@@ -1,0 +1,42 @@
+"""fmda_tpu — a TPU-native framework for real-time financial market data analysis.
+
+A ground-up JAX/XLA/Pallas/pjit re-design of the capabilities of
+``radoslawkrolikowski/financial-market-data-analysis`` (reference mounted at
+``/root/reference``): real-time acquisition of heterogeneous market feeds
+(order-book depth, OHLCV, VIX, economic indicators, COT reports), a
+framework-owned streaming feature-engineering core that replaces the
+reference's Kafka + Spark + MariaDB pipeline, and a bidirectional-GRU
+price-movement model trained with ``pjit`` data/sequence parallelism over a
+TPU mesh and served as jit-compiled streaming inference with carried hidden
+state.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+- :mod:`fmda_tpu.config`   — typed configs + feature-schema codegen (ref: config.py)
+- :mod:`fmda_tpu.ingest`   — API clients, scrapers, session driver (ref: getMarketData.py,
+  producer.py, *_spider.py)
+- :mod:`fmda_tpu.stream`   — message bus + streaming feature engine (ref: Kafka topics +
+  spark_consumer.py)
+- :mod:`fmda_tpu.ops`      — vectorized feature kernels, GRU scan, metrics (ref:
+  spark_consumer.py features + create_database.py views + sklearn metrics)
+- :mod:`fmda_tpu.data`     — chunked windowed data pipeline + normalization (ref:
+  sql_pytorch_dataloader.py)
+- :mod:`fmda_tpu.models`   — Flax BiGRU model family (ref: biGRU_model.py)
+- :mod:`fmda_tpu.train`    — training harness + Orbax checkpointing (ref:
+  biGRU_model_training.ipynb)
+- :mod:`fmda_tpu.serve`    — streaming predictor (ref: predict.py)
+- :mod:`fmda_tpu.parallel` — mesh / DP / sequence-parallel machinery (net-new; the
+  reference is single-machine)
+"""
+
+__version__ = "0.1.0"
+
+from fmda_tpu.config import FrameworkConfig, FeatureConfig, BusConfig, ModelConfig
+
+__all__ = [
+    "FrameworkConfig",
+    "FeatureConfig",
+    "BusConfig",
+    "ModelConfig",
+    "__version__",
+]

@@ -1,0 +1,374 @@
+"""Typed configuration for the fmda_tpu framework.
+
+Re-designs the reference's flat constants module (``/root/reference/config.py``)
+as frozen dataclasses while keeping its single load-bearing property: the
+**config → schema codegen**.  In the reference, changing ``bid_levels`` or
+``event_list`` reshapes the Kafka message schemas, the Spark streaming schemas,
+the MariaDB DDL, and the training feature set (``create_database.py:29-70``,
+``spark_consumer.py:241-291``).  Here the same knobs drive
+:meth:`FeatureConfig.table_columns` / :meth:`FeatureConfig.x_fields`, which
+every other layer (stream engine, warehouse, data pipeline, model input width,
+serving) derives its shapes from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+
+# ---------------------------------------------------------------------------
+# Bus (message transport) — replaces the reference's Kafka topic layout
+# (config.py:15: vix, volume, cot, ind, deep, predict_timestamp, prediction).
+# ---------------------------------------------------------------------------
+
+TOPIC_VIX = "vix"
+TOPIC_VOLUME = "volume"
+TOPIC_COT = "cot"
+TOPIC_IND = "ind"
+TOPIC_DEEP = "deep"
+TOPIC_PREDICT_TIMESTAMP = "predict_timestamp"
+TOPIC_PREDICTION = "prediction"
+
+DEFAULT_TOPICS: Tuple[str, ...] = (
+    TOPIC_VIX,
+    TOPIC_VOLUME,
+    TOPIC_COT,
+    TOPIC_IND,
+    TOPIC_DEEP,
+    TOPIC_PREDICT_TIMESTAMP,
+    TOPIC_PREDICTION,
+)
+
+
+@dataclass(frozen=True)
+class BusConfig:
+    """Message-bus layout (ref: config.py:15 ``kafka_config``)."""
+
+    topics: Tuple[str, ...] = DEFAULT_TOPICS
+    #: Ring-buffer capacity per topic (records) for the native bus backend.
+    capacity: int = 1 << 16
+    #: External Kafka brokers, only used by the optional Kafka adapter.
+    servers: Tuple[str, ...] = ("localhost:9092",)
+
+
+# ---------------------------------------------------------------------------
+# Warehouse
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WarehouseConfig:
+    """Warehouse backend (ref: MariaDB, config.py:21-28).
+
+    The framework-owned default is an embedded SQLite database (zero external
+    processes); a MySQL/MariaDB adapter with the reference's exact DDL can be
+    selected with ``backend="mysql"`` when ``mysql.connector`` is installed.
+    """
+
+    backend: str = "sqlite"
+    path: str = ":memory:"  # sqlite path or file
+    database_name: str = "stock_data"
+    table_name: str = "stock_data_joined"
+    # MySQL parity fields (unused by the sqlite backend)
+    user: str = "admin"
+    password: str = "admin"
+    hostname: str = "localhost"
+    port: int = 3306
+
+
+# ---------------------------------------------------------------------------
+# Feature configuration + schema codegen
+# ---------------------------------------------------------------------------
+
+DEFAULT_EVENT_LIST: Tuple[str, ...] = (
+    "Crude Oil Inventories",
+    "ISM Non-Manufacturing PMI",
+    "ISM Non-Manufacturing Employment",
+    "Services PMI",
+    "ADP Nonfarm Employment Change",
+    "Core CPI",
+    "Fed Interest Rate Decision",
+    "Building Permits",
+    "Core Retail Sales",
+    "Retail Sales",
+    "JOLTs Job Openings",
+    "Nonfarm Payrolls",
+    "Unemployment Rate",
+)
+
+EVENT_VALUES: Tuple[str, ...] = ("Actual", "Prev_actual_diff", "Forc_actual_diff")
+
+#: OHLCV column names as used by the reference end to end (the Alpha Vantage
+#: JSON keys ``1. open`` etc. become ``1_open`` after key sanitisation,
+#: getMarketData.py:240).
+VOLUME_COLUMNS: Tuple[str, ...] = (
+    "1_open",
+    "2_high",
+    "3_low",
+    "4_close",
+    "5_volume",
+    "wick_prct",
+)
+
+COT_GROUPS: Tuple[str, ...] = ("Asset", "Leveraged")
+COT_VALUES: Tuple[str, ...] = (
+    "long_pos",
+    "long_pos_change",
+    "long_open_int",
+    "short_pos",
+    "short_pos_change",
+    "short_open_int",
+)
+
+TARGET_COLUMNS: Tuple[str, ...] = ("up1", "up2", "down1", "down2")
+
+
+def sanitize_event(event_name: str) -> str:
+    """Event name → column stem (ref: config.py:58)."""
+    return event_name.replace(" ", "_").replace("-", "_")
+
+
+@dataclass(frozen=True)
+class FeatureConfig:
+    """Feature-engineering knobs (ref: config.py:31-65) + schema codegen.
+
+    The derived-feature parameters replicate the reference's SQL views
+    (create_database.py:76-190), including its quirks: the stochastic
+    oscillator and ATR windows are written as ``14 PRECEDING AND CURRENT ROW``
+    — i.e. **15-row** windows — while the MA views use ``period-1 PRECEDING``
+    (= ``period``-row windows).
+    """
+
+    get_cot: bool = True
+    get_vix: bool = True
+    #: Ticker whose OHLCV volume feed is ingested, or None to disable
+    #: (ref: config.py:33 ``get_stock_volume = 'SPY'``).
+    get_stock_volume: Optional[str] = "SPY"
+
+    bid_levels: int = 7
+    ask_levels: int = 7
+
+    volume_ma_periods: Tuple[int, ...] = (6, 20)
+    price_ma_periods: Tuple[int, ...] = (20,)
+    delta_ma_periods: Tuple[int, ...] = (12,)
+
+    bollinger_period: int = 20
+    bollinger_std: float = 2.0
+
+    stochastic_oscillator: bool = True
+    #: ``N PRECEDING`` counts — the effective rolling window is N+1 rows.
+    stoch_preceding: int = 14
+    atr_preceding: int = 14
+
+    event_list: Tuple[str, ...] = DEFAULT_EVENT_LIST
+
+    # Target construction (create_database.py:176-190)
+    target_n1: float = 1.5
+    target_n2: float = 3.0
+    target_lead1: int = 8
+    target_lead2: int = 15
+
+    #: Stream alignment: floor timestamps to this many seconds
+    #: (spark_consumer.py:111 — 5 minutes) and join feeds whose timestamps lie
+    #: within ``join_tolerance_s`` after the order-book timestamp
+    #: (spark_consumer.py:439-443 — 3 minutes).
+    floor_s: int = 5 * 60
+    join_tolerance_s: int = 3 * 60
+    watermark_s: int = 5 * 60
+
+    # -- schema codegen -----------------------------------------------------
+
+    @property
+    def event_list_repl(self) -> Tuple[str, ...]:
+        return tuple(sanitize_event(e) for e in self.event_list)
+
+    def empty_ind_message(self) -> dict:
+        """Economic-indicator message template (ref: config.py:58-65)."""
+        msg: dict = {"Timestamp": 0}
+        for event in self.event_list_repl:
+            msg[event] = {value: 0 for value in EVENT_VALUES}
+        return msg
+
+    def deep_columns(self) -> Tuple[str, ...]:
+        """Order-book feature columns landed in the warehouse.
+
+        Mirrors the reference DDL order (create_database.py:29-46): sizes for
+        all levels, rebased prices for levels 1.. (level-0 rebased prices are
+        identically zero and dropped, spark_consumer.py:397-400), then the
+        microstructure scalars and calendar one-hots.
+        """
+        cols = []
+        cols += [f"bid_{i}_size" for i in range(self.bid_levels)]
+        cols += [f"bid_{i}" for i in range(1, self.bid_levels)]
+        cols += [f"ask_{i}_size" for i in range(self.ask_levels)]
+        cols += [f"ask_{i}" for i in range(1, self.ask_levels)]
+        cols += [
+            "bids_ord_WA",
+            "asks_ord_WA",
+            "vol_imbalance",
+            "delta",
+            "micro_price",
+            "spread",
+            "session_start",
+            "day_1",
+            "day_2",
+            "day_3",
+            "day_4",
+            "week_1",
+            "week_2",
+            "week_3",
+            "week_4",
+        ]
+        return tuple(cols)
+
+    def vix_columns(self) -> Tuple[str, ...]:
+        return ("VIX",) if self.get_vix else ()
+
+    def volume_columns(self) -> Tuple[str, ...]:
+        return VOLUME_COLUMNS if self.get_stock_volume else ()
+
+    def cot_columns(self) -> Tuple[str, ...]:
+        if not self.get_cot:
+            return ()
+        return tuple(f"{g}_{v}" for g in COT_GROUPS for v in COT_VALUES)
+
+    def ind_columns(self) -> Tuple[str, ...]:
+        return tuple(
+            f"{event}_{value}"
+            for event in self.event_list_repl
+            for value in EVENT_VALUES
+        )
+
+    def table_columns(self) -> Tuple[str, ...]:
+        """All feature columns of the joined warehouse table, in DDL order
+        (create_database.py:69-70), excluding ID and Timestamp."""
+        return (
+            self.deep_columns()
+            + self.vix_columns()
+            + self.volume_columns()
+            + self.cot_columns()
+            + self.ind_columns()
+        )
+
+    def derived_columns(self) -> Tuple[str, ...]:
+        """Windowed-indicator columns (the reference's SQL views), in the
+        order the reference's ``join_statement`` concatenates them
+        (create_database.py:240-241: BB, vol_MA, price_MA, delta_MA, stoch,
+        ATR, price_change)."""
+        cols = []
+        if self.bollinger_period and self.bollinger_std:
+            cols += ["upper_BB_dist", "lower_BB_dist"]
+        cols += [f"vol_MA{p}" for p in self.volume_ma_periods]
+        cols += [f"price_MA{p}" for p in self.price_ma_periods]
+        cols += [f"delta_MA{p}" for p in self.delta_ma_periods]
+        if self.stochastic_oscillator:
+            cols += ["stoch"]
+        cols += ["ATR", "price_change"]
+        return tuple(cols)
+
+    def x_fields(self) -> Tuple[str, ...]:
+        """The model's input-feature schema: table columns followed by derived
+        columns — the column set of the reference's ``join_statement``
+        (create_database.py:240-258; 108 features with default config)."""
+        return self.table_columns() + self.derived_columns()
+
+    @property
+    def n_features(self) -> int:
+        return len(self.x_fields())
+
+
+# ---------------------------------------------------------------------------
+# Model / training / parallelism
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """BiGRU hyperparameters (ref: biGRU_model.py:32; notebook cell 29).
+
+    ``n_features=None`` means "derive from the feature schema" — resolved by
+    :class:`FrameworkConfig` so the model width can never silently diverge
+    from what the data pipeline emits.
+    """
+
+    hidden_size: int = 32
+    n_features: Optional[int] = None
+    output_size: int = len(TARGET_COLUMNS)
+    n_layers: int = 1
+    dropout: float = 0.5
+    spatial_dropout: bool = True
+    bidirectional: bool = True
+    #: Compute dtype for the GRU/head; params are kept in float32.
+    dtype: str = "float32"
+    #: Use the fused Pallas scan cell on TPU (falls back to lax.scan elsewhere).
+    use_pallas: bool = True
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training-harness hyperparameters (ref: notebook cells 11/29)."""
+
+    batch_size: int = 2
+    window: int = 30
+    chunk_size: int = 100
+    learning_rate: float = 1e-3
+    epochs: int = 25
+    clip: float = 50.0
+    val_size: float = 0.1
+    test_size: float = 0.1
+    fbeta_beta: float = 0.5
+    prob_threshold: float = 0.5
+    seed: int = 0
+    checkpoint_dir: str = "checkpoints"
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for pjit/shard_map parallelism (net-new vs the
+    single-machine reference; SURVEY.md §2 parallelism inventory)."""
+
+    #: Data-parallel axis size; -1 means "all remaining devices".
+    dp: int = -1
+    #: Sequence-parallel axis size (long-context recurrent scan sharding).
+    sp: int = 1
+    dp_axis: str = "dp"
+    sp_axis: str = "sp"
+
+
+@dataclass(frozen=True)
+class SessionConfig:
+    """Ingestion-session driver knobs (ref: producer.py:257-263)."""
+
+    freq_s: int = 300
+    source: str = "IEX"
+    symbol: str = "spy"
+    countries: Tuple[str, ...] = ("United States",)
+    importance: Tuple[str, ...] = ("1", "2", "3")
+    cot_subject: str = "S&P 500 STOCK INDEX"
+    timezone: str = "US/Eastern"
+
+
+@dataclass(frozen=True)
+class FrameworkConfig:
+    """Top-level aggregate configuration."""
+
+    features: FeatureConfig = field(default_factory=FeatureConfig)
+    bus: BusConfig = field(default_factory=BusConfig)
+    warehouse: WarehouseConfig = field(default_factory=WarehouseConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    session: SessionConfig = field(default_factory=SessionConfig)
+
+    def __post_init__(self) -> None:
+        if self.model.n_features is None:
+            synced = dataclasses.replace(
+                self.model, n_features=self.features.n_features
+            )
+            object.__setattr__(self, "model", synced)
+
+
+def default_config() -> FrameworkConfig:
+    return FrameworkConfig()

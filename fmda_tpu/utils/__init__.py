@@ -1,0 +1,16 @@
+"""Utility subpackage.
+
+Submodules are imported lazily so stdlib-only helpers (jsonutils, timeutils)
+stay importable without jax and don't pay its import cost in ingest-side
+processes.
+"""
+
+import importlib
+
+__all__ = ["jsonutils", "timeutils", "tracing"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module(f"fmda_tpu.utils.{name}")
+    raise AttributeError(name)

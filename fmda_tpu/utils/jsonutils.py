@@ -1,0 +1,41 @@
+"""JSON / dict normalisation helpers.
+
+Behavioral parity with the reference's message-shaping utilities
+(getMarketData.py:10-58): API payload keys are sanitised (``"1. open"`` →
+``"1_open"``) and stringly-typed numbers are coerced, recursively through
+nested containers.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def change_keys(obj: Any, old: str, new: str) -> Any:
+    """Recursively replace ``old`` with ``new`` in every dict key."""
+    if isinstance(obj, dict):
+        return {k.replace(old, new): change_keys(v, old, new) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return type(obj)(change_keys(v, old, new) for v in obj)
+    return obj
+
+
+def to_number(value: Any) -> Any:
+    """Cast a string to int (if all digits) or float; pass through otherwise."""
+    if not isinstance(value, str):
+        return value
+    if value.isdigit():
+        return int(value)
+    try:
+        return float(value)
+    except ValueError:
+        return value
+
+
+def values_to_numbers(obj: Any) -> Any:
+    """Recursively coerce numeric strings inside nested containers."""
+    if isinstance(obj, dict):
+        return {k: values_to_numbers(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return type(obj)(values_to_numbers(v) for v in obj)
+    return to_number(obj)

@@ -1,0 +1,121 @@
+"""Time handling: timestamp alignment, calendar features, market hours.
+
+Replaces the reference's scattered datetime logic (producer.py:41-49,
+spark_consumer.py:313-315/402-432) with pure, testable functions operating on
+epoch seconds and ``datetime`` objects.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, Optional
+
+try:  # stdlib zoneinfo needs tzdata on disk; fall back to pytz, then UTC.
+    from zoneinfo import ZoneInfo
+
+    def get_timezone(name: str):
+        return ZoneInfo(name)
+
+except Exception:  # pragma: no cover
+    try:
+        import pytz
+
+        def get_timezone(name: str):
+            return pytz.timezone(name)
+
+    except Exception:
+
+        def get_timezone(name: str):
+            return _dt.timezone.utc
+
+
+TS_FORMAT = "%Y-%m-%d %H:%M:%S"
+
+
+def parse_ts(ts: str) -> _dt.datetime:
+    """Parse a bus-message timestamp string (naive, exchange-local)."""
+    return _dt.datetime.strptime(ts, TS_FORMAT)
+
+
+def format_ts(dt: _dt.datetime) -> str:
+    return dt.strftime(TS_FORMAT)
+
+
+def to_epoch(ts: str) -> int:
+    """Naive timestamp string → epoch seconds (UTC interpretation).
+
+    The streaming engine only needs a consistent total order plus arithmetic,
+    matching Spark's ``unix_timestamp`` use (spark_consumer.py:315).
+    """
+    return int(parse_ts(ts).replace(tzinfo=_dt.timezone.utc).timestamp())
+
+
+def floor_epoch(epoch_s: int, floor_s: int) -> int:
+    """Round down to the nearest ``floor_s`` seconds (spark_consumer.py:315)."""
+    return (epoch_s // floor_s) * floor_s
+
+
+def day_of_week(dt: _dt.datetime) -> int:
+    """ISO day of week, Monday=1 (Spark ``date_format(.., "u")``)."""
+    return dt.isoweekday()
+
+
+def week_of_month(dt: _dt.datetime) -> int:
+    """Week-of-month as Java's ``"W"`` pattern computes it with default
+    locale settings (Sunday week start, minimal-days=1): the week index of
+    the calendar row containing ``dt`` (spark_consumer.py:407-408)."""
+    first = dt.replace(day=1)
+    # Days offset of the first day of the month within its (Sunday-start) week
+    first_dow_sunday0 = (first.weekday() + 1) % 7
+    return (dt.day + first_dow_sunday0 - 1) // 7 + 1
+
+
+def session_start_flag(dt: _dt.datetime) -> int:
+    """First-two-hours-of-session flag, replicating the reference's exact
+    predicate (spark_consumer.py:411-415): 0 iff hour >= 11 AND minute >= 30,
+    else 1.  (Note this is the reference's literal boolean, kept for parity —
+    e.g. 12:15 still yields 1.)"""
+    return 0 if (dt.hour >= 11 and dt.minute >= 30) else 1
+
+
+def last_day_of_month(date: _dt.date) -> _dt.date:
+    """Last day of the month (producer.py:32-38)."""
+    if date.month == 12:
+        return date.replace(day=31)
+    return date.replace(month=date.month + 1, day=1) - _dt.timedelta(days=1)
+
+
+def market_hour_to_dt(current: _dt.datetime, hour_str: str) -> _dt.datetime:
+    """'HH:MM' → today's datetime at that wall time (producer.py:41-49)."""
+    t = _dt.datetime.strptime(hour_str, "%H:%M")
+    return current.replace(hour=t.hour, minute=t.minute, second=0, microsecond=0)
+
+
+def forex_market_hours(current: _dt.datetime) -> Dict[str, _dt.datetime]:
+    """FX week: Sunday 17:00 ET → Friday 16:00 ET (producer.py:238-243)."""
+    start = current.replace(hour=17, minute=0, second=0, microsecond=0)
+    start = start - _dt.timedelta(days=current.weekday() + 1)
+    end = current.replace(hour=16, minute=0, second=0, microsecond=0)
+    end = end + _dt.timedelta(days=-(current.weekday() - 4))
+    return {"market_start": start, "market_end": end}
+
+
+def stock_market_hours(
+    current: _dt.datetime, market_day: Dict
+) -> Dict[str, _dt.datetime]:
+    """Expand a Tradier-style calendar day dict into localized datetimes with
+    keys ``{pre,post}market_{start,end}`` and ``market_{start,end}``
+    (producer.py:224-233; ``open`` maps to ``market``)."""
+    hours: Dict[str, _dt.datetime] = {}
+    for phase, key in (
+        ("premarket", "premarket"),
+        ("market", "open"),
+        ("postmarket", "postmarket"),
+    ):
+        entry = market_day.get(key)
+        if not entry:
+            continue
+        start, end = entry["start"], entry["end"]
+        hours[f"{phase}_start"] = market_hour_to_dt(current, start)
+        hours[f"{phase}_end"] = market_hour_to_dt(current, end)
+    return hours

@@ -1,0 +1,65 @@
+"""Lightweight tracing/profiling for pipeline stages.
+
+The reference has no tracing (SURVEY.md §5) — its only timing is the
+sleep-budget measurement in producer.py:115/147-150.  Here every pipeline
+stage can be wrapped in a :class:`StageTimer`, and device-side regions use
+``jax.named_scope`` so they show up in the JAX profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+log = logging.getLogger("fmda_tpu")
+
+
+class StageTimer:
+    """Accumulates wall-clock per named stage; cheap enough for hot loops."""
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - start
+            self.totals[name] += elapsed
+            self.counts[name] += 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "total_s": self.totals[name],
+                "count": self.counts[name],
+                "mean_s": self.totals[name] / max(self.counts[name], 1),
+            }
+            for name in self.totals
+        }
+
+    def log_summary(self, level: int = logging.INFO) -> None:
+        for name, stats in sorted(self.summary().items()):
+            log.log(
+                level,
+                "stage %-24s total=%.4fs count=%d mean=%.6fs",
+                name,
+                stats["total_s"],
+                int(stats["count"]),
+                stats["mean_s"],
+            )
+
+
+@contextlib.contextmanager
+def device_scope(name: str) -> Iterator[None]:
+    """Annotate a device-side region for the JAX profiler."""
+    import jax  # deferred: keep stdlib-only users of this module jax-free
+
+    with jax.named_scope(name):
+        yield

@@ -1,0 +1,79 @@
+"""Config → schema codegen parity (ref: create_database.py:29-70, 192-258)."""
+
+import dataclasses
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    EVENT_VALUES,
+    FeatureConfig,
+    default_config,
+)
+
+
+def test_default_feature_count_matches_reference():
+    # The reference's norm_params artifact holds exactly 108 features
+    # (SURVEY.md §2, BASELINE.md).
+    fc = FeatureConfig()
+    assert fc.n_features == 108
+
+
+def test_topic_layout():
+    assert DEFAULT_TOPICS == (
+        "vix",
+        "volume",
+        "cot",
+        "ind",
+        "deep",
+        "predict_timestamp",
+        "prediction",
+    )
+
+
+def test_deep_columns_layout():
+    fc = FeatureConfig(bid_levels=3, ask_levels=2)
+    cols = fc.deep_columns()
+    # sizes for all levels, rebased prices only for levels >= 1
+    assert cols[:3] == ("bid_0_size", "bid_1_size", "bid_2_size")
+    assert "bid_0" not in cols and "ask_0" not in cols
+    assert "bid_2" in cols and "ask_1" in cols
+    for c in ("bids_ord_WA", "vol_imbalance", "micro_price", "spread",
+              "session_start", "day_4", "week_4"):
+        assert c in cols
+
+
+def test_schema_reshapes_with_config():
+    # The load-bearing property: config knobs reshape the whole schema
+    # (create_database.py derives DDL from config at runtime).
+    base = FeatureConfig()
+    more_levels = dataclasses.replace(base, bid_levels=10, ask_levels=10)
+    assert more_levels.n_features == base.n_features + 2 * 3 + 2 * 3
+    fewer_events = dataclasses.replace(base, event_list=base.event_list[:5])
+    assert fewer_events.n_features == base.n_features - 8 * len(EVENT_VALUES)
+    no_vix = dataclasses.replace(base, get_vix=False)
+    assert no_vix.n_features == base.n_features - 1
+    no_vol = dataclasses.replace(base, get_stock_volume=None)
+    assert no_vol.n_features == base.n_features - 6
+    no_cot = dataclasses.replace(base, get_cot=False)
+    assert no_cot.n_features == base.n_features - 12
+
+
+def test_x_fields_order_table_then_views():
+    fc = FeatureConfig()
+    xf = fc.x_fields()
+    assert xf[: len(fc.table_columns())] == fc.table_columns()
+    assert xf[-2:] == ("ATR", "price_change")
+    assert "upper_BB_dist" in xf and "stoch" in xf and "vol_MA20" in xf
+
+
+def test_ind_message_template():
+    fc = FeatureConfig(event_list=("Core CPI", "Nonfarm Payrolls"))
+    msg = fc.empty_ind_message()
+    assert msg["Timestamp"] == 0
+    assert msg["Core_CPI"] == {
+        "Actual": 0, "Prev_actual_diff": 0, "Forc_actual_diff": 0}
+    assert set(msg) == {"Timestamp", "Core_CPI", "Nonfarm_Payrolls"}
+
+
+def test_model_width_syncs_to_features():
+    cfg = default_config()
+    assert cfg.model.n_features == cfg.features.n_features

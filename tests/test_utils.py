@@ -1,0 +1,79 @@
+"""Utils behavior parity (ref: getMarketData.py:10-58, producer.py:32-49,
+spark_consumer.py:402-415)."""
+
+import datetime as dt
+
+from fmda_tpu.utils.jsonutils import change_keys, to_number, values_to_numbers
+from fmda_tpu.utils.timeutils import (
+    day_of_week,
+    floor_epoch,
+    forex_market_hours,
+    last_day_of_month,
+    market_hour_to_dt,
+    parse_ts,
+    session_start_flag,
+    to_epoch,
+    week_of_month,
+)
+
+
+def test_change_keys_nested():
+    obj = {"1. open": {"2. high": [1, {"3. low": 2}]}}
+    out = change_keys(obj, ". ", "_")
+    assert out == {"1_open": {"2_high": [1, {"3_low": 2}]}}
+
+
+def test_to_number():
+    assert to_number("42") == 42
+    assert to_number("3.5") == 3.5
+    assert to_number("-1.5") == -1.5
+    assert to_number("abc") == "abc"
+    assert to_number(7) == 7
+
+
+def test_values_to_numbers():
+    assert values_to_numbers({"a": "1", "b": ["2.5", "x"]}) == {
+        "a": 1, "b": [2.5, "x"]}
+
+
+def test_floor_epoch_5min():
+    e = to_epoch("2020-02-07 09:26:12")
+    f = floor_epoch(e, 300)
+    assert f % 300 == 0
+    assert e - f == 6 * 60 + 12 - 5 * 60  # 09:25:00 floor
+
+
+def test_calendar_features():
+    d = parse_ts("2020-02-07 09:26:12")  # Friday
+    assert day_of_week(d) == 5
+    # Java "W" with Sunday week-start: Feb 1 2020 (Sat) is week 1; Feb 2-8 week 2.
+    assert week_of_month(d) == 2
+    assert week_of_month(parse_ts("2020-02-01 00:00:00")) == 1
+    assert week_of_month(parse_ts("2020-03-08 00:00:00")) == 2  # Mar 1 2020 = Sunday
+
+
+def test_session_start_flag_reference_semantics():
+    assert session_start_flag(parse_ts("2020-02-07 09:30:00")) == 1
+    assert session_start_flag(parse_ts("2020-02-07 11:30:00")) == 0
+    assert session_start_flag(parse_ts("2020-02-07 12:15:00")) == 1  # ref quirk
+    assert session_start_flag(parse_ts("2020-02-07 13:45:00")) == 0
+
+
+def test_last_day_of_month():
+    assert last_day_of_month(dt.date(2020, 2, 10)) == dt.date(2020, 2, 29)
+    assert last_day_of_month(dt.date(2020, 12, 1)) == dt.date(2020, 12, 31)
+
+
+def test_market_hour_to_dt():
+    cur = dt.datetime(2020, 2, 7, 9, 26, 12)
+    out = market_hour_to_dt(cur, "09:30")
+    assert out == dt.datetime(2020, 2, 7, 9, 30, 0)
+
+
+def test_forex_week():
+    cur = dt.datetime(2020, 2, 5, 12, 0)  # Wednesday
+    hours = forex_market_hours(cur)
+    assert hours["market_start"].weekday() == 6  # Sunday
+    assert hours["market_start"].hour == 17
+    assert hours["market_end"].weekday() == 4  # Friday
+    assert hours["market_end"].hour == 16
