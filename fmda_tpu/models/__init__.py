@@ -1,0 +1,3 @@
+from fmda_tpu.models.bigru import BiGRU, BiGRUState
+
+__all__ = ["BiGRU", "BiGRUState"]

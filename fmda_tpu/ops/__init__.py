@@ -1,0 +1,25 @@
+from fmda_tpu.ops.gru import GRUWeights, gru_gates, gru_layer, gru_scan, input_projection
+from fmda_tpu.ops.metrics import (
+    MultilabelMetrics,
+    fbeta_score,
+    hamming_loss,
+    multilabel_confusion,
+    multilabel_metrics,
+    subset_accuracy,
+    threshold_predictions,
+)
+
+__all__ = [
+    "GRUWeights",
+    "gru_gates",
+    "gru_layer",
+    "gru_scan",
+    "input_projection",
+    "MultilabelMetrics",
+    "fbeta_score",
+    "hamming_loss",
+    "multilabel_confusion",
+    "multilabel_metrics",
+    "subset_accuracy",
+    "threshold_predictions",
+]

@@ -1,0 +1,94 @@
+"""In-graph multi-label classification metrics.
+
+The reference computes metrics with sklearn on CPU inside the training batch
+loop (biGRU_model.py:215-222) — a host round-trip per batch.  Here every
+metric is a pure jnp function that jits into the train/eval step, so the TPU
+never stalls on metric computation.  Semantics match sklearn's:
+
+- ``subset_accuracy``  == sklearn.metrics.accuracy_score (exact-match ratio)
+- ``hamming_loss``     == sklearn.metrics.hamming_loss
+- ``fbeta_score``      == sklearn.metrics.fbeta_score(average=None),
+  with the 0/0 -> 0 convention
+- ``multilabel_confusion`` == sklearn.metrics.multilabel_confusion_matrix
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def threshold_predictions(logits: jax.Array, threshold: float = 0.5) -> jax.Array:
+    """Logits -> boolean label predictions (sigmoid > threshold)."""
+    return jax.nn.sigmoid(logits) > threshold
+
+
+def subset_accuracy(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """Exact-match ratio over the batch."""
+    pred = pred.astype(jnp.bool_)
+    target = target.astype(jnp.bool_)
+    return jnp.mean(jnp.all(pred == target, axis=-1).astype(jnp.float32))
+
+
+def hamming_loss(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """Fraction of wrong labels."""
+    pred = pred.astype(jnp.bool_)
+    target = target.astype(jnp.bool_)
+    return jnp.mean((pred != target).astype(jnp.float32))
+
+
+def _safe_div(num: jax.Array, den: jax.Array) -> jax.Array:
+    return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
+
+
+def fbeta_score(pred: jax.Array, target: jax.Array, beta: float = 0.5) -> jax.Array:
+    """Per-class F-beta over the batch; shape (n_classes,)."""
+    pred = pred.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    tp = jnp.sum(pred * target, axis=0)
+    fp = jnp.sum(pred * (1.0 - target), axis=0)
+    fn = jnp.sum((1.0 - pred) * target, axis=0)
+    precision = _safe_div(tp, tp + fp)
+    recall = _safe_div(tp, tp + fn)
+    b2 = beta * beta
+    return _safe_div((1.0 + b2) * precision * recall, b2 * precision + recall)
+
+
+def multilabel_confusion(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """Per-class 2x2 confusion matrices, shape (n_classes, 2, 2) of int32,
+    laid out [[tn, fp], [fn, tp]] like sklearn."""
+    pred = pred.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    tp = jnp.sum(pred * target, axis=0)
+    fp = jnp.sum(pred * (1.0 - target), axis=0)
+    fn = jnp.sum((1.0 - pred) * target, axis=0)
+    tn = jnp.sum((1.0 - pred) * (1.0 - target), axis=0)
+    return jnp.stack(
+        [jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2
+    ).astype(jnp.int32)
+
+
+class MultilabelMetrics(NamedTuple):
+    accuracy: jax.Array
+    hamming: jax.Array
+    fbeta: jax.Array  # (n_classes,)
+    confusion: jax.Array  # (n_classes, 2, 2)
+
+
+def multilabel_metrics(
+    logits: jax.Array,
+    target: jax.Array,
+    *,
+    threshold: float = 0.5,
+    beta: float = 0.5,
+) -> MultilabelMetrics:
+    """All batch metrics in one fused pass (train/eval step helper)."""
+    pred = threshold_predictions(logits, threshold)
+    return MultilabelMetrics(
+        accuracy=subset_accuracy(pred, target),
+        hamming=hamming_loss(pred, target),
+        fbeta=fbeta_score(pred, target, beta),
+        confusion=multilabel_confusion(pred, target),
+    )

@@ -7,12 +7,20 @@ jax initialises a backend, hence module-level env mutation in conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests always run on CPU (overriding any ambient accelerator platform) so
+# the 8-device virtual mesh is available and numerics are deterministic.
+# jax may already be imported by the environment's sitecustomize, so set the
+# platform via jax.config (env vars alone would be read too late).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,4 @@
+import jax
+
+def test_eight_cpu_devices():
+    assert len(jax.devices()) == 8, jax.devices()

@@ -1,0 +1,69 @@
+"""In-graph metrics vs sklearn (the reference's metric source,
+biGRU_model.py:215-222)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fmda_tpu.ops.metrics import (
+    fbeta_score,
+    hamming_loss,
+    multilabel_confusion,
+    multilabel_metrics,
+    subset_accuracy,
+    threshold_predictions,
+)
+
+sklearn_metrics = pytest.importorskip("sklearn.metrics")
+
+
+@pytest.fixture
+def batch(rng):
+    pred = rng.integers(0, 2, size=(32, 4)).astype(bool)
+    target = rng.integers(0, 2, size=(32, 4)).astype(bool)
+    return pred, target
+
+
+def test_subset_accuracy(batch):
+    pred, target = batch
+    ours = float(subset_accuracy(jnp.asarray(pred), jnp.asarray(target)))
+    theirs = sklearn_metrics.accuracy_score(target, pred)
+    assert ours == pytest.approx(theirs)
+
+
+def test_hamming(batch):
+    pred, target = batch
+    ours = float(hamming_loss(jnp.asarray(pred), jnp.asarray(target)))
+    theirs = sklearn_metrics.hamming_loss(target, pred)
+    assert ours == pytest.approx(theirs)
+
+
+def test_fbeta(batch):
+    pred, target = batch
+    ours = np.asarray(fbeta_score(jnp.asarray(pred), jnp.asarray(target), 0.5))
+    theirs = sklearn_metrics.fbeta_score(target, pred, beta=0.5, average=None)
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
+
+
+def test_fbeta_zero_division():
+    pred = jnp.zeros((8, 4), bool)
+    target = jnp.zeros((8, 4), bool)
+    np.testing.assert_allclose(np.asarray(fbeta_score(pred, target)), 0.0)
+
+
+def test_confusion(batch):
+    pred, target = batch
+    ours = np.asarray(multilabel_confusion(jnp.asarray(pred), jnp.asarray(target)))
+    theirs = sklearn_metrics.multilabel_confusion_matrix(target, pred)
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_bundle(batch):
+    pred, target = batch
+    # logits chosen so sigmoid(logits) > .5 reproduces pred exactly
+    logits = jnp.where(jnp.asarray(pred), 3.0, -3.0)
+    m = multilabel_metrics(logits, jnp.asarray(target))
+    assert float(m.accuracy) == pytest.approx(
+        sklearn_metrics.accuracy_score(target, pred))
+    assert np.asarray(threshold_predictions(logits)).dtype == bool

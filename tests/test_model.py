@@ -1,0 +1,174 @@
+"""Weight-for-weight parity of the Flax BiGRU against torch semantics.
+
+The torch side re-implements the documented reference forward
+(biGRU_model.py:63-138) as a test oracle: nn.GRU + sum-of-directions,
+max/mean pooling, last-hidden sum, Dense head.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.config import ModelConfig
+from fmda_tpu.models.bigru import BiGRU, BiGRUState
+
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+def make_params(tg, n_layers, bidirectional, hidden, out_size):
+    """Flax param dict from a torch nn.GRU + nn.Linear pair."""
+    gru, linear = tg
+    params = {}
+    n_dirs = 2 if bidirectional else 1
+    for layer in range(n_layers):
+        for d in range(n_dirs):
+            suffix = f"l{layer}" + ("_reverse" if d == 1 else "")
+            params[f"weight_ih_{suffix}"] = jnp.asarray(_np(getattr(gru, f"weight_ih_{suffix}")))
+            params[f"weight_hh_{suffix}"] = jnp.asarray(_np(getattr(gru, f"weight_hh_{suffix}")))
+            params[f"bias_ih_{suffix}"] = jnp.asarray(_np(getattr(gru, f"bias_ih_{suffix}")))
+            params[f"bias_hh_{suffix}"] = jnp.asarray(_np(getattr(gru, f"bias_hh_{suffix}")))
+    params["linear"] = {
+        "kernel": jnp.asarray(_np(linear.weight).T),
+        "bias": jnp.asarray(_np(linear.bias)),
+    }
+    return {"params": params}
+
+
+def torch_reference_forward(gru, linear, x, hidden_size, n_layers, bidirectional):
+    """The reference head semantics (biGRU_model.py:102-138), torch oracle."""
+    batch, seq_len = x.shape[0], x.shape[1]
+    n_dirs = 2 if bidirectional else 1
+    gru_out, hidden = gru(x)
+    hidden = hidden.view(n_layers, n_dirs, batch, hidden_size)
+    last_hidden = torch.sum(hidden[-1], dim=0)
+    if bidirectional:
+        gru_out = gru_out[:, :, :hidden_size] + gru_out[:, :, hidden_size:]
+    max_pool = torch.nn.functional.adaptive_max_pool1d(
+        gru_out.permute(0, 2, 1), (1,)
+    ).view(batch, -1)
+    avg_pool = torch.sum(gru_out, dim=1) / torch.FloatTensor([seq_len])
+    concat = torch.cat([last_hidden, max_pool, avg_pool], dim=1)
+    return linear(concat)
+
+
+@pytest.mark.parametrize(
+    "n_layers,bidirectional", [(1, True), (1, False), (2, True)]
+)
+def test_bigru_matches_torch(n_layers, bidirectional):
+    torch.manual_seed(0)
+    hidden, feats, out_size, batch, seq_len = 16, 12, 4, 3, 9
+
+    gru = torch.nn.GRU(
+        feats, hidden, num_layers=n_layers, batch_first=True,
+        bidirectional=bidirectional,
+    )
+    linear = torch.nn.Linear(hidden * 3, out_size)
+    xt = torch.randn(batch, seq_len, feats)
+    expected = torch_reference_forward(
+        gru, linear, xt, hidden, n_layers, bidirectional)
+
+    cfg = ModelConfig(
+        hidden_size=hidden, n_features=feats, output_size=out_size,
+        n_layers=n_layers, bidirectional=bidirectional, dropout=0.0,
+    )
+    model = BiGRU(cfg)
+    variables = make_params((gru, linear), n_layers, bidirectional, hidden, out_size)
+    logits = model.apply(variables, jnp.asarray(xt.numpy()))
+
+    np.testing.assert_allclose(np.asarray(logits), _np(expected), atol=1e-5)
+
+
+def test_streaming_state_carry_matches_full_scan():
+    """Forward hidden state carried across two half-windows equals a single
+    full-window scan (unidirectional — the streaming-serving fast path)."""
+    cfg = ModelConfig(hidden_size=8, n_features=5, output_size=4,
+                      bidirectional=False, dropout=0.0)
+    model = BiGRU(cfg)
+    rng = jax.random.PRNGKey(1)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 10, 5))
+    variables = model.init({"params": rng}, x)
+
+    _, state_full = model.apply(variables, x, return_state=True)
+    _, state_half = model.apply(variables, x[:, :5], return_state=True)
+    _, state_resumed = model.apply(
+        variables, x[:, 5:], BiGRUState(state_half.hidden), return_state=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(state_resumed.hidden), np.asarray(state_full.hidden), atol=1e-5
+    )
+    # Head consistency on the resumed window: last-hidden component of the
+    # logits must be derived from the carried final state.  With hidden==
+    # state_full.hidden, pooling over [5:] seeded by state_half equals
+    # pooling the full scan's outputs restricted to [5:]; verify via the
+    # per-step outputs of ops.gru directly.
+    from fmda_tpu.ops.gru import GRUWeights, gru_layer
+
+    p = variables["params"]
+    w = GRUWeights(p["weight_ih_l0"], p["weight_hh_l0"],
+                   p["bias_ih_l0"], p["bias_hh_l0"])
+    _, hs_full = gru_layer(x, w)
+    _, hs_resumed = gru_layer(x[:, 5:], w, state_half.hidden[0, 0])
+    np.testing.assert_allclose(
+        np.asarray(hs_resumed), np.asarray(hs_full[:, 5:]), atol=1e-5)
+
+
+def test_bidirectional_state_carry_rejected():
+    cfg = ModelConfig(hidden_size=4, n_features=3, output_size=4,
+                      bidirectional=True, dropout=0.0)
+    model = BiGRU(cfg)
+    x = jnp.zeros((1, 4, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+    _, state = model.apply(variables, x, return_state=True)
+    with pytest.raises(ValueError, match="bidirectional"):
+        model.apply(variables, x, state)
+
+
+def test_spatial_dropout_zeroes_whole_channels():
+    cfg = ModelConfig(hidden_size=4, n_features=6, output_size=4,
+                      dropout=0.5, spatial_dropout=True)
+    model = BiGRU(cfg)
+    x = jnp.ones((2, 7, 6))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+
+    # Peek at the dropout behavior through the intermediate: apply only the
+    # dropout by monkey-layering — simplest is to check determinism flag off
+    # produces either fully-zero or fully-scaled channels on the input side.
+    # We verify via the Dropout module directly with the same broadcast dims.
+    import flax.linen as nn
+
+    drop = nn.Dropout(0.5, broadcast_dims=(1,))
+    y = drop.apply({}, x, deterministic=False,
+                   rngs={"dropout": jax.random.PRNGKey(3)})
+    y = np.asarray(y)
+    # each (batch, channel) column is either all zero or all 2.0 across time
+    for b in range(2):
+        for f in range(6):
+            col = y[b, :, f]
+            assert np.all(col == 0.0) or np.allclose(col, 2.0)
+
+
+def test_mask_changes_pools_only_for_padded_steps():
+    cfg = ModelConfig(hidden_size=6, n_features=3, output_size=4, dropout=0.0)
+    model = BiGRU(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8, 3))
+    variables = model.init({"params": jax.random.PRNGKey(5)}, x)
+
+    mask = jnp.ones((2, 8), dtype=bool)
+    logits_full = model.apply(variables, x, mask=mask)
+    logits_nomask = model.apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(logits_full), np.asarray(logits_nomask), atol=1e-6)
+
+    # Truncated vs masked: last 3 steps invalid == scanning only first 5
+    mask5 = jnp.array([[True] * 5 + [False] * 3] * 2)
+    logits_masked = model.apply(variables, x, mask=mask5)
+    # mean-pool divides by valid count; compare against explicit 5-step run
+    logits_trunc = model.apply(variables, x[:, :5])
+    np.testing.assert_allclose(
+        np.asarray(logits_masked), np.asarray(logits_trunc), atol=1e-5)
