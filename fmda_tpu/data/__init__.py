@@ -1,0 +1,26 @@
+from fmda_tpu.data.source import ArraySource, FeatureSource
+from fmda_tpu.data.windows import chunk_ranges, train_val_test_split, window_index_matrix
+from fmda_tpu.data.normalize import (
+    NormParams,
+    chunk_norm_params,
+    load_norm_params,
+    normalize,
+    save_norm_params,
+)
+from fmda_tpu.data.pipeline import ChunkDataset, WindowBatches, prefetch_to_device
+
+__all__ = [
+    "ArraySource",
+    "FeatureSource",
+    "chunk_ranges",
+    "train_val_test_split",
+    "window_index_matrix",
+    "NormParams",
+    "chunk_norm_params",
+    "normalize",
+    "save_norm_params",
+    "load_norm_params",
+    "ChunkDataset",
+    "WindowBatches",
+    "prefetch_to_device",
+]

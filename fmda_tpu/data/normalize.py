@@ -1,0 +1,99 @@
+"""Chunked min-max normalization.
+
+Reproduces the reference's normalization *story* exactly
+(sql_pytorch_dataloader.py:91-153), because it changes the training
+distribution and therefore accuracy parity:
+
+- per-chunk MIN/MAX per feature column;
+- MIN==MAX jitter guard (``max += max*1e-3`` or ``+= 1e-3`` if zero);
+- order-book size columns share one MIN/MAX across all levels of a side
+  (the book is one distribution, not per-level);
+- the *last* chunk's params are persisted and reused for validation, test,
+  and serving.
+
+Unlike the reference (two full SQL aggregate scans per chunk), stats come
+from one vectorized pass over the chunk that is already in memory.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, NamedTuple, Sequence
+
+import numpy as np
+
+
+class NormParams(NamedTuple):
+    x_min: np.ndarray  # (F,)
+    x_max: np.ndarray  # (F,)
+
+
+def _shared_book_indices(
+    x_fields: Sequence[str], side: str, levels: int
+) -> List[int]:
+    names = [f"{side}_{i}_size" for i in range(levels)]
+    return [x_fields.index(n) for n in names if n in x_fields]
+
+
+def chunk_norm_params(
+    x: np.ndarray,
+    x_fields: Sequence[str],
+    *,
+    bid_levels: int = 0,
+    ask_levels: int = 0,
+) -> NormParams:
+    """Compute one chunk's min/max stats with the reference's guards."""
+    x = np.asarray(x, dtype=np.float64)
+    x_min = np.nanmin(x, axis=0)
+    x_max = np.nanmax(x, axis=0)
+
+    # Jitter guard: normalization needs MIN != MAX
+    # (sql_pytorch_dataloader.py:108-113).
+    degenerate = x_min == x_max
+    x_max = np.where(
+        degenerate & (x_max != 0), x_max + x_max * 0.001, x_max
+    )
+    x_max = np.where(degenerate & (x_max == 0), 0.001, x_max)
+
+    # Book-wide shared stats across size columns of each side
+    # (sql_pytorch_dataloader.py:119-144; gated on the book being present).
+    x_fields = list(x_fields)
+    if "bid_0_size" in x_fields:
+        for side, levels in (("ask", ask_levels), ("bid", bid_levels)):
+            idx = _shared_book_indices(x_fields, side, levels)
+            if idx:
+                x_min[idx] = x_min[idx].min()
+                x_max[idx] = x_max[idx].max()
+
+    return NormParams(
+        x_min.astype(np.float32), x_max.astype(np.float32)
+    )
+
+
+def normalize(x: np.ndarray, params: NormParams) -> np.ndarray:
+    """Min-max scale (sql_pytorch_dataloader.py:239)."""
+    return (np.asarray(x, np.float32) - params.x_min) / (
+        params.x_max - params.x_min
+    )
+
+
+def save_norm_params(
+    path: str, params: NormParams, x_fields: Sequence[str]
+) -> None:
+    """Persist as ``{name: {MIN, MAX}}`` — the reference's artifact layout
+    (sql_pytorch_dataloader.py:147-153), serialised as JSON instead of
+    pickle so it is language-neutral and checkpoint-tree friendly."""
+    payload: Dict[str, Dict[str, float]] = {
+        name: {"MIN": float(params.x_min[i]), "MAX": float(params.x_max[i])}
+        for i, name in enumerate(x_fields)
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh)
+
+
+def load_norm_params(path: str) -> NormParams:
+    with open(path) as fh:
+        payload = json.load(fh)
+    x_min = np.array([v["MIN"] for v in payload.values()], np.float32)
+    x_max = np.array([v["MAX"] for v in payload.values()], np.float32)
+    return NormParams(x_min, x_max)

@@ -1,0 +1,152 @@
+"""Chunked, windowed, normalized batch pipeline with device prefetch.
+
+The TPU-first re-design of the reference's SQL dataloader stack
+(sql_pytorch_dataloader.py:21-248):
+
+- :class:`ChunkDataset` plays ``MySQLChunkLoader``: chunk ranges with
+  window overlap + per-chunk normalization stats, against any
+  :class:`~fmda_tpu.data.source.FeatureSource`.
+- :class:`WindowBatches` plays ``MySQLBatchLoader``: one vectorized gather
+  materialises every stride-1 window of a chunk, then yields fixed-shape
+  batches (the last partial batch is zero-padded and masked, so every step
+  hits the same compiled executable — no recompiles, no dynamic shapes).
+- :func:`prefetch_to_device` double-buffers host batches into HBM so the
+  device never waits on the host (the "infeed" half of SURVEY.md §7.2).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fmda_tpu.data.normalize import NormParams, chunk_norm_params, normalize
+from fmda_tpu.data.source import FeatureSource
+from fmda_tpu.data.windows import chunk_ranges, train_val_test_split, window_index_matrix
+
+
+class Batch(NamedTuple):
+    """One fixed-shape training batch."""
+
+    x: np.ndarray  # (B, window, F) float32, normalized
+    y: np.ndarray  # (B, n_classes) float32
+    mask: np.ndarray  # (B,) float32 — 0 for padded examples
+
+
+class ChunkDataset:
+    """Chunk ranges + per-chunk normalization stats over a source."""
+
+    def __init__(
+        self,
+        source: FeatureSource,
+        chunk_size: int,
+        window: int,
+        *,
+        bid_levels: int = 0,
+        ask_levels: int = 0,
+    ) -> None:
+        self.source = source
+        self.window = window
+        self.chunk_size = chunk_size
+        self.ranges = chunk_ranges(len(source), chunk_size, window)
+        self.norm_params: List[NormParams] = [
+            chunk_norm_params(
+                source.fetch(r),
+                source.x_fields,
+                bid_levels=bid_levels,
+                ask_levels=ask_levels,
+            )
+            for r in self.ranges
+        ]
+
+    def __len__(self) -> int:
+        return len(self.ranges)
+
+    def __getitem__(self, idx: int) -> Tuple[range, NormParams]:
+        return self.ranges[idx], self.norm_params[idx]
+
+    @property
+    def final_norm_params(self) -> NormParams:
+        """The last chunk's stats — the reference persists these for
+        val/test/serving (sql_pytorch_dataloader.py:147-153)."""
+        return self.norm_params[-1]
+
+    def split(
+        self, val_size: float = 0.1, test_size: float = 0.1
+    ) -> Tuple[Sequence[int], Sequence[int], Sequence[int]]:
+        return train_val_test_split(len(self), val_size, test_size)
+
+
+class WindowBatches:
+    """Fixed-shape sliding-window batches for one chunk."""
+
+    def __init__(
+        self,
+        dataset: ChunkDataset,
+        chunk_idx: int,
+        batch_size: int,
+        *,
+        norm_params: Optional[NormParams] = None,
+        drop_remainder: bool = False,
+    ) -> None:
+        ids, chunk_params = dataset[chunk_idx]
+        params = norm_params if norm_params is not None else chunk_params
+        x = normalize(dataset.source.fetch(ids), params)
+        y = np.asarray(dataset.source.fetch_targets(ids), np.float32)
+        widx = window_index_matrix(len(x), dataset.window)
+        self.x_windows = x[widx]  # (n_windows, window, F)
+        self.y_windows = y[widx[:, -1]] if len(widx) else y[:0]
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+
+    def __len__(self) -> int:
+        n = len(self.x_windows)
+        if self.drop_remainder:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Batch]:
+        n = len(self.x_windows)
+        bs = self.batch_size
+        for start in range(0, n, bs):
+            xb = self.x_windows[start : start + bs]
+            yb = self.y_windows[start : start + bs]
+            valid = len(xb)
+            if valid < bs:
+                if self.drop_remainder:
+                    return
+                pad = bs - valid
+                xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+                yb = np.concatenate([yb, np.zeros((pad,) + yb.shape[1:], yb.dtype)])
+            mask = np.zeros(bs, np.float32)
+            mask[:valid] = 1.0
+            yield Batch(xb, yb, mask)
+
+
+def prefetch_to_device(
+    batches: Iterable[Batch], buffer_size: int = 2
+) -> Iterator[Batch]:
+    """Move batches to the default device ahead of consumption.
+
+    A simple double-buffer: while the caller computes on batch ``i``, batch
+    ``i+1`` is already being transferred.  (jax.device_put is async — the
+    transfer overlaps with compute dispatch.)
+    """
+    import collections
+
+    import jax
+
+    queue: collections.deque = collections.deque()
+    it = iter(batches)
+    try:
+        for _ in range(buffer_size):
+            queue.append(jax.device_put(next(it)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(jax.device_put(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,76 @@
+"""Chunk / sliding-window index arithmetic.
+
+Replicates the reference's chunked-loading semantics
+(sql_pytorch_dataloader.py:62-78, 251-320) as pure index math over 1-based
+row ids, but vectorized: instead of a Python generator yielding one window
+per ``next()`` call, windows are materialised as an index *matrix* so the
+whole chunk gathers in one stride-friendly operation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def chunk_ranges(db_length: int, chunk_size: int, window: int) -> List[range]:
+    """Chunk id ranges with ``window-1``-row overlap stitching.
+
+    Reference semantics (sql_pytorch_dataloader.py:68-78), 1-based ids:
+    chunk 0 covers ids ``[window, chunk_size)``; interior chunk ``k`` covers
+    ``[k*chunk_size - window + 1, (k+1)*chunk_size)``; the final chunk runs
+    to ``db_length`` inclusive.  The overlap lets every chunk produce
+    windows for all its "own" rows without reaching into the previous chunk.
+    """
+    if window >= db_length:
+        raise ValueError(
+            f"window ({window}) must be smaller than the source length "
+            f"({db_length})"
+        )
+    num_chunks = db_length // chunk_size
+    if num_chunks == 0:
+        # Source shorter than one chunk: a single chunk covering everything
+        # (the reference's arithmetic assumed db_length >= chunk_size).
+        return [range(window, db_length + 1)]
+    ranges: List[range] = []
+    for chunk in range(num_chunks + 1):
+        if chunk == 0:
+            ranges.append(range(window, chunk_size))
+        elif chunk < num_chunks:
+            ranges.append(range(chunk_size * chunk - window + 1, chunk_size * (chunk + 1)))
+        else:
+            ranges.append(range(chunk_size * chunk - window + 1, db_length + 1))
+    return ranges
+
+
+def window_index_matrix(n_rows: int, window: int) -> np.ndarray:
+    """All stride-1 sliding windows over ``n_rows`` positions.
+
+    Returns an int matrix of shape ``(n_rows - window + 1, window)`` whose
+    row ``i`` is ``[i, i+1, ..., i+window-1]`` — the vectorized equivalent of
+    the reference's ``window_indices`` generator
+    (sql_pytorch_dataloader.py:8-18).
+    """
+    if n_rows < window:
+        return np.empty((0, window), dtype=np.int64)
+    starts = np.arange(n_rows - window + 1, dtype=np.int64)[:, None]
+    return starts + np.arange(window, dtype=np.int64)[None, :]
+
+
+def train_val_test_split(
+    n_chunks: int, val_size: float = 0.1, test_size: float = 0.1
+) -> Tuple[Sequence[int], Sequence[int], Sequence[int]]:
+    """Contiguous chunk-level split (sql_pytorch_dataloader.py:299-320).
+
+    ``val`` and ``test`` each get ``int(frac * n) + 1`` chunks, matching the
+    reference's arithmetic; slices clamp at the end of the chunk list.
+    """
+    assert (val_size + test_size) < 1, "val_size + test_size must be < 1"
+    assert val_size >= 0 and test_size >= 0, "negative split size"
+    train_size = 1 - val_size - test_size
+    train_end = int(train_size * n_chunks)
+    val_end = train_end + int(val_size * n_chunks) + 1
+    test_end = val_end + int(test_size * n_chunks) + 1
+    chunks = range(n_chunks)
+    return chunks[:train_end], chunks[train_end:val_end], chunks[val_end:test_end]

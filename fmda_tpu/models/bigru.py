@@ -119,7 +119,16 @@ class BiGRU(nn.Module):
             for d in range(n_dirs):
                 reverse = d == 1
                 weights = self._direction_weights(layer, reverse, in_dim)
-                h0 = state.hidden[layer, d] if state is not None else None
+                # Params live in float32; compute in cfg.dtype (bf16 on TPU
+                # keeps the MXU fed without touching the stored params).
+                weights = GRUWeights(
+                    *(w.astype(compute_dtype) for w in weights)
+                )
+                h0 = (
+                    state.hidden[layer, d].astype(compute_dtype)
+                    if state is not None
+                    else None
+                )
                 h_last, hs = gru_layer(
                     layer_input,
                     weights,

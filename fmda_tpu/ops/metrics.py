@@ -10,11 +10,14 @@ never stalls on metric computation.  Semantics match sklearn's:
 - ``fbeta_score``      == sklearn.metrics.fbeta_score(average=None),
   with the 0/0 -> 0 convention
 - ``multilabel_confusion`` == sklearn.metrics.multilabel_confusion_matrix
+
+All functions accept an optional ``example_mask`` (B,) so zero-padded rows
+of fixed-shape TPU batches don't contribute.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,46 +28,77 @@ def threshold_predictions(logits: jax.Array, threshold: float = 0.5) -> jax.Arra
     return jax.nn.sigmoid(logits) > threshold
 
 
-def subset_accuracy(pred: jax.Array, target: jax.Array) -> jax.Array:
-    """Exact-match ratio over the batch."""
-    pred = pred.astype(jnp.bool_)
-    target = target.astype(jnp.bool_)
-    return jnp.mean(jnp.all(pred == target, axis=-1).astype(jnp.float32))
+def _example_weights(
+    n: int, example_mask: Optional[jax.Array]
+) -> jax.Array:
+    if example_mask is None:
+        return jnp.ones((n,), jnp.float32)
+    return example_mask.astype(jnp.float32)
 
 
-def hamming_loss(pred: jax.Array, target: jax.Array) -> jax.Array:
-    """Fraction of wrong labels."""
+def subset_accuracy(
+    pred: jax.Array,
+    target: jax.Array,
+    example_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Exact-match ratio over (valid) examples."""
     pred = pred.astype(jnp.bool_)
     target = target.astype(jnp.bool_)
-    return jnp.mean((pred != target).astype(jnp.float32))
+    w = _example_weights(pred.shape[0], example_mask)
+    correct = jnp.all(pred == target, axis=-1).astype(jnp.float32)
+    return jnp.sum(correct * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def hamming_loss(
+    pred: jax.Array,
+    target: jax.Array,
+    example_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Fraction of wrong labels over (valid) examples."""
+    pred = pred.astype(jnp.bool_)
+    target = target.astype(jnp.bool_)
+    w = _example_weights(pred.shape[0], example_mask)
+    wrong = jnp.mean((pred != target).astype(jnp.float32), axis=-1)
+    return jnp.sum(wrong * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 def _safe_div(num: jax.Array, den: jax.Array) -> jax.Array:
     return jnp.where(den > 0, num / jnp.where(den > 0, den, 1.0), 0.0)
 
 
-def fbeta_score(pred: jax.Array, target: jax.Array, beta: float = 0.5) -> jax.Array:
-    """Per-class F-beta over the batch; shape (n_classes,)."""
+def _counts(pred, target, example_mask):
     pred = pred.astype(jnp.float32)
     target = target.astype(jnp.float32)
-    tp = jnp.sum(pred * target, axis=0)
-    fp = jnp.sum(pred * (1.0 - target), axis=0)
-    fn = jnp.sum((1.0 - pred) * target, axis=0)
+    w = _example_weights(pred.shape[0], example_mask)[:, None]
+    tp = jnp.sum(w * pred * target, axis=0)
+    fp = jnp.sum(w * pred * (1.0 - target), axis=0)
+    fn = jnp.sum(w * (1.0 - pred) * target, axis=0)
+    tn = jnp.sum(w * (1.0 - pred) * (1.0 - target), axis=0)
+    return tp, fp, fn, tn
+
+
+def fbeta_score(
+    pred: jax.Array,
+    target: jax.Array,
+    beta: float = 0.5,
+    example_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Per-class F-beta over the batch; shape (n_classes,)."""
+    tp, fp, fn, _ = _counts(pred, target, example_mask)
     precision = _safe_div(tp, tp + fp)
     recall = _safe_div(tp, tp + fn)
     b2 = beta * beta
     return _safe_div((1.0 + b2) * precision * recall, b2 * precision + recall)
 
 
-def multilabel_confusion(pred: jax.Array, target: jax.Array) -> jax.Array:
+def multilabel_confusion(
+    pred: jax.Array,
+    target: jax.Array,
+    example_mask: Optional[jax.Array] = None,
+) -> jax.Array:
     """Per-class 2x2 confusion matrices, shape (n_classes, 2, 2) of int32,
     laid out [[tn, fp], [fn, tp]] like sklearn."""
-    pred = pred.astype(jnp.float32)
-    target = target.astype(jnp.float32)
-    tp = jnp.sum(pred * target, axis=0)
-    fp = jnp.sum(pred * (1.0 - target), axis=0)
-    fn = jnp.sum((1.0 - pred) * target, axis=0)
-    tn = jnp.sum((1.0 - pred) * (1.0 - target), axis=0)
+    tp, fp, fn, tn = _counts(pred, target, example_mask)
     return jnp.stack(
         [jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2
     ).astype(jnp.int32)
@@ -83,12 +117,13 @@ def multilabel_metrics(
     *,
     threshold: float = 0.5,
     beta: float = 0.5,
+    example_mask: Optional[jax.Array] = None,
 ) -> MultilabelMetrics:
     """All batch metrics in one fused pass (train/eval step helper)."""
     pred = threshold_predictions(logits, threshold)
     return MultilabelMetrics(
-        accuracy=subset_accuracy(pred, target),
-        hamming=hamming_loss(pred, target),
-        fbeta=fbeta_score(pred, target, beta),
-        confusion=multilabel_confusion(pred, target),
+        accuracy=subset_accuracy(pred, target, example_mask),
+        hamming=hamming_loss(pred, target, example_mask),
+        fbeta=fbeta_score(pred, target, beta, example_mask),
+        confusion=multilabel_confusion(pred, target, example_mask),
     )

@@ -1,0 +1,13 @@
+from fmda_tpu.train.losses import class_weights, weighted_bce_with_logits
+from fmda_tpu.train.trainer import EpochMetrics, Trainer, TrainState
+from fmda_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+
+__all__ = [
+    "class_weights",
+    "weighted_bce_with_logits",
+    "Trainer",
+    "TrainState",
+    "EpochMetrics",
+    "save_checkpoint",
+    "restore_checkpoint",
+]

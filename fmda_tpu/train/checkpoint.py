@@ -1,0 +1,75 @@
+"""Checkpointing: params + optimizer + step + normalization stats.
+
+The reference persists only ``model_params.pt`` (notebook cell 39) and a
+separate ``norm_params`` pickle (sql_pytorch_dataloader.py:147-153), with no
+optimizer state and no mid-training resume.  Here the whole training state
+is one Orbax checkpoint tree, so resume is exact and serving loads the norm
+stats from the same artifact.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from fmda_tpu.data.normalize import NormParams
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(
+    directory: str,
+    state: Any,
+    norm_params: Optional[NormParams] = None,
+    *,
+    step: Optional[int] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Save a full training checkpoint; returns the checkpoint path."""
+    directory = os.path.abspath(directory)
+    step = int(state.step) if step is None else step
+    path = os.path.join(directory, f"step_{step:08d}")
+    tree = {
+        "params": jax.device_get(state.params),
+        "opt_state": jax.device_get(state.opt_state),
+        "step": np.asarray(step, np.int64),
+    }
+    if norm_params is not None:
+        tree["norm"] = {
+            "x_min": np.asarray(norm_params.x_min),
+            "x_max": np.asarray(norm_params.x_max),
+        }
+    if extra:
+        tree["extra"] = extra
+    _checkpointer().save(path, tree, force=True)
+    return path
+
+
+def restore_checkpoint(path: str) -> Tuple[Dict[str, Any], Optional[NormParams]]:
+    """Restore a checkpoint tree; returns (tree, norm_params-or-None)."""
+    tree = _checkpointer().restore(os.path.abspath(path))
+    norm = None
+    if "norm" in tree and tree["norm"] is not None:
+        norm = NormParams(
+            np.asarray(tree["norm"]["x_min"], np.float32),
+            np.asarray(tree["norm"]["x_max"], np.float32),
+        )
+    return tree, norm
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    """Most recent step_* checkpoint path under a directory."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = sorted(
+        d for d in os.listdir(directory) if d.startswith("step_")
+    )
+    return os.path.join(directory, steps[-1]) if steps else None

@@ -1,0 +1,54 @@
+"""Losses and class-imbalance weighting.
+
+The reference trains with ``BCEWithLogitsLoss(weight=[N/n_c],
+pos_weight=[(N-n_c)/n_c])`` (training notebook cells 13-16, 29).  The same
+math here, as a pure jnp function with optional padded-example masking
+(fixed-shape batches on TPU pad the tail; padded rows must not contribute).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def class_weights(label_counts: np.ndarray, n_examples: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-class (weight, pos_weight) from positive-label counts.
+
+    weight_c = N / n_c;  pos_weight_c = (N - n_c) / n_c  (notebook cells 13-16).
+    """
+    counts = np.asarray(label_counts, np.float64)
+    weight = n_examples / counts
+    pos_weight = (n_examples - counts) / counts
+    return weight.astype(np.float32), pos_weight.astype(np.float32)
+
+
+def weighted_bce_with_logits(
+    logits: jax.Array,
+    targets: jax.Array,
+    *,
+    weight: Optional[jax.Array] = None,
+    pos_weight: Optional[jax.Array] = None,
+    example_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mean weighted binary cross-entropy on logits (torch semantics).
+
+    ``l = -w * [ pw * y * log(sigmoid(x)) + (1-y) * log(1 - sigmoid(x)) ]``
+    reduced by mean over all (valid) elements; numerically stable via
+    log-sigmoid.
+    """
+    targets = targets.astype(logits.dtype)
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    pw = pos_weight if pos_weight is not None else 1.0
+    per_elem = -(pw * targets * log_p + (1.0 - targets) * log_not_p)
+    if weight is not None:
+        per_elem = per_elem * weight
+    if example_mask is None:
+        return jnp.mean(per_elem)
+    m = example_mask.astype(per_elem.dtype)[:, None]
+    denom = jnp.maximum(jnp.sum(m) * per_elem.shape[-1], 1.0)
+    return jnp.sum(per_elem * m) / denom

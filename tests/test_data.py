@@ -1,0 +1,138 @@
+"""Data pipeline semantics (ref: sql_pytorch_dataloader.py)."""
+
+import numpy as np
+import pytest
+
+from fmda_tpu.data import (
+    ArraySource,
+    ChunkDataset,
+    WindowBatches,
+    chunk_ranges,
+    chunk_norm_params,
+    load_norm_params,
+    normalize,
+    save_norm_params,
+    train_val_test_split,
+    window_index_matrix,
+)
+
+
+def test_chunk_ranges_reference_arithmetic():
+    # db_length=500, chunk=100, window=30 (1-based ids)
+    ranges = chunk_ranges(500, 100, 30)
+    assert len(ranges) == 6
+    assert ranges[0] == range(30, 100)
+    assert ranges[1] == range(100 - 30 + 1, 200)
+    assert ranges[4] == range(400 - 30 + 1, 500)
+    assert ranges[5] == range(500 - 30 + 1, 501)  # final chunk inclusive
+
+
+def test_chunk_ranges_short_source():
+    # shorter than one chunk: single chunk covering everything
+    assert chunk_ranges(80, 100, 30) == [range(30, 81)]
+    with pytest.raises(ValueError, match="window"):
+        chunk_ranges(20, 100, 30)
+
+
+def test_window_index_matrix():
+    m = window_index_matrix(5, 2)
+    np.testing.assert_array_equal(m, [[0, 1], [1, 2], [2, 3], [3, 4]])
+    assert window_index_matrix(3, 5).shape == (0, 5)
+
+
+def test_split_docstring_example():
+    # 16 chunks, val=test=0.1 -> 12 / 2 / 2 (sql_pytorch_dataloader.py:256-261)
+    train, val, test = train_val_test_split(16, 0.1, 0.1)
+    assert (len(train), len(val), len(test)) == (12, 2, 2)
+    assert list(train)[-1] + 1 == list(val)[0]
+    assert list(val)[-1] + 1 == list(test)[0]
+
+
+def test_split_validation():
+    with pytest.raises(AssertionError):
+        train_val_test_split(10, 0.6, 0.5)
+    with pytest.raises(AssertionError):
+        train_val_test_split(10, -0.1, 0.1)
+
+
+def test_norm_params_jitter_guard():
+    fields = ("a", "b", "c")
+    x = np.array([[1.0, 5.0, 0.0], [1.0, 6.0, 0.0]])
+    p = chunk_norm_params(x, fields)
+    # constant non-zero column: max += max * 0.001
+    assert p.x_max[0] == pytest.approx(1.001)
+    # varying column untouched
+    assert p.x_max[1] == 6.0
+    # constant zero column: max = 0.001
+    assert p.x_max[2] == pytest.approx(0.001)
+    z = normalize(x, p)
+    assert np.isfinite(z).all()
+
+
+def test_norm_params_book_sharing():
+    fields = ("bid_0_size", "bid_1_size", "ask_0_size", "ask_1_size", "other")
+    x = np.array(
+        [[10.0, 100.0, 7.0, 70.0, 1.0], [20.0, 200.0, 9.0, 90.0, 2.0]]
+    )
+    p = chunk_norm_params(x, fields, bid_levels=2, ask_levels=2)
+    # bid sizes share min(10) / max(200); ask sizes share min(7) / max(90)
+    np.testing.assert_allclose(p.x_min[:2], [10.0, 10.0])
+    np.testing.assert_allclose(p.x_max[:2], [200.0, 200.0])
+    np.testing.assert_allclose(p.x_min[2:4], [7.0, 7.0])
+    np.testing.assert_allclose(p.x_max[2:4], [90.0, 90.0])
+    # non-book column keeps its own stats
+    assert p.x_min[4] == 1.0 and p.x_max[4] == 2.0
+
+
+def test_norm_params_roundtrip(tmp_path):
+    fields = ("a", "b")
+    p = chunk_norm_params(np.array([[0.0, 2.0], [1.0, 4.0]]), fields)
+    path = str(tmp_path / "norm.json")
+    save_norm_params(path, p, fields)
+    q = load_norm_params(path)
+    np.testing.assert_allclose(q.x_min, p.x_min)
+    np.testing.assert_allclose(q.x_max, p.x_max)
+
+
+def _toy_source(n=250, f=6, classes=4, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, f)).astype(np.float32)
+    y = (r.uniform(size=(n, classes)) > 0.7).astype(np.float32)
+    fields = tuple(f"f{i}" for i in range(f))
+    return ArraySource(x, y, fields)
+
+
+def test_window_batches_shapes_and_targets():
+    src = _toy_source(n=250)
+    ds = ChunkDataset(src, chunk_size=100, window=10)
+    ids, _ = ds[1]
+    wb = WindowBatches(ds, 1, batch_size=16)
+    n_windows = len(list(ids)) - 10 + 1
+    batches = list(wb)
+    assert sum(int(b.mask.sum()) for b in batches) == n_windows
+    for b in batches:
+        assert b.x.shape == (16, 10, 6)
+        assert b.y.shape == (16, 4)
+    # target of first window = target of last row of that window
+    first = batches[0]
+    window_last_id = list(ids)[9]  # 10th row of the chunk
+    np.testing.assert_allclose(
+        first.y[0], src.fetch_targets([window_last_id])[0]
+    )
+
+
+def test_window_batches_use_chunk_norm():
+    src = _toy_source()
+    ds = ChunkDataset(src, chunk_size=100, window=10)
+    wb = WindowBatches(ds, 0, batch_size=8)
+    b = next(iter(wb))
+    assert b.x.min() >= -1e-6 and b.x.max() <= 1.0 + 1e-6
+
+
+def test_array_source_id_bounds():
+    src = _toy_source(n=10)
+    with pytest.raises(IndexError):
+        src.fetch([0])  # ids are 1-based
+    with pytest.raises(IndexError):
+        src.fetch([11])
+    assert src.fetch([1, 10]).shape == (2, 6)

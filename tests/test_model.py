@@ -130,27 +130,51 @@ def test_bidirectional_state_carry_rejected():
 
 
 def test_spatial_dropout_zeroes_whole_channels():
+    """The model's own input dropout must drop entire feature channels
+    across time (torch Dropout2d semantics, biGRU_model.py:87-94)."""
     cfg = ModelConfig(hidden_size=4, n_features=6, output_size=4,
                       dropout=0.5, spatial_dropout=True)
     model = BiGRU(cfg)
     x = jnp.ones((2, 7, 6))
     variables = model.init({"params": jax.random.PRNGKey(0)}, x)
 
-    # Peek at the dropout behavior through the intermediate: apply only the
-    # dropout by monkey-layering — simplest is to check determinism flag off
-    # produces either fully-zero or fully-scaled channels on the input side.
-    # We verify via the Dropout module directly with the same broadcast dims.
-    import flax.linen as nn
-
-    drop = nn.Dropout(0.5, broadcast_dims=(1,))
-    y = drop.apply({}, x, deterministic=False,
-                   rngs={"dropout": jax.random.PRNGKey(3)})
-    y = np.asarray(y)
+    # Capture the model's post-dropout intermediate by running with
+    # capture_intermediates and inspecting the Dropout submodule output.
+    _, intermediates = model.apply(
+        variables, x, deterministic=False,
+        rngs={"dropout": jax.random.PRNGKey(3)},
+        capture_intermediates=lambda mdl, _: type(mdl).__name__ == "Dropout",
+    )
+    inter = intermediates["intermediates"]
+    drop_key = next(k for k in inter if k.startswith("Dropout"))
+    y = np.asarray(inter[drop_key]["__call__"][0])
+    assert y.shape == (2, 7, 6)
+    dropped = 0
     # each (batch, channel) column is either all zero or all 2.0 across time
     for b in range(2):
         for f in range(6):
             col = y[b, :, f]
             assert np.all(col == 0.0) or np.allclose(col, 2.0)
+            dropped += int(np.all(col == 0.0))
+    assert 0 < dropped < 12  # rate 0.5 should drop some but not all
+
+
+def test_bfloat16_compute_dtype():
+    cfg = ModelConfig(hidden_size=8, n_features=5, output_size=4,
+                      dropout=0.0, dtype="bfloat16")
+    model = BiGRU(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 6, 5))
+    variables = model.init({"params": jax.random.PRNGKey(1)}, x)
+    logits = model.apply(variables, x)
+    assert logits.dtype == jnp.float32  # head casts back
+    # params stayed float32
+    assert variables["params"]["weight_ih_l0"].dtype == jnp.float32
+    # close to the float32 computation
+    cfg32 = ModelConfig(hidden_size=8, n_features=5, output_size=4,
+                        dropout=0.0, dtype="float32")
+    logits32 = BiGRU(cfg32).apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits32), atol=0.1)
 
 
 def test_mask_changes_pools_only_for_padded_steps():
