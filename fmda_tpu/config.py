@@ -256,17 +256,46 @@ class FeatureConfig:
         """Windowed-indicator columns (the reference's SQL views), in the
         order the reference's ``join_statement`` concatenates them
         (create_database.py:240-241: BB, vol_MA, price_MA, delta_MA, stoch,
-        ATR, price_change)."""
+        ATR, price_change).
+
+        Every OHLC-derived view requires the volume feed; with
+        ``get_stock_volume`` disabled only the book-derived ``delta_MA``
+        survives (the reference would simply crash building its views
+        without the OHLCV columns — here the schema narrows instead).
+        """
+        has_ohlc = bool(self.get_stock_volume)
         cols = []
-        if self.bollinger_period and self.bollinger_std:
+        if has_ohlc and self.bollinger_period and self.bollinger_std:
             cols += ["upper_BB_dist", "lower_BB_dist"]
-        cols += [f"vol_MA{p}" for p in self.volume_ma_periods]
-        cols += [f"price_MA{p}" for p in self.price_ma_periods]
+        if has_ohlc:
+            cols += [f"vol_MA{p}" for p in self.volume_ma_periods]
+            cols += [f"price_MA{p}" for p in self.price_ma_periods]
         cols += [f"delta_MA{p}" for p in self.delta_ma_periods]
-        if self.stochastic_oscillator:
+        if has_ohlc and self.stochastic_oscillator:
             cols += ["stoch"]
-        cols += ["ATR", "price_change"]
+        if has_ohlc:
+            cols += ["ATR", "price_change"]
         return tuple(cols)
+
+    @property
+    def max_lookback(self) -> int:
+        """Longest trailing frame any derived view needs (rows)."""
+        frames = [2]  # LAG(close, 1) needs 2 rows
+        if self.get_stock_volume:
+            if self.bollinger_period and self.bollinger_std:
+                frames.append(self.bollinger_period)
+            frames.extend(self.volume_ma_periods)
+            frames.extend(self.price_ma_periods)
+            if self.stochastic_oscillator:
+                frames.append(self.stoch_preceding + 1)
+            frames.append(self.atr_preceding + 1)
+        frames.extend(self.delta_ma_periods)
+        return max(frames)
+
+    @property
+    def max_lead(self) -> int:
+        """Longest LEAD the target view uses (rows)."""
+        return max(self.target_lead1, self.target_lead2)
 
     def x_fields(self) -> Tuple[str, ...]:
         """The model's input-feature schema: table columns followed by derived

@@ -1,0 +1,12 @@
+from fmda_tpu.stream.bus import Consumer, InProcessBus, MessageBus, Record
+from fmda_tpu.stream.warehouse import Warehouse
+from fmda_tpu.stream.engine import StreamEngine
+
+__all__ = [
+    "Record",
+    "Consumer",
+    "MessageBus",
+    "InProcessBus",
+    "Warehouse",
+    "StreamEngine",
+]

@@ -1,0 +1,140 @@
+"""Message bus: the framework-owned transport replacing Kafka.
+
+The reference moves every feed through external Kafka brokers (7 topics,
+config.py:15; producers at producer.py:103 and in each scraper pipeline;
+consumers in spark_consumer.py and predict.py).  Here the data plane is a
+framework-owned bus with Kafka-compatible *semantics* — append-only topics,
+monotonically increasing offsets, independent consumer positions, seek — but
+no external processes:
+
+- :class:`InProcessBus` — thread-safe Python ring buffers (default);
+- the native C++ ring-buffer backend (``fmda_tpu.stream.native_bus``)
+  exposes the same interface for cross-process use;
+- an optional adapter to real Kafka brokers can wrap ``kafka-python`` when
+  that package is installed (gated import, parity deployments only).
+
+Values are JSON-serialisable dicts, matching the reference's
+``json.dumps(...).encode('utf-8')`` value serializer.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Protocol, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Record:
+    """One message on a topic."""
+
+    topic: str
+    offset: int
+    value: dict
+
+
+class Consumer:
+    """A positioned reader of one topic (Kafka-consumer analog)."""
+
+    def __init__(self, bus: "MessageBus", topic: str, offset: int = 0) -> None:
+        self._bus = bus
+        self.topic = topic
+        self.offset = offset
+
+    def poll(self, max_records: Optional[int] = None) -> List[Record]:
+        records = self._bus.read(self.topic, self.offset, max_records)
+        if records:
+            self.offset = records[-1].offset + 1
+        return records
+
+    def seek(self, offset: int) -> None:
+        self.offset = offset
+
+    def seek_to_end(self) -> None:
+        """Skip everything already published (predict.py:30 parity)."""
+        self.offset = self._bus.end_offset(self.topic)
+
+
+class MessageBus(Protocol):
+    """Topic transport contract shared by all backends."""
+
+    def publish(self, topic: str, value: dict) -> int:
+        """Append a message; returns its offset."""
+        ...
+
+    def read(
+        self, topic: str, offset: int, max_records: Optional[int] = None
+    ) -> List[Record]:
+        """Read records with offsets >= ``offset`` (bounded by retention)."""
+        ...
+
+    def end_offset(self, topic: str) -> int:
+        """Offset one past the last published record."""
+        ...
+
+    def topics(self) -> Sequence[str]:
+        ...
+
+    def consumer(self, topic: str, *, from_end: bool = False) -> Consumer:
+        ...
+
+
+class InProcessBus:
+    """Thread-safe in-process bus with per-topic ring retention."""
+
+    def __init__(
+        self, topics: Iterable[str], capacity: int = 1 << 16
+    ) -> None:
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._logs: Dict[str, List[Record]] = {t: [] for t in topics}
+        self._base: Dict[str, int] = {t: 0 for t in self._logs}
+        self._next: Dict[str, int] = {t: 0 for t in self._logs}
+
+    def _check_topic(self, topic: str) -> None:
+        if topic not in self._logs:
+            raise KeyError(
+                f"unknown topic {topic!r}; configured: {sorted(self._logs)}"
+            )
+
+    def publish(self, topic: str, value: dict) -> int:
+        # round-trip through JSON to enforce serialisability (and decouple
+        # the stored value from caller-side mutation), like a real broker
+        value = json.loads(json.dumps(value))
+        with self._lock:
+            self._check_topic(topic)
+            offset = self._next[topic]
+            self._next[topic] = offset + 1
+            log = self._logs[topic]
+            log.append(Record(topic, offset, value))
+            if len(log) > self._capacity:  # retention: drop oldest
+                drop = len(log) - self._capacity
+                del log[:drop]
+                self._base[topic] += drop
+            return offset
+
+    def read(
+        self, topic: str, offset: int, max_records: Optional[int] = None
+    ) -> List[Record]:
+        with self._lock:
+            self._check_topic(topic)
+            base = self._base[topic]
+            start = max(offset - base, 0)
+            log = self._logs[topic]
+            stop = len(log) if max_records is None else start + max_records
+            return log[start:stop]
+
+    def end_offset(self, topic: str) -> int:
+        with self._lock:
+            self._check_topic(topic)
+            return self._next[topic]
+
+    def topics(self) -> Sequence[str]:
+        return tuple(self._logs)
+
+    def consumer(self, topic: str, *, from_end: bool = False) -> Consumer:
+        c = Consumer(self, topic)
+        if from_end:
+            c.seek_to_end()
+        return c

@@ -1,0 +1,354 @@
+"""Streaming feature engine: the framework-owned Spark replacement.
+
+Consumes the five feed topics from the bus, aligns heterogeneous timestamps,
+computes microstructure/candle features, interval-joins the feeds, lands
+joined rows in the warehouse, and emits a ``predict_timestamp`` signal per
+row — the whole role of the reference's ``spark_consumer.py`` (506 lines +
+JVM + external Spark/Kafka processes) as one deterministic, testable,
+host-side micro-batch engine.
+
+Semantics preserved from the reference:
+
+- timestamps floored to 5-minute buckets (spark_consumer.py:111/181/231/263/315);
+- inner interval join: a side-stream row matches a book row iff their floors
+  are equal AND the side timestamp lies within ``[deep_ts, deep_ts + 3min]``
+  (spark_consumer.py:434-477);
+- 5-minute watermark bounds state: a book row with no match is *dropped*
+  once every enabled stream's watermark has passed its join horizon;
+- missing values become 0 (fillna, spark_consumer.py:311/480);
+- exactly one output row per book tick (the reference's ``dropDuplicates``
+  intent, spark_consumer.py:477) — the earliest match per stream is used;
+- the signal topic carries the joined row's timestamp and is checkpointed
+  via consumer offsets (spark_consumer.py:490-502).
+
+Deviation (deliberate): the race the reference papers over with
+``sleep(15)`` in serving (predict.py:141-157) cannot happen here — the
+signal is emitted strictly *after* the warehouse insert commits.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fmda_tpu.config import (
+    COT_GROUPS,
+    COT_VALUES,
+    EVENT_VALUES,
+    FeatureConfig,
+    TOPIC_COT,
+    TOPIC_DEEP,
+    TOPIC_IND,
+    TOPIC_PREDICT_TIMESTAMP,
+    TOPIC_VIX,
+    TOPIC_VOLUME,
+)
+from fmda_tpu.ops.microstructure import deep_features, wick_percentage
+from fmda_tpu.stream.bus import MessageBus
+from fmda_tpu.stream.warehouse import Warehouse
+from fmda_tpu.utils.timeutils import floor_epoch, parse_ts, to_epoch
+
+log = logging.getLogger("fmda_tpu.stream")
+
+
+@dataclass
+class _Event:
+    ts: int  # epoch seconds
+    ts_str: str
+    payload: Dict[str, float]
+
+
+@dataclass
+class _StreamBuffer:
+    """Per-feed buffer with watermark tracking."""
+
+    name: str
+    events: List[_Event] = field(default_factory=list)
+    max_ts: int = -1
+
+    def add(self, event: _Event) -> None:
+        self.events.append(event)
+        self.max_ts = max(self.max_ts, event.ts)
+
+    def watermark(self, delay_s: int) -> int:
+        return self.max_ts - delay_s if self.max_ts >= 0 else -1
+
+    def evict_before(self, ts: int) -> None:
+        self.events = [e for e in self.events if e.ts >= ts]
+
+    def match(self, deep_ts: int, floor_s: int, tolerance_s: int) -> Optional[_Event]:
+        """Earliest event with equal floor and ts in [deep_ts, deep_ts+tol]."""
+        target_floor = floor_epoch(deep_ts, floor_s)
+        best: Optional[_Event] = None
+        for e in self.events:
+            if floor_epoch(e.ts, floor_s) != target_floor:
+                continue
+            if not (deep_ts <= e.ts <= deep_ts + tolerance_s):
+                continue
+            if best is None or e.ts < best.ts:
+                best = e
+        return best
+
+
+def _parse_deep(value: dict, bid_levels: int, ask_levels: int) -> _Event:
+    """Flatten a DEEP book message (producer reshape, getMarketData.py:117-127;
+    Spark schema spark_consumer.py:281-308).  Missing levels -> 0."""
+    ts_str = value["Timestamp"]
+    bids = np.zeros((1, bid_levels))
+    bid_sizes = np.zeros((1, bid_levels))
+    asks = np.zeros((1, ask_levels))
+    ask_sizes = np.zeros((1, ask_levels))
+    for i in range(bid_levels):
+        lvl = value.get(f"bids_{i}") or {}
+        bids[0, i] = lvl.get(f"bid_{i}") or 0.0
+        bid_sizes[0, i] = lvl.get(f"bid_{i}_size") or 0.0
+    for i in range(ask_levels):
+        lvl = value.get(f"asks_{i}") or {}
+        asks[0, i] = lvl.get(f"ask_{i}") or 0.0
+        ask_sizes[0, i] = lvl.get(f"ask_{i}_size") or 0.0
+    feats = deep_features(
+        bids, bid_sizes, asks, ask_sizes, [parse_ts(ts_str)]
+    )
+    payload = {k: float(v[0]) for k, v in feats.items()}
+    return _Event(to_epoch(ts_str), ts_str, payload)
+
+
+def _parse_vix(value: dict) -> _Event:
+    ts_str = value["Timestamp"]
+    return _Event(to_epoch(ts_str), ts_str, {"VIX": float(value.get("VIX") or 0.0)})
+
+
+def _parse_volume(value: dict) -> _Event:
+    """OHLCV bar + wick percentage (spark_consumer.py:186-193)."""
+    ts_str = value["Timestamp"]
+    payload = {
+        k: float(value.get(k) or 0.0)
+        for k in ("1_open", "2_high", "3_low", "4_close", "5_volume")
+    }
+    payload["wick_prct"] = float(
+        wick_percentage(
+            [payload["1_open"]],
+            [payload["2_high"]],
+            [payload["3_low"]],
+            [payload["4_close"]],
+        )[0]
+    )
+    return _Event(to_epoch(ts_str), ts_str, payload)
+
+
+def _parse_cot(value: dict) -> _Event:
+    """Flatten nested COT groups (spark_consumer.py:200-225)."""
+    ts_str = value["Timestamp"]
+    payload: Dict[str, float] = {}
+    for group in COT_GROUPS:
+        nested = value.get(group) or {}
+        for v in COT_VALUES:
+            key = f"{group}_{v}"
+            payload[key] = float(nested.get(key) or 0.0)
+    return _Event(to_epoch(ts_str), ts_str, payload)
+
+
+def _parse_ind(value: dict, events: Tuple[str, ...]) -> _Event:
+    """Flatten the indicator template message (spark_consumer.py:239-259)."""
+    ts_str = value["Timestamp"]
+    payload: Dict[str, float] = {}
+    for event in events:
+        nested = value.get(event) or {}
+        for ev_val in EVENT_VALUES:
+            payload[f"{event}_{ev_val}"] = float(nested.get(ev_val) or 0.0)
+    return _Event(to_epoch(ts_str), ts_str, payload)
+
+
+class StreamEngine:
+    """Micro-batch join engine over the bus feeds."""
+
+    def __init__(
+        self,
+        bus: MessageBus,
+        warehouse: Warehouse,
+        features: FeatureConfig,
+        *,
+        signal_topic: str = TOPIC_PREDICT_TIMESTAMP,
+        checkpoint_path: Optional[str] = None,
+        from_end: bool = False,
+    ) -> None:
+        self.bus = bus
+        self.warehouse = warehouse
+        self.features = features
+        self.signal_topic = signal_topic
+        self.checkpoint_path = checkpoint_path
+
+        self._side_streams: Dict[str, _StreamBuffer] = {}
+        self._consumers = {}
+        self._consumers[TOPIC_DEEP] = bus.consumer(TOPIC_DEEP, from_end=from_end)
+        if features.get_vix:
+            self._side_streams[TOPIC_VIX] = _StreamBuffer(TOPIC_VIX)
+            self._consumers[TOPIC_VIX] = bus.consumer(TOPIC_VIX, from_end=from_end)
+        if features.get_stock_volume:
+            self._side_streams[TOPIC_VOLUME] = _StreamBuffer(TOPIC_VOLUME)
+            self._consumers[TOPIC_VOLUME] = bus.consumer(TOPIC_VOLUME, from_end=from_end)
+        if features.get_cot:
+            self._side_streams[TOPIC_COT] = _StreamBuffer(TOPIC_COT)
+            self._consumers[TOPIC_COT] = bus.consumer(TOPIC_COT, from_end=from_end)
+        self._side_streams[TOPIC_IND] = _StreamBuffer(TOPIC_IND)
+        self._consumers[TOPIC_IND] = bus.consumer(TOPIC_IND, from_end=from_end)
+
+        self._pending_deep: List[_Event] = []
+        self._emitted = 0
+        self._dropped = 0
+        if checkpoint_path and os.path.exists(checkpoint_path):
+            self.restore()
+
+    # -- parsing -------------------------------------------------------------
+
+    def _ingest(self) -> None:
+        fc = self.features
+        for rec in self._consumers[TOPIC_DEEP].poll():
+            try:
+                self._pending_deep.append(
+                    _parse_deep(rec.value, fc.bid_levels, fc.ask_levels)
+                )
+            except (KeyError, ValueError, TypeError) as e:
+                log.warning("bad deep message at offset %d: %s", rec.offset, e)
+        parsers = {
+            TOPIC_VIX: _parse_vix,
+            TOPIC_VOLUME: _parse_volume,
+            TOPIC_COT: _parse_cot,
+            TOPIC_IND: lambda v: _parse_ind(v, fc.event_list_repl),
+        }
+        for topic, buf in self._side_streams.items():
+            for rec in self._consumers[topic].poll():
+                try:
+                    buf.add(parsers[topic](rec.value))
+                except (KeyError, ValueError, TypeError) as e:
+                    log.warning(
+                        "bad %s message at offset %d: %s", topic, rec.offset, e
+                    )
+
+    # -- join ----------------------------------------------------------------
+
+    def step(self) -> int:
+        """One micro-batch: poll, join what's ready, land + signal.
+
+        Returns the number of rows emitted this step.
+        """
+        fc = self.features
+        self._ingest()
+        emitted_rows: List[Dict[str, float]] = []
+        still_pending: List[_Event] = []
+
+        for deep_ev in sorted(self._pending_deep, key=lambda e: e.ts):
+            matches: Dict[str, _Event] = {}
+            expired = False  # some stream can provably never match
+            waiting = False  # some stream might still deliver a match
+            for topic, buf in self._side_streams.items():
+                m = buf.match(deep_ev.ts, fc.floor_s, fc.join_tolerance_s)
+                if m is not None:
+                    matches[topic] = m
+                elif buf.watermark(fc.watermark_s) > deep_ev.ts + fc.join_tolerance_s:
+                    expired = True
+                else:
+                    waiting = True
+            if expired:
+                # inner join: one unmatched stream past its horizon kills the row
+                self._dropped += 1
+                log.warning(
+                    "dropping unjoinable book row at %s (no side match within "
+                    "tolerance)", deep_ev.ts_str,
+                )
+            elif waiting:
+                still_pending.append(deep_ev)
+            else:  # all side streams matched
+                row: Dict[str, float] = {"Timestamp": deep_ev.ts_str}
+                row.update(deep_ev.payload)
+                for m in matches.values():
+                    row.update(m.payload)
+                emitted_rows.append(row)
+
+        self._pending_deep = still_pending
+
+        if emitted_rows:
+            self.warehouse.insert_rows(emitted_rows)
+            # signal AFTER the write commits: no sleep-and-retry race
+            for row in emitted_rows:
+                self.bus.publish(self.signal_topic, {"Timestamp": row["Timestamp"]})
+            self._emitted += len(emitted_rows)
+
+        # bound buffer state by the global watermark
+        horizon = min(
+            (b.watermark(fc.watermark_s) for b in self._side_streams.values()),
+            default=-1,
+        )
+        if horizon > 0:
+            for buf in self._side_streams.values():
+                buf.evict_before(horizon - fc.join_tolerance_s)
+
+        if self.checkpoint_path:
+            self.checkpoint()
+        return len(emitted_rows)
+
+    # -- observability -------------------------------------------------------
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return {
+            "emitted": self._emitted,
+            "dropped": self._dropped,
+            "pending": len(self._pending_deep),
+        }
+
+    # -- checkpoint / resume -------------------------------------------------
+
+    def checkpoint(self) -> None:
+        """Persist the engine's durable state: consumer offsets *plus* all
+        polled-but-unjoined events (pending book rows and side-stream
+        buffers).  Offsets alone — the reference's Spark checkpoint story
+        (spark_consumer.py:500) — would silently lose any row still waiting
+        for a join match across a restart."""
+
+        def dump_event(e: _Event) -> dict:
+            return {"ts": e.ts, "ts_str": e.ts_str, "payload": e.payload}
+
+        state = {
+            "offsets": {t: c.offset for t, c in self._consumers.items()},
+            "emitted": self._emitted,
+            "dropped": self._dropped,
+            "pending_deep": [dump_event(e) for e in self._pending_deep],
+            "buffers": {
+                t: {
+                    "max_ts": b.max_ts,
+                    "events": [dump_event(e) for e in b.events],
+                }
+                for t, b in self._side_streams.items()
+            },
+        }
+        tmp = f"{self.checkpoint_path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(state, fh)
+        os.replace(tmp, self.checkpoint_path)
+
+    def restore(self) -> None:
+        with open(self.checkpoint_path) as fh:
+            state = json.load(fh)
+
+        def load_event(d: dict) -> _Event:
+            return _Event(d["ts"], d["ts_str"], d["payload"])
+
+        for topic, offset in state["offsets"].items():
+            if topic in self._consumers:
+                self._consumers[topic].seek(offset)
+        self._emitted = state.get("emitted", 0)
+        self._dropped = state.get("dropped", 0)
+        self._pending_deep = [
+            load_event(d) for d in state.get("pending_deep", [])
+        ]
+        for topic, dump in state.get("buffers", {}).items():
+            if topic in self._side_streams:
+                buf = self._side_streams[topic]
+                buf.events = [load_event(d) for d in dump["events"]]
+                buf.max_ts = dump["max_ts"]

@@ -1,0 +1,214 @@
+"""Feature warehouse: embedded columnar store + derived-feature views.
+
+Replaces the reference's MariaDB layer (create_database.py): the joined
+feature table is an embedded SQLite database whose DDL is *generated from
+the feature config* — the reference's load-bearing config→schema property
+(create_database.py:29-70) — and the derived-feature "views" (MAs,
+Bollinger, stochastic, ATR, price change, targets; create_database.py:76-190)
+are computed by the vectorized kernels in :mod:`fmda_tpu.ops.indicators`
+instead of SQL window functions, with results cached until new rows land.
+
+The warehouse implements the :class:`~fmda_tpu.data.source.FeatureSource`
+protocol, so the trainer and the serving layer read it directly — the
+equivalent of the reference's ``join_statement`` query path
+(create_database.py:240-258 → sql_pytorch_dataloader / predict.py).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fmda_tpu.config import FeatureConfig, TARGET_COLUMNS, WarehouseConfig
+from fmda_tpu.ops.indicators import build_targets, derived_features
+
+
+def _quote(col: str) -> str:
+    return f'"{col}"'
+
+
+class Warehouse:
+    """SQLite-backed joined feature table + in-memory derived views."""
+
+    def __init__(
+        self,
+        features: FeatureConfig,
+        config: Optional[WarehouseConfig] = None,
+    ) -> None:
+        self.features = features
+        self.config = config or WarehouseConfig()
+        if self.config.backend != "sqlite":
+            raise NotImplementedError(
+                f"backend {self.config.backend!r}; the embedded backend is "
+                "'sqlite' (a MariaDB adapter can wrap the same interface)"
+            )
+        self.table = self.config.table_name
+        self._columns: Tuple[str, ...] = self.features.table_columns()
+        self._conn = sqlite3.connect(self.config.path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._create_table()
+        # Incrementally-maintained caches: the raw table matrix plus the
+        # derived views/targets, extended (not recomputed) as rows land.
+        self._cache_rows = 0
+        self._matrix = np.empty((0, len(self._columns)), np.float64)
+        self._derived: Dict[str, np.ndarray] = {
+            c: np.empty(0, np.float64) for c in self.features.derived_columns()
+        }
+        self._targets = np.empty((0, len(TARGET_COLUMNS)), np.float64)
+
+    # -- DDL (config -> schema codegen) -------------------------------------
+
+    def _create_table(self) -> None:
+        cols = ", ".join(f"{_quote(c)} REAL" for c in self._columns)
+        ddl = (
+            f"CREATE TABLE IF NOT EXISTS {self.table} "
+            f"(ID INTEGER PRIMARY KEY AUTOINCREMENT, Timestamp TEXT, {cols})"
+        )
+        with self._lock:
+            self._conn.execute(ddl)
+            self._conn.commit()
+
+    # -- writes --------------------------------------------------------------
+
+    def insert_rows(self, rows: Sequence[Dict[str, float]]) -> int:
+        """Append joined feature rows; unknown keys rejected, missing keys
+        stored as 0 (the engine's fillna(0), spark_consumer.py:480).
+        Each row dict must carry 'Timestamp'."""
+        if not rows:
+            return 0
+        placeholders = ", ".join(["?"] * (1 + len(self._columns)))
+        col_list = "Timestamp, " + ", ".join(_quote(c) for c in self._columns)
+        values = []
+        for row in rows:
+            unknown = set(row) - set(self._columns) - {"Timestamp"}
+            if unknown:
+                raise KeyError(f"unknown feature columns: {sorted(unknown)}")
+            values.append(
+                [row.get("Timestamp")]
+                + [float(row.get(c, 0.0) or 0.0) for c in self._columns]
+            )
+        with self._lock:
+            self._conn.executemany(
+                f"INSERT INTO {self.table} ({col_list}) VALUES ({placeholders})",
+                values,
+            )
+            self._conn.commit()
+        return len(values)
+
+    # -- raw reads -----------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            (n,) = self._conn.execute(
+                f"SELECT COUNT(ID) FROM {self.table}"
+            ).fetchone()
+        return int(n)
+
+    def timestamps(self) -> List[str]:
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT Timestamp FROM {self.table} ORDER BY ID"
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    def id_for_timestamp(self, ts: str) -> Optional[int]:
+        """Row id of a timestamp (predict.py:144 lookup path)."""
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT ID FROM {self.table} WHERE Timestamp = ? "
+                "ORDER BY ID DESC LIMIT 1",
+                (ts,),
+            ).fetchone()
+        return None if row is None else int(row[0])
+
+    def _fetch_rows_after(self, row_id: int) -> np.ndarray:
+        cols = ", ".join(_quote(c) for c in self._columns)
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT {cols} FROM {self.table} WHERE ID > ? ORDER BY ID",
+                (row_id,),
+            ).fetchall()
+        return np.asarray(rows, np.float64).reshape(len(rows), len(self._columns))
+
+    # -- derived views -------------------------------------------------------
+
+    def _refresh_derived(self) -> None:
+        """Extend the derived-view caches to cover newly landed rows.
+
+        Incremental: only the tail is recomputed.  Trailing-window views for
+        a row need at most ``max_lookback-1`` context rows before it; target
+        labels of the last ``max_lead`` cached rows can still change as LEAD
+        rows arrive, so the recompute region starts there.  Results are
+        bit-identical to a full recompute (verified in tests) at O(new+const)
+        per refresh instead of O(total).
+        """
+        n = len(self)
+        old_n = self._cache_rows
+        if n == old_n:
+            return
+        if n < old_n:  # table replaced/truncated externally: full rebuild
+            old_n = 0
+            self._matrix = self._matrix[:0]
+        new_rows = self._fetch_rows_after(old_n)
+        self._matrix = np.concatenate([self._matrix, new_rows])
+
+        fc = self.features
+        recompute_start = max(0, old_n - fc.max_lead)
+        context_start = max(0, recompute_start - (fc.max_lookback - 1))
+        sl = slice(context_start, n)
+        table = {c: self._matrix[sl, i] for i, c in enumerate(self._columns)}
+        derived = derived_features(table, fc)
+        offset = recompute_start - context_start
+        for c in self.features.derived_columns():
+            self._derived[c] = np.concatenate(
+                [self._derived[c][:recompute_start], derived[c][offset:]]
+            )
+        if self._has_ohlc():
+            targets = build_targets(table, fc)
+            self._targets = np.concatenate(
+                [self._targets[:recompute_start], targets[offset:]]
+            )
+        self._cache_rows = n
+
+    def _has_ohlc(self) -> bool:
+        return {"2_high", "3_low", "4_close"} <= set(self._columns)
+
+    # -- FeatureSource protocol ----------------------------------------------
+
+    @property
+    def x_fields(self) -> Tuple[str, ...]:
+        """Joined column set — table columns then derived views, the
+        reference join_statement order (create_database.py:240-241)."""
+        return self._columns + self.features.derived_columns()
+
+    def fetch(self, ids: Sequence[int]) -> np.ndarray:
+        """Feature rows (1-based ids) with NaN->0 (IFNULL parity,
+        sql_pytorch_dataloader.py:219)."""
+        self._refresh_derived()
+        idx = np.asarray(list(ids), np.int64) - 1
+        n = self._cache_rows
+        if idx.size and (idx.min() < 0 or idx.max() >= n):
+            raise IndexError(f"row ids out of range 1..{n}")
+        derived_cols = self.features.derived_columns()
+        out = np.empty((len(idx), len(self.x_fields)), np.float64)
+        out[:, : len(self._columns)] = self._matrix[idx]
+        for j, c in enumerate(derived_cols):
+            out[:, len(self._columns) + j] = self._derived[c][idx]
+        return np.nan_to_num(out, nan=0.0).astype(np.float32)
+
+    def fetch_targets(self, ids: Sequence[int]) -> np.ndarray:
+        if not self._has_ohlc():
+            raise ValueError(
+                "movement targets need the OHLCV feed: enable "
+                "FeatureConfig.get_stock_volume (the target view derives "
+                "from 4_close/ATR, create_database.py:179-190)"
+            )
+        self._refresh_derived()
+        idx = np.asarray(list(ids), np.int64) - 1
+        return np.asarray(self._targets[idx], np.float32)
+
+    def close(self) -> None:
+        self._conn.close()

@@ -52,7 +52,10 @@ def test_schema_reshapes_with_config():
     no_vix = dataclasses.replace(base, get_vix=False)
     assert no_vix.n_features == base.n_features - 1
     no_vol = dataclasses.replace(base, get_stock_volume=None)
-    assert no_vol.n_features == base.n_features - 6
+    # volume off removes the 6 OHLCV table columns AND all 8 OHLC-derived
+    # views (BB x2, vol_MA x2, price_MA, stoch, ATR, price_change)
+    assert no_vol.n_features == base.n_features - 6 - 8
+    assert no_vol.derived_columns() == ("delta_MA12",)
     no_cot = dataclasses.replace(base, get_cot=False)
     assert no_cot.n_features == base.n_features - 12
 

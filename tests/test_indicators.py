@@ -1,0 +1,132 @@
+"""Windowed indicators vs brute-force SQL-semantics oracles
+(create_database.py:76-190 is the spec)."""
+
+import numpy as np
+import pytest
+
+from fmda_tpu.config import FeatureConfig
+from fmda_tpu.ops.indicators import (
+    average_true_range,
+    bollinger_bands,
+    build_targets,
+    derived_features,
+    lag,
+    lead,
+    movement_targets,
+    price_change,
+    rolling_mean,
+    rolling_std,
+    stochastic_oscillator,
+)
+
+
+def _sql_frame(x, i, rows):
+    """SQL 'rows-1 PRECEDING AND CURRENT ROW' frame at row i."""
+    return x[max(0, i - rows + 1): i + 1]
+
+
+@pytest.fixture
+def series(rng):
+    return rng.uniform(100, 110, size=40)
+
+
+def test_rolling_mean_partial_frames(series):
+    out = rolling_mean(series, 6)
+    for i in range(len(series)):
+        assert out[i] == pytest.approx(np.mean(_sql_frame(series, i, 6)))
+
+
+def test_rolling_std_population(series):
+    out = rolling_std(series, 20)
+    for i in range(len(series)):
+        frame = _sql_frame(series, i, 20)
+        # MySQL STD() is population stddev
+        assert out[i] == pytest.approx(np.std(frame), abs=1e-9)
+
+
+def test_lag_lead():
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_array_equal(lag(x, 1)[1:], [1.0, 2.0, 3.0])
+    assert np.isnan(lag(x, 1)[0])
+    np.testing.assert_array_equal(lead(x, 2)[:2], [3.0, 4.0])
+    assert np.isnan(lead(x, 2)[2:]).all()
+
+
+def test_bollinger_hand():
+    close = np.array([10.0, 12.0, 11.0])
+    out = bollinger_bands(close, period=2, n_std=2.0)
+    # row 2: frame [12, 11]; avg 11.5, pop std 0.5
+    assert out["upper_BB_dist"][2] == pytest.approx((11.5 + 2 * 0.5) - 11.0)
+    assert out["lower_BB_dist"][2] == pytest.approx(11.0 - (11.5 - 2 * 0.5))
+
+
+def test_stochastic_15_row_frame(series):
+    out = stochastic_oscillator(series, preceding=14)
+    for i in range(len(series)):
+        frame = _sql_frame(series, i, 15)  # 14 PRECEDING == 15 rows
+        lo, hi = frame.min(), frame.max()
+        expected = (series[i] - lo) / (hi - lo) if hi != lo else np.nan
+        if np.isnan(expected):
+            assert np.isnan(out[i])
+        else:
+            assert out[i] == pytest.approx(expected)
+    assert ((out >= 0) & (out <= 1))[~np.isnan(out)].all()
+
+
+def test_price_change():
+    close = np.array([10.0, 12.0, 9.0])
+    out = price_change(close)
+    assert np.isnan(out[0])
+    np.testing.assert_allclose(out[1:], [2.0, -3.0])
+
+
+def test_atr_15_row_frame(series):
+    high = series + 1.0
+    low = series - 0.5
+    out = average_true_range(high, low, preceding=14)
+    for i in range(len(series)):
+        frame_h = _sql_frame(high, i, 15)
+        frame_l = _sql_frame(low, i, 15)
+        assert out[i] == pytest.approx(np.mean(frame_h - frame_l))
+
+
+def test_movement_targets_hand():
+    # close path engineered so specific labels fire
+    close = np.zeros(20)
+    close[:] = 100.0
+    close[10] = 120.0   # strong up move visible from row 2 (lead 8)
+    atr = np.full(20, 2.0)
+    t = movement_targets(close, atr, n1=1.5, n2=3.0, lead1=8, lead2=15)
+    assert t.shape == (20, 4)
+    # row 2: lead8 -> close[10]=120 >= 100 + 3 -> up1
+    assert t[2, 0] == 1.0
+    # row 2: lead15 -> close[17]=100 < 106 -> up2=0
+    assert t[2, 1] == 0.0
+    # last 8 rows: lead past edge -> 0 labels for up1/down1
+    assert t[-8:, 0].sum() == 0 and t[-8:, 2].sum() == 0
+
+
+def test_movement_targets_down():
+    close = np.full(20, 100.0)
+    close[12] = 80.0
+    atr = np.full(20, 2.0)
+    t = movement_targets(close, atr)
+    # row 4: lead8 -> close[12]=80 <= 100 - 3 -> down1
+    assert t[4, 2] == 1.0 and t[4, 0] == 0.0
+
+
+def test_derived_features_schema(rng):
+    cfg = FeatureConfig()
+    n = 50
+    table = {
+        "4_close": rng.uniform(100, 110, n),
+        "2_high": rng.uniform(110, 112, n),
+        "3_low": rng.uniform(95, 99, n),
+        "5_volume": rng.integers(1000, 5000, n).astype(float),
+        "delta": rng.normal(size=n),
+    }
+    out = derived_features(table, cfg)
+    assert set(out) == set(cfg.derived_columns())
+    y = build_targets(table, cfg)
+    assert y.shape == (n, 4)
+    assert set(np.unique(y)).issubset({0.0, 1.0})

@@ -1,0 +1,106 @@
+"""Microstructure kernels vs hand-computed values (the Spark column
+expressions at spark_consumer.py:186-432 are the spec)."""
+
+import numpy as np
+
+from fmda_tpu.config import FeatureConfig
+from fmda_tpu.ops.microstructure import (
+    calendar_features,
+    deep_features,
+    delta,
+    micro_price,
+    rebase_levels,
+    spread,
+    volume_imbalance,
+    weighted_average_distance,
+    wick_percentage,
+)
+from fmda_tpu.utils.timeutils import parse_ts
+
+
+def test_weighted_average_distance_hand():
+    prices = np.array([[100.0, 99.0, 98.0]])
+    sizes = np.array([[10.0, 20.0, 30.0]])
+    # ((100-100)*10 + (100-99)*20 + (100-98)*30) / 60 = (0+20+60)/60
+    out = weighted_average_distance(prices, sizes)
+    assert out[0] == (20 + 60) / 60
+
+
+def test_weighted_average_zero_book():
+    out = weighted_average_distance(np.zeros((2, 3)), np.zeros((2, 3)))
+    np.testing.assert_array_equal(out, [0.0, 0.0])
+
+
+def test_volume_imbalance_and_delta():
+    bid_sizes = np.array([[500.0, 100.0], [0.0, 0.0]])
+    ask_sizes = np.array([[300.0, 50.0], [0.0, 0.0]])
+    vi = volume_imbalance(bid_sizes, ask_sizes)
+    assert vi[0] == (500 - 300) / (500 + 300)
+    assert vi[1] == 0.0  # 0/0 -> fillna(0)
+    d = delta(bid_sizes, ask_sizes)
+    assert d[0] == (300 + 50) - (500 + 100)
+
+
+def test_micro_price_hand():
+    bids = np.array([[332.28, 332.25]])
+    asks = np.array([[332.33, 332.35]])
+    bid_sizes = np.array([[500.0, 500.0]])
+    ask_sizes = np.array([[300.0, 500.0]])
+    i_t = 500 / 800
+    expected = i_t * 332.33 + (1 - i_t) * 332.28
+    assert micro_price(bids, bid_sizes, asks, ask_sizes)[0] == expected
+    # empty book -> 0
+    assert micro_price(np.zeros((1, 1)), np.zeros((1, 1)),
+                       np.zeros((1, 1)), np.zeros((1, 1)))[0] == 0.0
+
+
+def test_spread_reference_sign():
+    bids = np.array([[332.28], [0.0]])
+    asks = np.array([[332.33], [332.33]])
+    s = spread(bids, asks)
+    # the reference computes bid_0 - ask_0 (negative for a normal book)
+    assert s[0] == np.float64(332.28) - np.float64(332.33)
+    assert s[1] == 0.0  # unquoted side -> 0
+
+
+def test_rebase_levels():
+    prices = np.array([[100.0, 99.5, 0.0]])
+    out = rebase_levels(prices)
+    np.testing.assert_allclose(out, [[0.5, 0.0]])  # level0 dropped, 0 stays 0
+
+
+def test_wick_percentage():
+    # bullish candle: wick = high - close
+    out = wick_percentage([100.0], [110.0], [95.0], [105.0])
+    assert out[0] == (110 - 105) / (110 - 95)
+    # bearish candle: wick = low - close (negative by the reference formula)
+    out = wick_percentage([105.0], [110.0], [95.0], [100.0])
+    assert out[0] == (95 - 100) / (110 - 95)
+    # flat candle: 0/0 -> 0
+    assert wick_percentage([5.0], [5.0], [5.0], [5.0])[0] == 0.0
+
+
+def test_calendar_features():
+    ts = [parse_ts("2020-02-07 09:26:12"),  # Friday, week 2, session start
+          parse_ts("2020-02-03 12:00:00")]  # Monday
+    out = calendar_features(ts)
+    assert out["day_1"][1] == 1.0 and out["day_1"][0] == 0.0
+    assert out["day_4"][0] == 0.0  # Friday is day 5 -> all four one-hots 0
+    assert out["week_2"][0] == 1.0
+    assert out["session_start"][0] == 1.0
+
+
+def test_deep_features_schema_matches_config():
+    cfg = FeatureConfig()
+    n, bl, al = 3, cfg.bid_levels, cfg.ask_levels
+    r = np.random.default_rng(0)
+    feats = deep_features(
+        bids=r.uniform(99, 100, (n, bl)),
+        bid_sizes=r.integers(1, 100, (n, bl)).astype(float),
+        asks=r.uniform(100, 101, (n, al)),
+        ask_sizes=r.integers(1, 100, (n, al)).astype(float),
+        timestamps=[parse_ts("2020-02-07 10:00:00")] * n,
+    )
+    assert set(feats) == set(cfg.deep_columns())
+    for v in feats.values():
+        assert v.shape == (n,)

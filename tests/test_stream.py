@@ -1,0 +1,348 @@
+"""Streaming core: bus semantics, warehouse, and the replay of a synthetic
+session through the full engine (the golden-file strategy from SURVEY.md §4)."""
+
+import dataclasses
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    FeatureConfig,
+    TOPIC_DEEP,
+    TOPIC_IND,
+    TOPIC_PREDICT_TIMESTAMP,
+    TOPIC_VIX,
+    TOPIC_VOLUME,
+    WarehouseConfig,
+)
+from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
+from fmda_tpu.utils.timeutils import format_ts
+
+
+# ---------------------------------------------------------------- bus
+
+
+def test_bus_offsets_and_consumers():
+    bus = InProcessBus(["a", "b"])
+    assert bus.publish("a", {"x": 1}) == 0
+    assert bus.publish("a", {"x": 2}) == 1
+    c = bus.consumer("a")
+    recs = c.poll()
+    assert [r.value["x"] for r in recs] == [1, 2]
+    assert c.poll() == []  # position advanced
+    bus.publish("a", {"x": 3})
+    assert [r.value["x"] for r in c.poll()] == [3]
+    # independent consumer starts from 0
+    c2 = bus.consumer("a")
+    assert len(c2.poll()) == 3
+    # from_end consumer sees only new messages
+    c3 = bus.consumer("a", from_end=True)
+    assert c3.poll() == []
+    bus.publish("a", {"x": 4})
+    assert [r.value["x"] for r in c3.poll()] == [4]
+
+
+def test_bus_unknown_topic():
+    bus = InProcessBus(["a"])
+    with pytest.raises(KeyError):
+        bus.publish("nope", {})
+
+
+def test_bus_retention_ring():
+    bus = InProcessBus(["a"], capacity=3)
+    for i in range(5):
+        bus.publish("a", {"i": i})
+    recs = bus.read("a", 0)
+    assert [r.value["i"] for r in recs] == [2, 3, 4]  # oldest dropped
+    assert recs[0].offset == 2  # offsets stay monotonic across eviction
+
+
+def test_bus_values_decoupled():
+    bus = InProcessBus(["a"])
+    msg = {"nested": {"v": 1}}
+    bus.publish("a", msg)
+    msg["nested"]["v"] = 999
+    assert bus.read("a", 0)[0].value["nested"]["v"] == 1
+
+
+# ---------------------------------------------------------------- warehouse
+
+
+def _small_features(**kw):
+    base = dict(
+        bid_levels=2,
+        ask_levels=2,
+        event_list=("Core CPI",),
+        volume_ma_periods=(3,),
+        price_ma_periods=(3,),
+        delta_ma_periods=(2,),
+        bollinger_period=3,
+        stoch_preceding=2,
+        atr_preceding=2,
+        target_lead1=2,
+        target_lead2=3,
+    )
+    base.update(kw)
+    return FeatureConfig(**base)
+
+
+def test_warehouse_schema_codegen():
+    fc = _small_features()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    assert wh.x_fields == fc.x_fields()
+    assert len(wh) == 0
+
+
+def test_warehouse_insert_fetch_roundtrip():
+    fc = _small_features()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    rows = []
+    for i in range(12):
+        row = {c: float(i) for c in fc.table_columns()}
+        row["Timestamp"] = f"2020-02-07 09:{30+i:02d}:00"
+        row["4_close"] = 100.0 + i
+        row["2_high"] = 101.0 + i
+        row["3_low"] = 99.0 + i
+        rows.append(row)
+    wh.insert_rows(rows)
+    assert len(wh) == 12
+    x = wh.fetch(range(1, 13))
+    assert x.shape == (12, len(wh.x_fields))
+    assert np.isfinite(x).all()  # IFNULL(…,0) parity: no NaNs escape
+    y = wh.fetch_targets(range(1, 13))
+    assert y.shape == (12, 4)
+    # derived column sanity: price_MA3 at row 3 = mean(close rows 1..3)
+    ma_idx = wh.x_fields.index("price_MA3")
+    assert x[2, ma_idx] == pytest.approx(np.mean([100.0, 101.0, 102.0]))
+    assert wh.id_for_timestamp("2020-02-07 09:31:00") == 2
+    assert wh.id_for_timestamp("1999-01-01 00:00:00") is None
+
+
+def test_warehouse_incremental_derived_matches_full_recompute():
+    """Row-by-row streaming inserts must yield bit-identical derived views
+    and targets to a single bulk insert (the incremental cache path)."""
+    fc = _small_features()
+    rng = np.random.default_rng(7)
+
+    def make_row(i):
+        row = {c: float(rng.uniform()) for c in fc.table_columns()}
+        row["Timestamp"] = f"2020-02-07 {9 + i // 60:02d}:{i % 60:02d}:00"
+        row["4_close"] = 100.0 + float(rng.normal())
+        row["2_high"] = row["4_close"] + 1.0
+        row["3_low"] = row["4_close"] - 1.0
+        row["5_volume"] = float(rng.integers(100, 1000))
+        row["delta"] = float(rng.normal())
+        return row
+
+    rows = [make_row(i) for i in range(40)]
+    bulk = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    bulk.insert_rows(rows)
+    streamed = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    for row in rows:
+        streamed.insert_rows([row])
+        streamed.fetch([len(streamed)])  # force incremental refresh each tick
+    ids = range(1, 41)
+    np.testing.assert_allclose(
+        streamed.fetch(ids), bulk.fetch(ids), atol=1e-12)
+    np.testing.assert_allclose(
+        streamed.fetch_targets(ids), bulk.fetch_targets(ids), atol=0)
+
+
+def test_warehouse_volume_disabled_schema_narrows():
+    fc = _small_features(get_stock_volume=None)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    assert "upper_BB_dist" not in wh.x_fields
+    assert "delta_MA2" in wh.x_fields
+    rows = []
+    for i in range(5):
+        row = {c: float(i) for c in fc.table_columns()}
+        row["Timestamp"] = f"2020-02-07 09:3{i}:00"
+        rows.append(row)
+    wh.insert_rows(rows)
+    x = wh.fetch(range(1, 6))
+    assert x.shape == (5, len(wh.x_fields))
+    with pytest.raises(ValueError, match="get_stock_volume"):
+        wh.fetch_targets([1])
+
+
+def test_warehouse_rejects_unknown_columns():
+    fc = _small_features()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    with pytest.raises(KeyError, match="unknown feature columns"):
+        wh.insert_rows([{"Timestamp": "2020-01-01 00:00:00", "bogus": 1.0}])
+
+
+# ---------------------------------------------------------------- engine replay
+
+
+def _session_messages(n_ticks=6, start="2020-02-07 09:30:00"):
+    """Synthetic recorded session: one deep+volume+vix+ind tick / 5 min."""
+    t0 = dt.datetime.strptime(start, "%Y-%m-%d %H:%M:%S")
+    msgs = []
+    for i in range(n_ticks):
+        ts = format_ts(t0 + dt.timedelta(minutes=5 * i))
+        ts_late = format_ts(t0 + dt.timedelta(minutes=5 * i, seconds=50))
+        deep = {"Timestamp": ts}
+        for lvl in range(2):
+            deep[f"bids_{lvl}"] = {
+                f"bid_{lvl}": 100.0 - 0.1 * lvl + i,
+                f"bid_{lvl}_size": 500 + 10 * lvl,
+            }
+            deep[f"asks_{lvl}"] = {
+                f"ask_{lvl}": 100.2 + 0.1 * lvl + i,
+                f"ask_{lvl}_size": 400 + 10 * lvl,
+            }
+        msgs.append((TOPIC_DEEP, deep))
+        msgs.append((TOPIC_VIX, {"VIX": 16.0 + i, "Timestamp": ts_late}))
+        msgs.append(
+            (
+                TOPIC_VOLUME,
+                {
+                    "1_open": 100.0 + i,
+                    "2_high": 101.0 + i,
+                    "3_low": 99.5 + i,
+                    "4_close": 100.5 + i,
+                    "5_volume": 10000 + i,
+                    "Timestamp": ts_late,
+                },
+            )
+        )
+        ind = {"Timestamp": ts_late, "Core_CPI": {
+            "Actual": 0.2, "Prev_actual_diff": 0.1, "Forc_actual_diff": 0.0}}
+        msgs.append((TOPIC_IND, ind))
+    return msgs
+
+
+def _engine_setup(tmp_path=None, **feature_kw):
+    fc = _small_features(get_cot=False, **feature_kw)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    ckpt = str(tmp_path / "engine.json") if tmp_path else None
+    eng = StreamEngine(bus, wh, fc, checkpoint_path=ckpt)
+    return fc, bus, wh, eng
+
+
+def test_engine_replay_joins_all_ticks():
+    fc, bus, wh, eng = _engine_setup()
+    for topic, msg in _session_messages(6):
+        bus.publish(topic, msg)
+    emitted = eng.step()
+    assert emitted == 6
+    assert len(wh) == 6
+    # signal topic carries one timestamp per row, in order
+    sig = bus.consumer(TOPIC_PREDICT_TIMESTAMP).poll()
+    assert len(sig) == 6
+    assert sig[0].value["Timestamp"] == "2020-02-07 09:30:00"
+    # joined row carries data from every stream
+    x = wh.fetch([1])
+    fields = wh.x_fields
+    assert x[0, fields.index("VIX")] == pytest.approx(16.0)
+    assert x[0, fields.index("4_close")] == pytest.approx(100.5)
+    assert x[0, fields.index("Core_CPI_Actual")] == pytest.approx(0.2)
+    assert x[0, fields.index("bid_0_size")] == pytest.approx(500.0)
+    # microstructure features landed
+    assert x[0, fields.index("vol_imbalance")] == pytest.approx(
+        (500 - 400) / (500 + 400))
+
+
+def test_engine_waits_for_late_stream_then_joins():
+    fc, bus, wh, eng = _engine_setup()
+    msgs = _session_messages(2)
+    # publish everything except the vix of tick 0
+    held_back = None
+    for topic, msg in msgs:
+        if topic == TOPIC_VIX and held_back is None:
+            held_back = (topic, msg)
+            continue
+        bus.publish(topic, msg)
+    eng.step()
+    # tick 0 incomplete -> pending; tick 1 complete -> emitted
+    assert eng.stats["pending"] == 1
+    assert len(wh) == 1
+    bus.publish(*held_back)
+    eng.step()
+    assert len(wh) == 2
+    assert eng.stats["pending"] == 0
+
+
+def test_engine_drops_unjoinable_after_watermark():
+    fc, bus, wh, eng = _engine_setup()
+    msgs = _session_messages(4)
+    # drop tick 0's vix entirely; later vix ticks advance the watermark
+    for topic, msg in msgs:
+        if topic == TOPIC_VIX and msg["Timestamp"].startswith("2020-02-07 09:30"):
+            continue
+        bus.publish(topic, msg)
+    eng.step()
+    # vix watermark = 09:45:50 - 5min = 09:40:50 > 09:33:00 horizon of tick 0
+    assert eng.stats["dropped"] == 1
+    assert len(wh) == 3  # ticks 1..3 joined
+
+
+def test_engine_checkpoint_resume(tmp_path):
+    fc, bus, wh, eng = _engine_setup(tmp_path)
+    for topic, msg in _session_messages(3):
+        bus.publish(topic, msg)
+    eng.step()
+    assert len(wh) == 3
+
+    # a new engine over the same bus + checkpoint must not re-emit old rows
+    eng2 = StreamEngine(
+        bus, wh, fc, checkpoint_path=str(tmp_path / "engine.json")
+    )
+    assert eng2.step() == 0
+    assert len(wh) == 3
+    # new data still flows
+    for topic, msg in _session_messages(1, start="2020-02-07 10:30:00"):
+        bus.publish(topic, msg)
+    assert eng2.step() == 1
+    assert len(wh) == 4
+
+
+def test_engine_checkpoint_preserves_pending_joins(tmp_path):
+    """A restart between poll and join must not lose the pending book row
+    (the durability hole offsets-only checkpoints would have)."""
+    fc, bus, wh, eng = _engine_setup(tmp_path)
+    msgs = _session_messages(1)
+    held_back = None
+    for topic, msg in msgs:
+        if topic == TOPIC_VIX:
+            held_back = (topic, msg)
+            continue
+        bus.publish(topic, msg)
+    eng.step()  # deep row pending (vix missing), offsets past it
+    assert eng.stats["pending"] == 1 and len(wh) == 0
+
+    # "restart": fresh engine restores pending state from the checkpoint
+    eng2 = StreamEngine(
+        bus, wh, fc, checkpoint_path=str(tmp_path / "engine.json")
+    )
+    assert eng2.stats["pending"] == 1
+    bus.publish(*held_back)
+    assert eng2.step() == 1
+    assert len(wh) == 1
+
+
+def test_engine_warehouse_feeds_trainer():
+    """The minimum end-to-end slice: replayed stream -> warehouse -> trainer."""
+    from fmda_tpu.config import ModelConfig, TrainConfig
+    from fmda_tpu.train import Trainer
+
+    fc, bus, wh, eng = _engine_setup()
+    for topic, msg in _session_messages(60):
+        bus.publish(topic, msg)
+    eng.step()
+    assert len(wh) == 60
+
+    model_cfg = ModelConfig(
+        hidden_size=4, n_features=len(wh.x_fields), output_size=4,
+        dropout=0.0, use_pallas=False,
+    )
+    train_cfg = TrainConfig(batch_size=8, window=4, chunk_size=20, epochs=1)
+    trainer = Trainer(model_cfg, train_cfg)
+    state, history, _ = trainer.fit(
+        wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels
+    )
+    assert np.isfinite(history["train"][0].loss)
