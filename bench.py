@@ -1,0 +1,132 @@
+"""Benchmark: biGRU training throughput, TPU (fmda_tpu) vs CPU (torch ref).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "seq/s", "vs_baseline": N}
+
+- value: sequences/second/chip of the full fmda_tpu training step (forward +
+  weighted BCE + backward + global-norm clip + Adam + all four metrics) on
+  the flagship config (108 features, hidden 32, window 30) at batch 256.
+- vs_baseline: ratio against the same training step implemented with torch
+  on CPU — the reference's actual execution mode (its CUDA dispatch never
+  moves the inputs, biGRU_model.py:195-196; BASELINE.md), scaled to the
+  same batch size for fairness.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 256
+WINDOW = 30
+FEATURES = 108
+HIDDEN = 32
+CLASSES = 4
+WARMUP_STEPS = 3
+BENCH_STEPS = 20
+TORCH_STEPS = 5
+
+
+def bench_jax() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.config import ModelConfig, TrainConfig
+    from fmda_tpu.data.pipeline import Batch
+    from fmda_tpu.train.trainer import Trainer
+
+    model_cfg = ModelConfig(
+        hidden_size=HIDDEN, n_features=FEATURES, output_size=CLASSES,
+        dropout=0.5, spatial_dropout=True,
+    )
+    train_cfg = TrainConfig(batch_size=BATCH, window=WINDOW)
+    weight = np.full(CLASSES, 2.0, np.float32)
+    pos_weight = np.full(CLASSES, 3.0, np.float32)
+    trainer = Trainer(model_cfg, train_cfg, weight=weight, pos_weight=pos_weight)
+    state = trainer.init_state(jax.random.PRNGKey(0))
+
+    r = np.random.default_rng(0)
+    batch = Batch(
+        x=jnp.asarray(r.normal(size=(BATCH, WINDOW, FEATURES)).astype(np.float32)),
+        y=jnp.asarray((r.uniform(size=(BATCH, CLASSES)) > 0.7).astype(np.float32)),
+        mask=jnp.ones(BATCH, np.float32),
+    )
+    rng = jax.random.PRNGKey(1)
+
+    for _ in range(WARMUP_STEPS):
+        state, loss, metrics = trainer._train_step(state, batch, rng)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_STEPS):
+        state, loss, metrics = trainer._train_step(state, batch, rng)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+    return BATCH * BENCH_STEPS / elapsed
+
+
+def bench_torch() -> float:
+    """The reference stack's training step (torch CPU), same shapes."""
+    import torch
+
+    torch.manual_seed(0)
+    gru = torch.nn.GRU(FEATURES, HIDDEN, num_layers=1, batch_first=True,
+                       bidirectional=True)
+    linear = torch.nn.Linear(HIDDEN * 3, CLASSES)
+    drop = torch.nn.Dropout2d(0.5)
+    params = list(gru.parameters()) + list(linear.parameters())
+    optimizer = torch.optim.Adam(params, lr=1e-3)
+    loss_fn = torch.nn.BCEWithLogitsLoss(
+        weight=torch.full((CLASSES,), 2.0),
+        pos_weight=torch.full((CLASSES,), 3.0),
+    )
+    x = torch.randn(BATCH, WINDOW, FEATURES)
+    y = (torch.rand(BATCH, CLASSES) > 0.7).float()
+
+    def step():
+        optimizer.zero_grad()
+        xd = drop(x.permute(0, 2, 1)).permute(0, 2, 1)
+        gru_out, hidden = gru(xd)
+        last_hidden = hidden.view(1, 2, BATCH, HIDDEN)[-1].sum(dim=0)
+        summed = gru_out[:, :, :HIDDEN] + gru_out[:, :, HIDDEN:]
+        max_pool = summed.max(dim=1).values
+        avg_pool = summed.sum(dim=1) / WINDOW
+        logits = linear(torch.cat([last_hidden, max_pool, avg_pool], dim=1))
+        loss = loss_fn(logits, y)
+        loss.backward()
+        torch.nn.utils.clip_grad_norm_(params, 50.0)
+        optimizer.step()
+        # the reference computes sklearn metrics per batch on the host
+        # (biGRU_model.py:215-222); charge a threshold pass at least
+        (torch.sigmoid(logits) > 0.5).float().mean().item()
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(TORCH_STEPS):
+        step()
+    elapsed = time.perf_counter() - t0
+    return BATCH * TORCH_STEPS / elapsed
+
+
+def main() -> None:
+    jax_seq_s = bench_jax()
+    torch_seq_s = bench_torch()
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "seq/sec/chip (biGRU train step, "
+                    f"B={BATCH} T={WINDOW} F={FEATURES} H={HIDDEN})"
+                ),
+                "value": round(jax_seq_s, 1),
+                "unit": "seq/s",
+                "vs_baseline": round(jax_seq_s / torch_seq_s, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

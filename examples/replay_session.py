@@ -1,9 +1,12 @@
 """End-to-end demo: replay a recorded session through the full pipeline.
 
-bus -> streaming engine (join + features) -> warehouse -> trainer -> checkpoint.
+bus -> streaming engine (join + features) -> warehouse -> trainer ->
+checkpoint -> real-time predictor -> prediction topic.
 Run: PYTHONPATH=/root/repo:$PYTHONPATH python examples/replay_session.py
 """
 import datetime as dt
+import tempfile
+
 import numpy as np
 
 from fmda_tpu.config import DEFAULT_TOPICS, FeatureConfig, ModelConfig, TrainConfig, WarehouseConfig, TOPIC_DEEP, TOPIC_VIX, TOPIC_VOLUME, TOPIC_IND, TOPIC_COT, TOPIC_PREDICT_TIMESTAMP
@@ -70,6 +73,27 @@ def main():
         wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
     print("train loss:", [round(m.loss, 4) for m in history["train"]])
     print("norm stats features:", dataset.final_norm_params.x_min.shape[0])
+
+    # ---- serving: checkpoint -> predictor -> live ticks ------------------
+    from fmda_tpu.serve import Predictor
+    from fmda_tpu.train import save_checkpoint
+
+    ckpt = save_checkpoint(tempfile.mkdtemp(), state, dataset.final_norm_params)
+    predictor = Predictor.from_checkpoint(
+        ckpt, bus, wh, model_cfg, window=train_cfg.window,
+        from_end=True, max_staleness_s=None,
+    )
+    # stream a fresh hour of ticks through the engine, serving each one
+    served = 0
+    for topic, msg in synth_session(fc, 12, start="2020-02-07 15:00:00"):
+        bus.publish(topic, msg)
+        if topic == TOPIC_COT:  # one full tick published
+            engine.step()
+            served += len(predictor.poll())
+    preds = bus.consumer("prediction").poll()
+    print(f"served {served} live ticks; last prediction: "
+          f"probs={['%.3f' % p for p in preds[-1].value['probabilities']]} "
+          f"labels={preds[-1].value['pred_labels']}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,20 @@
+from fmda_tpu.parallel.mesh import batch_sharding, build_mesh, replicated_sharding
+from fmda_tpu.parallel.collectives import (
+    all_gather,
+    all_reduce_mean,
+    all_reduce_sum,
+    ring_shift,
+)
+from fmda_tpu.parallel.seq_parallel import sp_bigru_layer, sp_gru_scan
+
+__all__ = [
+    "build_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "all_reduce_sum",
+    "all_reduce_mean",
+    "all_gather",
+    "ring_shift",
+    "sp_gru_scan",
+    "sp_bigru_layer",
+]

@@ -1,0 +1,59 @@
+"""Named collective wrappers used inside ``shard_map`` bodies.
+
+The TPU-native replacement for the communication backends the reference's
+ecosystem would reach for (NCCL/MPI — absent in the reference itself,
+SURVEY.md §5): XLA's built-in collectives over ICI/DCN.  These are thin,
+greppable wrappers so call sites say *what* they move, not how.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_sum(x: jax.Array, axis_name: str) -> jax.Array:
+    """Sum across the mesh axis (ICI all-reduce)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def all_reduce_mean(x: jax.Array, axis_name: str) -> jax.Array:
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
+    """Gather shards along ``axis`` from every device on the mesh axis."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+
+
+def ring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Rotate values around the mesh axis ring (ppermute); the neighbor
+    exchange used for the sequence-parallel hidden-state handoff."""
+    n = jax.lax.axis_size(axis_name)
+    perm: List[Tuple[int, int]] = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def shift_right(x: jax.Array, axis_name: str, fill: jax.Array) -> jax.Array:
+    """Send each shard's value to the next device (no wraparound); the
+    first device receives ``fill``.  The boundary-respecting variant of
+    :func:`ring_shift` for non-cyclic scans."""
+    n = jax.lax.axis_size(axis_name)
+    shifted = jax.lax.ppermute(
+        x, axis_name, [(i, i + 1) for i in range(n - 1)]
+    )
+    idx = jax.lax.axis_index(axis_name)
+    return jnp.where(idx == 0, fill, shifted)
+
+
+def shift_left(x: jax.Array, axis_name: str, fill: jax.Array) -> jax.Array:
+    """Send each shard's value to the previous device; the last device
+    receives ``fill``."""
+    n = jax.lax.axis_size(axis_name)
+    shifted = jax.lax.ppermute(
+        x, axis_name, [(i + 1, i) for i in range(n - 1)]
+    )
+    idx = jax.lax.axis_index(axis_name)
+    return jnp.where(idx == n - 1, fill, shifted)

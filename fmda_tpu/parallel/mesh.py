@@ -1,0 +1,70 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference is single-machine (SURVEY.md §2: no DP/TP/PP/SP, no
+NCCL/MPI) — this module is net-new design.  The runtime core follows the
+standard TPU recipe: one :class:`jax.sharding.Mesh` whose axes name the
+parallelism dimensions, ``NamedSharding``/``PartitionSpec`` annotations at
+the jit boundary, and XLA inserting the ICI/DCN collectives.
+
+Axes used by the framework:
+
+- ``dp`` — data parallel: batch dimension sharded, gradients all-reduced
+  over ICI (free from XLA once the batch is sharded);
+- ``sp`` — sequence parallel: the time dimension of long windows sharded;
+  the recurrent carry crosses shard boundaries via neighbor ``ppermute``
+  (see :mod:`fmda_tpu.parallel.seq_parallel`).
+
+Multi-host/multi-slice: build the mesh from ``jax.devices()`` spanning all
+processes (DP over DCN between slices, SP within a slice) — the same code
+path, larger device array.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from fmda_tpu.config import MeshConfig
+
+
+def build_mesh(
+    cfg: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, sp) mesh over the available devices.
+
+    ``cfg.dp == -1`` means "all devices not used by sp".  Devices beyond
+    ``dp*sp`` are left unused (explicitly, never silently wrong).
+    """
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sp = cfg.sp
+    if sp <= 0 or n % sp != 0 and cfg.dp == -1:
+        raise ValueError(f"sp={sp} does not divide device count {n}")
+    dp = (n // sp) if cfg.dp == -1 else cfg.dp
+    needed = dp * sp
+    if needed > n:
+        raise ValueError(f"mesh {dp}x{sp} needs {needed} devices, have {n}")
+    arr = np.asarray(devices[:needed]).reshape(dp, sp)
+    return Mesh(arr, (cfg.dp_axis, cfg.sp_axis))
+
+
+def batch_sharding(mesh: Mesh, dp_axis: str = "dp") -> NamedSharding:
+    """Shard the leading (batch) dimension over dp; everything else
+    replicated."""
+    return NamedSharding(mesh, PartitionSpec(dp_axis))
+
+
+def sequence_sharding(
+    mesh: Mesh, dp_axis: str = "dp", sp_axis: str = "sp"
+) -> NamedSharding:
+    """Shard (batch, time, ...) over (dp, sp)."""
+    return NamedSharding(mesh, PartitionSpec(dp_axis, sp_axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

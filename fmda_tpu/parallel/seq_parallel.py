@@ -1,0 +1,200 @@
+"""Sequence parallelism for the recurrent model (long-context windows).
+
+Ring attention does not apply — the model is a GRU, not attention — so the
+long-context design shards the *time* dimension of the window across the
+``sp`` mesh axis and hands the recurrent carry between neighboring devices
+with ``ppermute`` (SURVEY.md §5 "long-context" / §7 hard part (b)):
+
+- the input projection ``x @ W_ih^T`` — where the FLOPs are — runs fully
+  sharded: each device projects only its (B, T/sp, F) time block on its own
+  MXU;
+- the recurrence is inherently serial across blocks, so the scan runs as
+  ``sp`` pipelined stages: at stage k, device k's block scan is the valid
+  one, and its final carry is ppermuted to device k+1 for stage k+1.  The
+  other devices' stage-k scans are discarded (the classic pipeline bubble;
+  microbatch staggering can fill it later — the projection savings already
+  dominate for wide features);
+- the pooling head reduces locally then crosses the axis with
+  ``pmax``/``psum``, so no device ever materialises the full sequence.
+
+Everything here is written to run inside ``shard_map`` bodies; the
+public entry point :func:`make_sp_forward` wires the shard_map over a
+(dp, sp) mesh and is verified bit-close against the single-device model in
+``tests/test_parallel.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fmda_tpu.config import ModelConfig
+from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection
+from fmda_tpu.parallel.collectives import shift_left, shift_right
+
+
+def sp_gru_scan(
+    xp_local: jax.Array,
+    h0: jax.Array,
+    w_hh: jax.Array,
+    b_hh: jax.Array,
+    axis_name: str,
+    *,
+    reverse: bool = False,
+    vary_axes: Optional[Tuple[str, ...]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Time-sharded GRU recurrence (call inside shard_map).
+
+    Args:
+      xp_local: this device's input-projection block (B, T_local, 3H).
+      h0: global initial hidden state (B, H), replicated.
+      axis_name: the sp mesh axis.
+      reverse: backward-direction scan (stages run right-to-left).
+
+    Returns:
+      (h_last, hs_local): the *global* final hidden state (replicated on
+      every sp device) and this device's per-step hiddens (B, T_local, H).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    # Mark the (replicated) initial carry as varying over the mesh axes the
+    # inputs vary on, so the lax.scan carry type matches the per-device gate
+    # outputs (shard_map's varying-manual-axes typing).
+    h0 = jax.lax.pvary(h0, vary_axes or (axis_name,))
+    carry = h0
+    hs_local = jnp.zeros(xp_local.shape[:2] + (w_hh.shape[-1],), xp_local.dtype)
+    h_final = jnp.zeros_like(h0)
+    for k in range(n):  # static: mesh size is known at trace time
+        stage_dev = (n - 1 - k) if reverse else k
+        h_out, ys = gru_scan(xp_local, carry, w_hh, b_hh, reverse=reverse)
+        take = idx == stage_dev
+        hs_local = jnp.where(take, ys, hs_local)
+        h_final = jnp.where(take, h_out, h_final)
+        if k < n - 1:
+            if reverse:
+                carry = shift_left(h_out, axis_name, fill=h0)
+            else:
+                carry = shift_right(h_out, axis_name, fill=h0)
+
+    # broadcast the true final carry (lives on the last stage's device)
+    last_dev = 0 if reverse else n - 1
+    h_last = jax.lax.psum(
+        jnp.where(idx == last_dev, h_final, jnp.zeros_like(h_final)),
+        axis_name,
+    )
+    return h_last, hs_local
+
+
+def sp_bigru_layer(
+    x_local: jax.Array,
+    weights_fwd: GRUWeights,
+    weights_bwd: Optional[GRUWeights],
+    axis_name: str,
+    vary_axes: Optional[Tuple[str, ...]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One (bi)GRU layer over a time-sharded input block.
+
+    The input projection — the MXU-heavy part — is computed on the local
+    block only; the serial recurrence uses :func:`sp_gru_scan`.
+
+    Returns (last_hidden_sum, gru_out_local): the direction-summed global
+    final hidden (B, H) and the direction-summed local outputs
+    (B, T_local, H) (the reference head's gru_out, biGRU_model.py:119-120).
+    """
+    batch = x_local.shape[0]
+    hidden = weights_fwd.w_hh.shape[-1]
+    h0 = jnp.zeros((batch, hidden), x_local.dtype)
+
+    xp_f = input_projection(x_local, weights_fwd)
+    h_last_f, hs_f = sp_gru_scan(
+        xp_f, h0, weights_fwd.w_hh, weights_fwd.b_hh, axis_name,
+        vary_axes=vary_axes,
+    )
+    if weights_bwd is None:
+        return h_last_f, hs_f
+    xp_b = input_projection(x_local, weights_bwd)
+    h_last_b, hs_b = sp_gru_scan(
+        xp_b, h0, weights_bwd.w_hh, weights_bwd.b_hh, axis_name, reverse=True,
+        vary_axes=vary_axes,
+    )
+    return h_last_f + h_last_b, hs_f + hs_b
+
+
+def _weights_from_params(params: Dict, suffix: str) -> GRUWeights:
+    return GRUWeights(
+        params[f"weight_ih_{suffix}"],
+        params[f"weight_hh_{suffix}"],
+        params[f"bias_ih_{suffix}"],
+        params[f"bias_hh_{suffix}"],
+    )
+
+
+def sp_bigru_apply(
+    params: Dict,
+    x_local: jax.Array,
+    cfg: ModelConfig,
+    axis_name: str,
+    seq_len: int,
+    vary_axes: Optional[Tuple[str, ...]] = None,
+) -> jax.Array:
+    """The flagship single-layer BiGRU forward with the pool-concat head,
+    sequence-sharded (shard_map body).  Matches ``BiGRU.__call__``
+    (deterministic mode) output exactly.
+    """
+    assert cfg.n_layers == 1, "sp forward currently covers the 1-layer flagship"
+    w_f = _weights_from_params(params, "l0")
+    w_b = _weights_from_params(params, "l0_reverse") if cfg.bidirectional else None
+    last_hidden, gru_out_local = sp_bigru_layer(
+        x_local, w_f, w_b, axis_name, vary_axes=vary_axes
+    )
+
+    # Pool head across the sharded time axis: local reduce + collective.
+    # (pmax has no differentiation rule, so the cross-device max goes
+    # through a tiny all_gather of the (B, H) local maxima instead.)
+    local_max = jnp.max(gru_out_local, axis=1)
+    max_pool = jnp.max(
+        jax.lax.all_gather(local_max, axis_name, axis=0), axis=0
+    )
+    sum_pool = jax.lax.psum(jnp.sum(gru_out_local, axis=1), axis_name)
+    avg_pool = sum_pool / jnp.asarray(seq_len, gru_out_local.dtype)
+
+    concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)
+    dense = params["linear"]
+    return concat @ dense["kernel"] + dense["bias"]
+
+
+def make_sp_forward(
+    mesh: Mesh,
+    cfg: ModelConfig,
+    seq_len: int,
+    *,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+):
+    """Jit-ready sequence-parallel forward over a (dp, sp) mesh.
+
+    Input x: (B, T, F) sharded (dp, sp); params replicated; output logits
+    (B, out) sharded over dp only.
+    """
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(dp_axis, sp_axis)),
+        out_specs=P(dp_axis),
+        # the head's psum/all_gather leave the logits replicated over sp,
+        # but the static vma checker can't prove it through jnp.where mixes
+        check_vma=False,
+    )
+    def forward(params, x_local):
+        return sp_bigru_apply(
+            params, x_local, cfg, sp_axis, seq_len,
+            vary_axes=(dp_axis, sp_axis),
+        )
+
+    return forward

@@ -1,0 +1,3 @@
+from fmda_tpu.serve.predictor import Prediction, Predictor
+
+__all__ = ["Prediction", "Predictor"]

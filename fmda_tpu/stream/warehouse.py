@@ -48,7 +48,9 @@ class Warehouse:
         self.table = self.config.table_name
         self._columns: Tuple[str, ...] = self.features.table_columns()
         self._conn = sqlite3.connect(self.config.path, check_same_thread=False)
-        self._lock = threading.Lock()
+        # RLock: guards both the SQL connection and the derived caches;
+        # _refresh_derived re-enters through __len__/_fetch_rows_after.
+        self._lock = threading.RLock()
         self._create_table()
         # Incrementally-maintained caches: the raw table matrix plus the
         # derived views/targets, extended (not recomputed) as rows land.
@@ -144,6 +146,9 @@ class Warehouse:
         rows arrive, so the recompute region starts there.  Results are
         bit-identical to a full recompute (verified in tests) at O(new+const)
         per refresh instead of O(total).
+
+        Caller must hold ``self._lock`` (writers mutate the shared caches;
+        concurrent readers would otherwise observe torn state).
         """
         n = len(self)
         old_n = self._cache_rows
@@ -187,16 +192,17 @@ class Warehouse:
     def fetch(self, ids: Sequence[int]) -> np.ndarray:
         """Feature rows (1-based ids) with NaN->0 (IFNULL parity,
         sql_pytorch_dataloader.py:219)."""
-        self._refresh_derived()
-        idx = np.asarray(list(ids), np.int64) - 1
-        n = self._cache_rows
-        if idx.size and (idx.min() < 0 or idx.max() >= n):
-            raise IndexError(f"row ids out of range 1..{n}")
-        derived_cols = self.features.derived_columns()
-        out = np.empty((len(idx), len(self.x_fields)), np.float64)
-        out[:, : len(self._columns)] = self._matrix[idx]
-        for j, c in enumerate(derived_cols):
-            out[:, len(self._columns) + j] = self._derived[c][idx]
+        with self._lock:
+            self._refresh_derived()
+            idx = np.asarray(list(ids), np.int64) - 1
+            n = self._cache_rows
+            if idx.size and (idx.min() < 0 or idx.max() >= n):
+                raise IndexError(f"row ids out of range 1..{n}")
+            derived_cols = self.features.derived_columns()
+            out = np.empty((len(idx), len(self.x_fields)), np.float64)
+            out[:, : len(self._columns)] = self._matrix[idx]
+            for j, c in enumerate(derived_cols):
+                out[:, len(self._columns) + j] = self._derived[c][idx]
         return np.nan_to_num(out, nan=0.0).astype(np.float32)
 
     def fetch_targets(self, ids: Sequence[int]) -> np.ndarray:
@@ -206,9 +212,10 @@ class Warehouse:
                 "FeatureConfig.get_stock_volume (the target view derives "
                 "from 4_close/ATR, create_database.py:179-190)"
             )
-        self._refresh_derived()
-        idx = np.asarray(list(ids), np.int64) - 1
-        return np.asarray(self._targets[idx], np.float32)
+        with self._lock:
+            self._refresh_derived()
+            idx = np.asarray(list(ids), np.int64) - 1
+            return np.asarray(self._targets[idx], np.float32)
 
     def close(self) -> None:
         self._conn.close()

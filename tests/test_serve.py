@@ -1,0 +1,151 @@
+"""Serving: signal-triggered inference against the live warehouse
+(ref: predict.py event loop)."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import jax
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    ModelConfig,
+    TOPIC_PREDICTION,
+    TOPIC_PREDICT_TIMESTAMP,
+    WarehouseConfig,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.models.bigru import BiGRU
+from fmda_tpu.serve import Predictor
+from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
+
+from test_stream import _session_messages, _small_features
+
+
+def _served_pipeline(n_ticks=8, **pred_kw):
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+
+    model_cfg = ModelConfig(
+        hidden_size=4, n_features=len(wh.x_fields), output_size=4,
+        dropout=0.0, use_pallas=False,
+    )
+    model = BiGRU(model_cfg)
+    import jax.numpy as jnp
+    dummy = jnp.zeros((1, 3, model_cfg.n_features))
+    params = model.init({"params": jax.random.PRNGKey(0)}, dummy)["params"]
+    norm = NormParams(
+        np.zeros(model_cfg.n_features, np.float32),
+        np.ones(model_cfg.n_features, np.float32),
+    )
+    predictor = Predictor(
+        bus, wh, model_cfg, params, norm,
+        window=3, from_end=False, max_staleness_s=None, **pred_kw,
+    )
+    return fc, bus, wh, eng, predictor
+
+
+def test_predictions_flow_end_to_end():
+    fc, bus, wh, eng, predictor = _served_pipeline()
+    for topic, msg in _session_messages(8):
+        bus.publish(topic, msg)
+    eng.step()
+    preds = predictor.poll()
+    # rows 1,2 lack window history; rows 3..8 served
+    assert len(preds) == 6
+    assert preds[0].timestamp == "2020-02-07 09:40:00"
+    for p in preds:
+        assert len(p.probabilities) == 4
+        assert all(0.0 <= q <= 1.0 for q in p.probabilities)
+        assert all(p.probabilities[i] > 0.5 for i in p.label_indices)
+    # predictions republished on the bus (predict.py:197 parity)
+    out = bus.consumer(TOPIC_PREDICTION).poll()
+    assert len(out) == 6
+    assert out[0].value["pred_labels"] == list(preds[0].labels)
+    # idempotent: no new signals -> no new predictions
+    assert predictor.poll() == []
+
+
+def test_stale_signals_dropped():
+    fc, bus, wh, eng, predictor = _served_pipeline()
+    predictor.max_staleness_s = 240
+    predictor.now_fn = lambda: dt.datetime(2020, 2, 7, 10, 30, 0)
+    for topic, msg in _session_messages(8):
+        bus.publish(topic, msg)
+    eng.step()
+    preds = predictor.poll()
+    # only signals within 4 min of "now" (10:30) survive: the 10:05 tick is
+    # 25 min old ... ticks are 09:30..10:05, so all stale
+    assert preds == []
+
+
+def test_default_staleness_clock_is_exchange_local():
+    """The default clock must compare in exchange-local time (predict.py
+    converts utcnow->EST); otherwise every fresh signal looks hours stale."""
+    from fmda_tpu.utils.timeutils import format_ts, get_timezone
+
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    model_cfg = ModelConfig(hidden_size=2, n_features=len(wh.x_fields),
+                            output_size=4, dropout=0.0, use_pallas=False)
+    import jax.numpy as jnp
+    params = BiGRU(model_cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 3, model_cfg.n_features)))["params"]
+    norm = NormParams(np.zeros(model_cfg.n_features, np.float32),
+                      np.ones(model_cfg.n_features, np.float32))
+    # defaults: max_staleness_s=240, exchange-local clock
+    predictor = Predictor(bus, wh, model_cfg, params, norm, window=3)
+    tz = get_timezone("US/Eastern")
+    fresh = format_ts(dt.datetime.now(tz).replace(tzinfo=None))
+    stale = format_ts(
+        dt.datetime.now(tz).replace(tzinfo=None) - dt.timedelta(minutes=10))
+    assert not predictor._is_stale(fresh)
+    assert predictor._is_stale(stale)
+
+
+def test_signal_for_missing_row_skipped():
+    fc, bus, wh, eng, predictor = _served_pipeline()
+    bus.publish(TOPIC_PREDICT_TIMESTAMP, {"Timestamp": "2020-02-07 09:30:00"})
+    assert predictor.poll() == []  # warehouse empty -> warn + skip, no crash
+
+
+def test_from_checkpoint_full_loop(tmp_path):
+    """Train on the warehouse, checkpoint, then serve from that checkpoint —
+    the full train->serve artifact handoff (params + norm in one tree,
+    vs the reference's separate model_params.pt + norm_params pickle)."""
+    from fmda_tpu.config import TrainConfig
+    from fmda_tpu.train import Trainer, save_checkpoint
+
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+    for topic, msg in _session_messages(60):
+        bus.publish(topic, msg)
+    eng.step()
+
+    model_cfg = ModelConfig(hidden_size=4, n_features=len(wh.x_fields),
+                            output_size=4, dropout=0.0, use_pallas=False)
+    train_cfg = TrainConfig(batch_size=8, window=3, chunk_size=20, epochs=1)
+    trainer = Trainer(model_cfg, train_cfg)
+    state, _, dataset = trainer.fit(
+        wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
+    path = save_checkpoint(str(tmp_path / "c"), state, dataset.final_norm_params)
+
+    predictor = Predictor.from_checkpoint(
+        path, bus, wh, model_cfg, window=3, from_end=False,
+        max_staleness_s=None,
+    )
+    preds = predictor.poll()
+    assert len(preds) == 58  # 60 signals, first 2 lack window history
+    assert all(len(p.probabilities) == 4 for p in preds)
+
+    # checkpoint without norm stats must be rejected
+    bare = save_checkpoint(str(tmp_path / "bare"), state, None)
+    with pytest.raises(ValueError, match="normalization"):
+        Predictor.from_checkpoint(bare, bus, wh, model_cfg, window=3)
