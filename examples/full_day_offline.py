@@ -1,0 +1,142 @@
+"""The complete framework loop, fully offline: L1 acquisition (replay
+transports) -> L2 bus -> L3 streaming feature engine -> L4 warehouse ->
+L5 train + serve.  A whole trading day replays in seconds.
+
+Run: PYTHONPATH=/root/repo:$PYTHONPATH python examples/full_day_offline.py
+"""
+
+import datetime as dt
+import json
+
+import numpy as np
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    FeatureConfig,
+    ModelConfig,
+    SessionConfig,
+    TrainConfig,
+    WarehouseConfig,
+)
+from fmda_tpu.ingest import (
+    AlphaVantageClient,
+    COTScraper,
+    EconomicCalendarScraper,
+    IEXClient,
+    ReplayTransport,
+    SessionDriver,
+    TradierCalendarClient,
+    VIXScraper,
+)
+from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
+from fmda_tpu.train import Trainer
+from fmda_tpu.train.trainer import imbalance_weights_from_source
+
+
+class SynthMarketTransport:
+    """A fake exchange: serves evolving API/scraper responses per request."""
+
+    def __init__(self, fc: FeatureConfig, seed: int = 0) -> None:
+        self.fc = fc
+        self.r = np.random.default_rng(seed)
+        self.price = 330.0
+
+    def get(self, url: str, headers=None) -> bytes:
+        if "markets/calendar" in url:
+            return json.dumps({"calendar": {"days": {"day": [
+                {"date": "2020-02-07", "status": "open",
+                 "open": {"start": "09:30", "end": "16:00"},
+                 "premarket": {"start": "04:00", "end": "09:30"},
+                 "postmarket": {"start": "16:00", "end": "20:00"}}]}}}).encode()
+        if "deep/book" in url:
+            self.price += float(self.r.normal(0, 0.3))
+            book = {"bids": [], "asks": []}
+            for lvl in range(self.fc.bid_levels):
+                book["bids"].append({"price": round(self.price - 0.02 * (lvl + 1), 2),
+                                     "size": int(self.r.integers(100, 900))})
+            for lvl in range(self.fc.ask_levels):
+                book["asks"].append({"price": round(self.price + 0.02 * (lvl + 1), 2),
+                                     "size": int(self.r.integers(100, 900))})
+            return json.dumps({"SPY": book}).encode()
+        if "alphavantage" in url:
+            o = self.price + float(self.r.normal(0, 0.1))
+            c = self.price + float(self.r.normal(0, 0.1))
+            ts = self.now.strftime("%Y-%m-%d %H:%M:%S")
+            return json.dumps({"Meta Data": {}, "Time Series (5min)": {ts: {
+                "1. open": f"{o:.2f}", "2. high": f"{max(o, c) + 0.2:.2f}",
+                "3. low": f"{min(o, c) - 0.2:.2f}", "4. close": f"{c:.2f}",
+                "5. volume": str(int(self.r.integers(5000, 50000)))}}}).encode()
+        if "cnbc" in url:
+            return (f'<span class="last original">'
+                    f'{16 + float(self.r.normal(0, 0.5)):.2f}</span>').encode()
+        if "economic-calendar" in url:
+            return b"<html><table></table></html>"  # quiet day
+        if url.endswith("/cot"):
+            return (b'<table><tr><td>S&amp;P 500 STOCK INDEX</td><td></td>'
+                    b'<td><a href="/cot/tff/13874A">v</a></td></tr></table>')
+        if "13874A" in url:
+            return ("<table><tbody>"
+                    "<tr><td><strong>Asset Manager / Institutional</strong></td>"
+                    "<td>304,136<span>10.0</span></td><td>53.6 %</td><td>x</td>"
+                    "<td>100,790<span>-745.0</span></td><td>17.8 %</td></tr>"
+                    "<tr><td><strong>Leveraged Funds</strong></td>"
+                    "<td>57,404<span>1,922.0</span></td><td>10.1 %</td><td>x</td>"
+                    "<td>98,263<span>2,377.0</span></td><td>17.3 %</td></tr>"
+                    "</tbody></table>").encode()
+        raise ValueError(f"unexpected url {url}")
+
+
+def main():
+    fc = FeatureConfig()
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    engine = StreamEngine(bus, wh, fc)
+
+    transport = SynthMarketTransport(fc)
+    clock = {"now": dt.datetime(2020, 2, 7, 9, 30, 0)}
+
+    def now_fn():
+        transport.now = clock["now"]
+        return clock["now"]
+
+    def fast_sleep(s):
+        clock["now"] += dt.timedelta(seconds=s)
+
+    driver = SessionDriver(
+        bus, SessionConfig(freq_s=300),
+        iex=IEXClient("tok", transport),
+        alpha_vantage=AlphaVantageClient("tok", transport),
+        calendar=TradierCalendarClient("tok", transport),
+        indicator_scraper=EconomicCalendarScraper(fc, transport=transport),
+        vix_scraper=VIXScraper(transport),
+        cot_scraper=COTScraper("S&P 500 STOCK INDEX", transport),
+        now_fn=now_fn, sleep_fn=fast_sleep,
+    )
+    ticks = driver.run_session(max_ticks=77)  # 09:30-16:00 at 5 min
+    engine.step()
+    print(f"session ticks: {ticks}; engine: {engine.stats}; "
+          f"warehouse: {len(wh)} rows x {len(wh.x_fields)} features")
+
+    model_cfg = ModelConfig(hidden_size=16, n_features=len(wh.x_fields), output_size=4)
+    train_cfg = TrainConfig(batch_size=16, window=10, chunk_size=30, epochs=2)
+    w, pw = imbalance_weights_from_source(wh)
+    trainer = Trainer(model_cfg, train_cfg, weight=w, pos_weight=pw)
+    state, history, dataset = trainer.fit(
+        wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
+    print("train loss:", [round(m.loss, 4) for m in history["train"]])
+
+    import tempfile
+    from fmda_tpu.serve import Predictor
+    from fmda_tpu.train import save_checkpoint
+
+    ckpt = save_checkpoint(tempfile.mkdtemp(), state, dataset.final_norm_params)
+    predictor = Predictor.from_checkpoint(
+        ckpt, bus, wh, model_cfg, window=train_cfg.window,
+        from_end=False, max_staleness_s=None)
+    preds = predictor.poll()
+    print(f"served {len(preds)} predictions; last: "
+          f"{['%.3f' % p for p in preds[-1].probabilities]} -> {preds[-1].labels}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,11 @@ import tempfile
 
 import numpy as np
 
-from fmda_tpu.config import DEFAULT_TOPICS, FeatureConfig, ModelConfig, TrainConfig, WarehouseConfig, TOPIC_DEEP, TOPIC_VIX, TOPIC_VOLUME, TOPIC_IND, TOPIC_COT, TOPIC_PREDICT_TIMESTAMP
+from fmda_tpu.config import (
+    DEFAULT_TOPICS, FeatureConfig, ModelConfig, TrainConfig, WarehouseConfig,
+    TOPIC_DEEP, TOPIC_VIX, TOPIC_VOLUME, TOPIC_IND, TOPIC_COT,
+    TOPIC_PREDICT_TIMESTAMP, TOPIC_PREDICTION,
+)
 from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
 from fmda_tpu.train import Trainer
 from fmda_tpu.train.trainer import imbalance_weights_from_source
@@ -90,10 +94,13 @@ def main():
         if topic == TOPIC_COT:  # one full tick published
             engine.step()
             served += len(predictor.poll())
-    preds = bus.consumer("prediction").poll()
-    print(f"served {served} live ticks; last prediction: "
-          f"probs={['%.3f' % p for p in preds[-1].value['probabilities']]} "
-          f"labels={preds[-1].value['pred_labels']}")
+    preds = bus.consumer(TOPIC_PREDICTION).poll()
+    if preds:
+        print(f"served {served} live ticks; last prediction: "
+              f"probs={['%.3f' % p for p in preds[-1].value['probabilities']]} "
+              f"labels={preds[-1].value['pred_labels']}")
+    else:
+        print(f"served {served} live ticks; no predictions produced")
 
 
 if __name__ == "__main__":

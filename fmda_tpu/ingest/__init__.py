@@ -1,0 +1,27 @@
+from fmda_tpu.ingest.transport import (
+    RecordingTransport,
+    ReplayTransport,
+    Transport,
+    UrllibTransport,
+)
+from fmda_tpu.ingest.clients import AlphaVantageClient, IEXClient, TradierCalendarClient
+from fmda_tpu.ingest.scrapers import (
+    COTScraper,
+    EconomicCalendarScraper,
+    VIXScraper,
+)
+from fmda_tpu.ingest.session import SessionDriver
+
+__all__ = [
+    "Transport",
+    "UrllibTransport",
+    "ReplayTransport",
+    "RecordingTransport",
+    "IEXClient",
+    "AlphaVantageClient",
+    "TradierCalendarClient",
+    "EconomicCalendarScraper",
+    "VIXScraper",
+    "COTScraper",
+    "SessionDriver",
+]

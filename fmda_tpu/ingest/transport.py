@@ -1,0 +1,87 @@
+"""HTTP transport abstraction with record/replay.
+
+The reference talks to the outside world through ``requests.get`` scattered
+in clients (getMarketData.py:105/188/255) and through live Scrapy crawls —
+none of it testable offline.  Here every network touch goes through a
+:class:`Transport`, so the whole acquisition layer runs against recorded
+fixtures in tests and air-gapped environments (SURVEY.md §4 golden-replay
+strategy).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+from typing import Dict, List, Optional, Protocol, Tuple
+
+log = logging.getLogger("fmda_tpu.ingest")
+
+
+class TransportError(Exception):
+    """Network failure or non-2xx response."""
+
+
+class Transport(Protocol):
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        """Fetch a URL; returns the response body, raises TransportError."""
+        ...
+
+
+class UrllibTransport:
+    """Live stdlib transport (no third-party HTTP dependency)."""
+
+    def __init__(self, timeout_s: float = 20.0, user_agent: str = "fmda-tpu/0.1"):
+        self.timeout_s = timeout_s
+        self.user_agent = user_agent
+
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        import urllib.error
+        import urllib.request
+
+        req_headers = {"User-Agent": self.user_agent}
+        if headers:
+            req_headers.update(headers)
+        request = urllib.request.Request(url, headers=req_headers)
+        try:
+            with urllib.request.urlopen(request, timeout=self.timeout_s) as resp:
+                return resp.read()
+        except urllib.error.URLError as e:  # pragma: no cover - live only
+            raise TransportError(f"GET {url} failed: {e}") from e
+
+
+class ReplayTransport:
+    """Serve responses from recorded (url-pattern -> body) fixtures."""
+
+    def __init__(self, fixtures: Dict[str, bytes]) -> None:
+        #: regex pattern -> body; exact strings work too (re.escape not
+        #: required for urls without regex metacharacters in the match).
+        self.fixtures = {
+            k: (v if isinstance(v, bytes) else str(v).encode()) for k, v in fixtures.items()
+        }
+        self.requests: List[str] = []
+
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        self.requests.append(url)
+        if url in self.fixtures:
+            return self.fixtures[url]
+        for pattern, body in self.fixtures.items():
+            if re.search(pattern, url):
+                return body
+        raise TransportError(f"no fixture for {url}")
+
+
+class RecordingTransport:
+    """Wrap a live transport and persist every response for later replay."""
+
+    def __init__(self, inner: Transport, path: str) -> None:
+        self.inner = inner
+        self.path = path
+        self.recorded: Dict[str, bytes] = {}
+
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        body = self.inner.get(url, headers)
+        self.recorded[url] = body
+        with open(self.path, "w") as fh:
+            json.dump({u: b.decode("utf-8", "replace") for u, b in self.recorded.items()}, fh)
+        return body

@@ -1,20 +1,31 @@
-from fmda_tpu.parallel.mesh import batch_sharding, build_mesh, replicated_sharding
+from fmda_tpu.parallel.mesh import (
+    batch_sharding,
+    build_mesh,
+    replicated_sharding,
+    sequence_sharding,
+)
 from fmda_tpu.parallel.collectives import (
     all_gather,
     all_reduce_mean,
     all_reduce_sum,
     ring_shift,
+    shift_left,
+    shift_right,
 )
-from fmda_tpu.parallel.seq_parallel import sp_bigru_layer, sp_gru_scan
+from fmda_tpu.parallel.seq_parallel import make_sp_forward, sp_bigru_layer, sp_gru_scan
 
 __all__ = [
     "build_mesh",
     "batch_sharding",
     "replicated_sharding",
+    "sequence_sharding",
     "all_reduce_sum",
     "all_reduce_mean",
     "all_gather",
     "ring_shift",
+    "shift_left",
+    "shift_right",
+    "make_sp_forward",
     "sp_gru_scan",
     "sp_bigru_layer",
 ]

@@ -23,9 +23,12 @@ def all_reduce_mean(x: jax.Array, axis_name: str) -> jax.Array:
     return jax.lax.pmean(x, axis_name)
 
 
-def all_gather(x: jax.Array, axis_name: str, axis: int = 0) -> jax.Array:
-    """Gather shards along ``axis`` from every device on the mesh axis."""
-    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=True)
+def all_gather(
+    x: jax.Array, axis_name: str, axis: int = 0, *, tiled: bool = False
+) -> jax.Array:
+    """Gather from every device on the mesh axis: stacked along a new
+    ``axis`` by default, concatenated into the existing one when ``tiled``."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
 def ring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:

@@ -34,7 +34,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fmda_tpu.config import ModelConfig
 from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection
-from fmda_tpu.parallel.collectives import shift_left, shift_right
+from fmda_tpu.parallel.collectives import (
+    all_gather,
+    all_reduce_sum,
+    shift_left,
+    shift_right,
+)
 
 
 def sp_gru_scan(
@@ -83,7 +88,7 @@ def sp_gru_scan(
 
     # broadcast the true final carry (lives on the last stage's device)
     last_dev = 0 if reverse else n - 1
-    h_last = jax.lax.psum(
+    h_last = all_reduce_sum(
         jnp.where(idx == last_dev, h_final, jnp.zeros_like(h_final)),
         axis_name,
     )
@@ -157,10 +162,8 @@ def sp_bigru_apply(
     # (pmax has no differentiation rule, so the cross-device max goes
     # through a tiny all_gather of the (B, H) local maxima instead.)
     local_max = jnp.max(gru_out_local, axis=1)
-    max_pool = jnp.max(
-        jax.lax.all_gather(local_max, axis_name, axis=0), axis=0
-    )
-    sum_pool = jax.lax.psum(jnp.sum(gru_out_local, axis=1), axis_name)
+    max_pool = jnp.max(all_gather(local_max, axis_name, axis=0), axis=0)
+    sum_pool = all_reduce_sum(jnp.sum(gru_out_local, axis=1), axis_name)
     avg_pool = sum_pool / jnp.asarray(seq_len, gru_out_local.dtype)
 
     concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)

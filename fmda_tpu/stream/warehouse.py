@@ -215,6 +215,9 @@ class Warehouse:
         with self._lock:
             self._refresh_derived()
             idx = np.asarray(list(ids), np.int64) - 1
+            n = self._cache_rows
+            if idx.size and (idx.min() < 0 or idx.max() >= n):
+                raise IndexError(f"row ids out of range 1..{n}")
             return np.asarray(self._targets[idx], np.float32)
 
     def close(self) -> None:

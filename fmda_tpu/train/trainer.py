@@ -92,10 +92,9 @@ class Trainer:
             step=jnp.zeros((), jnp.int32),
         )
         if self.mesh is not None:
-            replicated = jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec()
-            )
-            state = jax.device_put(state, replicated)
+            from fmda_tpu.parallel.mesh import replicated_sharding
+
+            state = jax.device_put(state, replicated_sharding(self.mesh))
         return state
 
     # -- compiled steps ------------------------------------------------------
@@ -103,9 +102,9 @@ class Trainer:
     def _batch_sharding(self):
         if self.mesh is None:
             return None
-        return jax.sharding.NamedSharding(
-            self.mesh, jax.sharding.PartitionSpec(self.dp_axis)
-        )
+        from fmda_tpu.parallel.mesh import batch_sharding
+
+        return batch_sharding(self.mesh, self.dp_axis)
 
     def _build_train_step(self):
         model, tc = self.model, self.train_cfg

@@ -1,0 +1,317 @@
+"""Ingestion layer against recorded fixtures (record/replay strategy,
+SURVEY.md §4; behavior specs: getMarketData.py, *_spider.py, producer.py)."""
+
+import datetime as dt
+import json
+
+import pytest
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    FeatureConfig,
+    SessionConfig,
+    TOPIC_DEEP,
+    TOPIC_IND,
+    TOPIC_VIX,
+    TOPIC_VOLUME,
+)
+from fmda_tpu.ingest import (
+    AlphaVantageClient,
+    COTScraper,
+    EconomicCalendarScraper,
+    IEXClient,
+    ReplayTransport,
+    SessionDriver,
+    TradierCalendarClient,
+    VIXScraper,
+)
+from fmda_tpu.ingest.scrapers import SentItemsRegistry
+from fmda_tpu.stream import InProcessBus
+
+NOW = dt.datetime(2020, 2, 7, 9, 30, 0)
+
+
+# ---------------------------------------------------------------- clients
+
+
+def test_iex_deep_book_reshape():
+    payload = {
+        "SPY": {
+            "bids": [{"price": 332.28, "size": 500}, {"price": 332.25, "size": 400}],
+            "asks": [{"price": 332.33, "size": 300}],
+        }
+    }
+    t = ReplayTransport({r"deep/book": json.dumps(payload)})
+    client = IEXClient("tok", t)
+    msg = client.get_deep_book("spy", NOW)
+    assert msg["Timestamp"] == "2020-02-07 09:30:00"
+    assert msg["bids_0"] == {"bid_0": 332.28, "bid_0_size": 500}
+    assert msg["bids_1"] == {"bid_1": 332.25, "bid_1_size": 400}
+    assert msg["asks_0"] == {"ask_0": 332.33, "ask_0_size": 300}
+    assert "token=tok" in t.requests[0]
+
+
+def test_alpha_vantage_latest_bar():
+    series = {
+        "2020-02-07 09:25:00": {
+            "1. open": "333.80", "2. high": "334.00", "3. low": "333.60",
+            "4. close": "333.95", "5. volume": "1061578",
+        },
+        "2020-02-07 09:30:00": {
+            "1. open": "334.02", "2. high": "334.11", "3. low": "333.91",
+            "4. close": "333.96", "5. volume": "90211",
+        },
+    }
+    payload = {"Meta Data": {}, "Time Series (5min)": series}
+    t = ReplayTransport({r"alphavantage": json.dumps(payload)})
+    client = AlphaVantageClient("tok", t)
+    bar = client.get_latest_bar("SPY", NOW)
+    assert bar["1_open"] == 334.02 and bar["5_volume"] == 90211
+    assert bar["Timestamp"] == "2020-02-07 09:30:00"
+
+
+def test_alpha_vantage_delayed_bar_accepted(caplog):
+    series = {"2020-02-07 09:00:00": {"1. open": "1", "2. high": "1",
+                                      "3. low": "1", "4. close": "1",
+                                      "5. volume": "5"}}
+    t = ReplayTransport({r"alphavantage": json.dumps(
+        {"Meta Data": {}, "Time Series (5min)": series})})
+    client = AlphaVantageClient("tok", t)
+    with caplog.at_level("WARNING"):
+        bar = client.get_latest_bar("SPY", NOW)
+    assert bar["5_volume"] == 5  # delayed but accepted
+    assert any("DELAYED" in r.message for r in caplog.records)
+
+
+def test_alpha_vantage_error_message():
+    t = ReplayTransport({r"alphavantage": json.dumps({"Error Message": "bad key"})})
+    with pytest.raises(ValueError, match="bad key"):
+        AlphaVantageClient("tok", t).get_latest_bar("SPY", NOW)
+
+
+def test_tradier_calendar():
+    payload = {"calendar": {"days": {"day": [
+        {"date": "2020-02-07", "status": "open",
+         "open": {"start": "09:30", "end": "16:00"},
+         "premarket": {"start": "04:00", "end": "09:30"},
+         "postmarket": {"start": "16:00", "end": "20:00"}},
+    ]}}}
+    t = ReplayTransport({r"markets/calendar": json.dumps(payload)})
+    days = TradierCalendarClient("tok", t).get_market_calendar()
+    assert days[0]["status"] == "open"
+
+
+# ---------------------------------------------------------------- scrapers
+
+CALENDAR_HTML = """
+<html><body><table>
+<tr id="eventRowId_1" data-event-datetime="2020/02/07 08:30:00">
+  <td><span title="United States"></span></td>
+  <td class="left textNum sentiment noWrap" data-img_key="bull3"></td>
+  <td class="left event"><a> Nonfarm Payrolls </a></td>
+  <td id="eventActual_1">225K</td>
+  <td id="eventPrevious_1"><span>147K</span></td>
+  <td id="eventForecast_1">160K</td>
+</tr>
+<tr id="eventRowId_2" data-event-datetime="2020/02/07 08:30:00">
+  <td><span title="United States"></span></td>
+  <td class="left textNum sentiment noWrap" data-img_key="bull3"></td>
+  <td class="left event"><a>Unemployment Rate </a></td>
+  <td id="eventActual_2">3.6%</td>
+  <td id="eventPrevious_2"><span>3.5%</span></td>
+  <td id="eventForecast_2">&#160;</td>
+</tr>
+<tr id="eventRowId_3" data-event-datetime="2020/02/07 14:00:00">
+  <td><span title="United States"></span></td>
+  <td class="left textNum sentiment noWrap" data-img_key="bull3"></td>
+  <td class="left event"><a>Fed Interest Rate Decision</a></td>
+  <td id="eventActual_3">&#160;</td>
+  <td id="eventPrevious_3"><span>1.75</span></td>
+  <td id="eventForecast_3">1.75</td>
+</tr>
+<tr id="eventRowId_4" data-event-datetime="2020/02/07 08:30:00">
+  <td><span title="Germany"></span></td>
+  <td class="left textNum sentiment noWrap" data-img_key="bull3"></td>
+  <td class="left event"><a>Core CPI (Jan)</a></td>
+  <td id="eventActual_4">0.2</td>
+  <td id="eventPrevious_4"><span>0.1</span></td>
+  <td id="eventForecast_4">0.2</td>
+</tr>
+</table></body></html>
+"""
+
+
+def test_calendar_scraper_filters_and_diffs():
+    fc = FeatureConfig()
+    scraper = EconomicCalendarScraper(
+        fc, transport=ReplayTransport({r"economic-calendar": CALENDAR_HTML}))
+    items = scraper.parse(CALENDAR_HTML, NOW)
+    # row 3 not yet released (future + empty actual); row 4 wrong country
+    assert {i["Event"] for i in items} == {"Nonfarm_Payrolls", "Unemployment_Rate"}
+    nfp = next(i for i in items if i["Event"] == "Nonfarm_Payrolls")
+    assert nfp["Nonfarm_Payrolls"]["Actual"] == 225.0
+    assert nfp["Nonfarm_Payrolls"]["Prev_actual_diff"] == pytest.approx(147 - 225)
+    assert nfp["Nonfarm_Payrolls"]["Forc_actual_diff"] == pytest.approx(160 - 225)
+    ur = next(i for i in items if i["Event"] == "Unemployment_Rate")
+    assert ur["Unemployment_Rate"]["Forc_actual_diff"] is None  # no forecast
+
+
+def test_calendar_scraper_template_merge_and_dedup(tmp_path):
+    fc = FeatureConfig()
+    registry = SentItemsRegistry(str(tmp_path / "items.json"))
+    scraper = EconomicCalendarScraper(
+        fc, transport=ReplayTransport({r"economic-calendar": CALENDAR_HTML}),
+        registry=registry)
+    msg = scraper.scrape(NOW)
+    # merged into the full zero template
+    assert set(msg) == {"Timestamp"} | set(fc.event_list_repl)
+    assert msg["Nonfarm_Payrolls"]["Actual"] == 225.0
+    assert msg["Core_CPI"] == {"Actual": 0, "Prev_actual_diff": 0,
+                               "Forc_actual_diff": 0}  # untouched template
+    # second scrape: items already sent -> all zeros again
+    msg2 = scraper.scrape(NOW)
+    assert msg2["Nonfarm_Payrolls"]["Actual"] == 0
+    # registry persists across instances
+    registry2 = SentItemsRegistry(str(tmp_path / "items.json"))
+    assert not registry2.is_new("2020/02/07 08:30:00", "Nonfarm_Payrolls")
+
+
+VIX_HTML = '<div><span class="last original">16.04</span></div>'
+
+
+def test_vix_scraper():
+    scraper = VIXScraper(ReplayTransport({r"cnbc": VIX_HTML}))
+    msg = scraper.scrape(NOW)
+    assert msg == {"VIX": 16.04, "Timestamp": "2020-02-07 09:30:00"}
+
+
+COT_INDEX_HTML = """
+<table>
+<tr><td>EURO FX</td><td>x</td><td><a href="/cot/legacy/1">view</a></td></tr>
+<tr><td>S&amp;P 500 STOCK INDEX</td><td>x</td><td><a href="/cot/tff/13874A">view</a></td></tr>
+</table>
+"""
+
+COT_REPORT_HTML = """
+<table><tbody>
+<tr><td><strong>Dealer / Intermediary</strong></td>
+    <td>1000<span>5</span></td><td>10 %</td><td>x</td><td>900<span>1</span></td><td>9 %</td></tr>
+<tr><td><strong>Asset Manager / Institutional</strong></td>
+    <td>304,136 <span>10.0</span></td><td>53.6 %</td><td>x</td>
+    <td>100,790 <span>-745.0</span></td><td>17.8 %</td></tr>
+<tr><td><strong>Leveraged Funds</strong></td>
+    <td>57,404 <span>1,922.0</span></td><td>10.1 %</td><td>x</td>
+    <td>98,263 <span>2,377.0</span></td><td>17.3 %</td></tr>
+</tbody></table>
+"""
+
+
+def test_cot_scraper_two_hop():
+    t = ReplayTransport({
+        r"tradingster.com/cot$": COT_INDEX_HTML,
+        r"/cot/tff/13874A": COT_REPORT_HTML,
+    })
+    scraper = COTScraper("S&P 500 STOCK INDEX", t)
+    msg = scraper.scrape(NOW)
+    assert t.requests[1].endswith("/cot/tff/13874A")
+    assert msg["Asset"]["Asset_long_pos"] == 304136
+    assert msg["Asset"]["Asset_short_pos_change"] == -745.0
+    assert msg["Leveraged"]["Leveraged_long_pos_change"] == 1922.0
+    assert msg["Leveraged"]["Leveraged_short_open_int"] == 17.3
+    assert "Dealer" not in msg
+
+
+def test_cot_scraper_subject_missing():
+    t = ReplayTransport({r"tradingster.com/cot$": "<table></table>"})
+    assert COTScraper("GOLD", t).scrape(NOW) is None
+
+
+# ---------------------------------------------------------------- session
+
+
+def _session_fixture_transport():
+    deep = {"SPY": {"bids": [{"price": 332.0, "size": 100}],
+                    "asks": [{"price": 332.1, "size": 90}]}}
+    series = {"2020-02-07 09:30:00": {
+        "1. open": "332.0", "2. high": "332.2", "3. low": "331.9",
+        "4. close": "332.1", "5. volume": "1000"}}
+    calendar = {"calendar": {"days": {"day": [
+        {"date": "2020-02-07", "status": "open",
+         "open": {"start": "09:30", "end": "16:00"},
+         "premarket": {"start": "04:00", "end": "09:30"},
+         "postmarket": {"start": "16:00", "end": "20:00"}}]}}}
+    return ReplayTransport({
+        r"deep/book": json.dumps(deep),
+        r"alphavantage": json.dumps({"Meta Data": {}, "Time Series (5min)": series}),
+        r"markets/calendar": json.dumps(calendar),
+        r"economic-calendar": CALENDAR_HTML,
+        r"cnbc": VIX_HTML,
+        r"tradingster.com/cot$": COT_INDEX_HTML,
+        r"/cot/tff/13874A": COT_REPORT_HTML,
+    })
+
+
+def test_session_driver_full_day():
+    t = _session_fixture_transport()
+    fc = FeatureConfig()
+    bus = InProcessBus(DEFAULT_TOPICS)
+    clock = {"now": dt.datetime(2020, 2, 7, 9, 30, 0)}
+    sleeps = []
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock["now"] += dt.timedelta(seconds=s)
+
+    driver = SessionDriver(
+        bus,
+        SessionConfig(freq_s=300),
+        iex=IEXClient("tok", t),
+        alpha_vantage=AlphaVantageClient("tok", t),
+        calendar=TradierCalendarClient("tok", t),
+        indicator_scraper=EconomicCalendarScraper(fc, transport=t),
+        vix_scraper=VIXScraper(t),
+        cot_scraper=COTScraper("S&P 500 STOCK INDEX", t),
+        now_fn=lambda: clock["now"],
+        sleep_fn=fake_sleep,
+    )
+    n = driver.run_session(max_ticks=5)
+    assert n == 5
+    assert all(abs(s - 300) < 5 for s in sleeps)
+    for topic in (TOPIC_DEEP, TOPIC_VOLUME, TOPIC_VIX, TOPIC_IND, "cot"):
+        assert bus.end_offset(topic) == 5, topic
+    # deep messages have the producer shape the engine parses
+    rec = bus.read(TOPIC_DEEP, 0)[0]
+    assert "bids_0" in rec.value and rec.value["Timestamp"].startswith("2020-02-07")
+
+
+def test_session_driver_market_closed():
+    t = ReplayTransport({r"markets/calendar": json.dumps(
+        {"calendar": {"days": {"day": [
+            {"date": "2020-02-08", "status": "closed"}]}}})})
+    bus = InProcessBus(DEFAULT_TOPICS)
+    driver = SessionDriver(
+        bus, SessionConfig(),
+        calendar=TradierCalendarClient("tok", t),
+        now_fn=lambda: dt.datetime(2020, 2, 8, 10, 0, 0),
+    )
+    assert driver.run_session() == 0
+
+
+def test_session_feed_failure_isolated(caplog):
+    """One failing feed must not kill the tick (unlike producer.py:113-157)."""
+    t = _session_fixture_transport()
+    del t.fixtures[r"cnbc"]  # VIX feed will fail
+    fc = FeatureConfig()
+    bus = InProcessBus(DEFAULT_TOPICS)
+    driver = SessionDriver(
+        bus, SessionConfig(),
+        iex=IEXClient("tok", t),
+        vix_scraper=VIXScraper(t),
+        indicator_scraper=EconomicCalendarScraper(fc, transport=t),
+        now_fn=lambda: NOW,
+    )
+    with caplog.at_level("WARNING"):
+        results = driver.run_tick()
+    assert results["deep"] and results["ind"] and not results["vix"]
+    assert bus.end_offset(TOPIC_DEEP) == 1
+    assert bus.end_offset(TOPIC_VIX) == 0
