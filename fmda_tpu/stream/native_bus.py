@@ -1,0 +1,214 @@
+"""ctypes binding for the native C++ ring-buffer bus (native/ringbus.cpp).
+
+Implements the same :class:`~fmda_tpu.stream.bus.MessageBus` contract as
+:class:`~fmda_tpu.stream.bus.InProcessBus` — topics, monotonic offsets,
+independent consumers, bounded retention — on top of the C++ topic log.
+The shared library is built on demand with the checked-in Makefile (g++ is
+part of the toolchain); environments without a compiler fall back to the
+Python bus.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+import subprocess
+from typing import Iterable, List, Optional, Sequence
+
+from fmda_tpu.stream.bus import Consumer, Record
+
+log = logging.getLogger("fmda_tpu.stream")
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libringbus.so")
+
+
+class NativeBusUnavailable(RuntimeError):
+    pass
+
+
+def _build_library() -> str:
+    if os.path.exists(_LIB_PATH):
+        return _LIB_PATH
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:
+        detail = ""
+        if isinstance(e, subprocess.CalledProcessError):
+            detail = f": {e.stderr.decode(errors='replace')[-500:]}"
+        raise NativeBusUnavailable(f"cannot build libringbus ({e}){detail}") from e
+    if not os.path.exists(_LIB_PATH):
+        raise NativeBusUnavailable("build succeeded but library missing")
+    return _LIB_PATH
+
+
+def _load_library() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_build_library())
+    lib.rb_create.restype = ctypes.c_void_p
+    lib.rb_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.rb_destroy.argtypes = [ctypes.c_void_p]
+    lib.rb_topic.restype = ctypes.c_int64
+    lib.rb_topic.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.rb_publish.restype = ctypes.c_int64
+    lib.rb_publish.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+    ]
+    lib.rb_read.restype = ctypes.c_int64
+    lib.rb_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int64,
+    ]
+    lib.rb_end_offset.restype = ctypes.c_int64
+    lib.rb_end_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rb_base_offset.restype = ctypes.c_int64
+    lib.rb_base_offset.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def native_available() -> bool:
+    try:
+        _get_lib()
+        return True
+    except NativeBusUnavailable:
+        return False
+
+
+def _get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load_library()
+    return _lib
+
+
+class NativeBus:
+    """MessageBus over the C++ topic log."""
+
+    READ_CHUNK = 256
+    READ_BUF_BYTES = 1 << 20
+
+    def __init__(
+        self,
+        topics: Iterable[str],
+        arena_bytes: int = 1 << 22,
+        max_records: int = 1 << 16,
+    ) -> None:
+        self._lib = _get_lib()
+        self._handle = self._lib.rb_create(arena_bytes, max_records)
+        if not self._handle:
+            raise NativeBusUnavailable("rb_create failed")
+        self._topic_ids = {}
+        for name in topics:
+            tid = self._lib.rb_topic(self._handle, name.encode())
+            if tid < 0:
+                raise NativeBusUnavailable(f"rb_topic({name!r}) failed")
+            self._topic_ids[name] = tid
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.rb_destroy(handle)
+            self._handle = None
+
+    def _tid(self, topic: str) -> int:
+        if topic not in self._topic_ids:
+            raise KeyError(
+                f"unknown topic {topic!r}; configured: {sorted(self._topic_ids)}"
+            )
+        return self._topic_ids[topic]
+
+    # -- MessageBus ----------------------------------------------------------
+
+    def publish(self, topic: str, value: dict) -> int:
+        payload = json.dumps(value).encode()
+        if len(payload) > self.READ_BUF_BYTES:
+            # a record the read buffer can never return would wedge its
+            # consumers forever — reject at the door
+            raise RuntimeError(
+                f"record of {len(payload)}B exceeds the bus record limit "
+                f"({self.READ_BUF_BYTES}B)"
+            )
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        offset = self._lib.rb_publish(
+            self._handle, self._tid(topic), buf, len(payload)
+        )
+        if offset < 0:
+            raise RuntimeError(
+                f"publish to {topic!r} failed (record {len(payload)}B too "
+                "large for the arena?)"
+            )
+        return offset
+
+    def read(
+        self, topic: str, offset: int, max_records: Optional[int] = None
+    ) -> List[Record]:
+        tid = self._tid(topic)
+        out: List[Record] = []
+        remaining = max_records
+        cursor = max(offset, 0)
+        buf = (ctypes.c_uint8 * self.READ_BUF_BYTES)()
+        offsets = (ctypes.c_uint64 * self.READ_CHUNK)()
+        lengths = (ctypes.c_uint32 * self.READ_CHUNK)()
+        while True:
+            chunk = self.READ_CHUNK if remaining is None else min(
+                self.READ_CHUNK, remaining)
+            if chunk <= 0:
+                break
+            n = self._lib.rb_read(
+                self._handle, tid, cursor, buf, self.READ_BUF_BYTES,
+                offsets, lengths, chunk,
+            )
+            if n < 0:
+                raise RuntimeError(f"rb_read failed on {topic!r}")
+            if n == 0:
+                # no record fit: either end-of-log, or a record larger than
+                # the read buffer (must not silently stall the consumer)
+                if cursor < self.end_offset(topic) and cursor >= self.base_offset(topic):
+                    raise RuntimeError(
+                        f"record at {topic!r} offset {cursor} exceeds the "
+                        f"read buffer ({self.READ_BUF_BYTES}B)"
+                    )
+                break
+            pos = 0
+            for i in range(n):
+                raw = bytes(buf[pos : pos + lengths[i]])
+                pos += lengths[i]
+                out.append(Record(topic, int(offsets[i]), json.loads(raw)))
+            cursor = int(offsets[n - 1]) + 1
+            if remaining is not None:
+                remaining -= n
+                if remaining <= 0:
+                    break
+            # NOTE: n < chunk does NOT mean end-of-log — rb_read also stops
+            # early when the byte buffer fills; loop until n == 0.
+        return out
+
+    def end_offset(self, topic: str) -> int:
+        return int(self._lib.rb_end_offset(self._handle, self._tid(topic)))
+
+    def base_offset(self, topic: str) -> int:
+        return int(self._lib.rb_base_offset(self._handle, self._tid(topic)))
+
+    def topics(self) -> Sequence[str]:
+        return tuple(self._topic_ids)
+
+    def consumer(self, topic: str, *, from_end: bool = False) -> Consumer:
+        c = Consumer(self, topic)
+        if from_end:
+            c.seek_to_end()
+        return c

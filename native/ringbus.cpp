@@ -1,0 +1,249 @@
+// ringbus: native topic-log message bus — the framework's broker core.
+//
+// The reference's data plane is an external JVM Kafka broker (config.py:15,
+// README.md:186-292).  This is the TPU-framework-owned equivalent: an
+// embedded, lock-striped, append-only topic log with Kafka semantics
+// (monotonic offsets, independent consumer positions, bounded retention),
+// compiled to a shared library and driven from Python via ctypes
+// (fmda_tpu/stream/native_bus.py).  No external processes, no JVM.
+//
+// Design:
+//  - per-topic ring: a contiguous byte arena + a record table (offset into
+//    arena, length, logical offset).  Records are variable-length up to
+//    max_record_size.
+//  - retention: when either the record table or the arena fills, the oldest
+//    records are evicted; logical offsets stay monotonic (readers observe a
+//    moved base, exactly like Kafka's log-start-offset).
+//  - one mutex per topic (publishers/readers of different topics never
+//    contend); readers copy out under the lock — records are small JSON
+//    messages at a 5-minute cadence, contention is not the bottleneck,
+//    crossing the C boundary without dangling pointers is the point.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <new>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Record {
+  uint64_t logical_offset;
+  size_t arena_pos;
+  uint32_t length;
+};
+
+struct Topic {
+  std::string name;
+  std::mutex mu;
+  std::vector<uint8_t> arena;     // circular byte storage
+  std::vector<Record> records;    // logical record index (FIFO window)
+  size_t arena_capacity = 0;
+  size_t arena_head = 0;          // next write position in arena
+  uint64_t next_offset = 0;       // next logical offset to assign
+  size_t max_records = 0;
+
+  // Drop the oldest record (caller holds mu).
+  void evict_front() {
+    if (!records.empty()) records.erase(records.begin());
+  }
+
+  bool fits_after_eviction(uint32_t len) const {
+    return static_cast<size_t>(len) <= arena_capacity;
+  }
+
+  // Free arena space: a record's bytes are free iff no live record uses
+  // them.  Because writes are sequential in a ring, it is sufficient to
+  // evict from the front until the byte range [arena_head, arena_head+len)
+  // (mod capacity) overlaps no live record.
+  // Does the circular byte range [start, start+len) overlap record r?
+  // Each circular range is split into at most two linear segments in
+  // [0, cap); segments are then compared pairwise.
+  bool range_overlaps(size_t start, size_t len, const Record& r) const {
+    auto overlap1d = [](size_t a0, size_t a1, size_t b0, size_t b1) {
+      return a0 < b1 && b0 < a1;
+    };
+    const size_t cap = arena_capacity;
+    auto segments = [cap](size_t pos, size_t n,
+                          size_t seg[2][2]) -> int {
+      pos %= cap;
+      if (pos + n <= cap) {
+        seg[0][0] = pos;
+        seg[0][1] = pos + n;
+        return 1;
+      }
+      seg[0][0] = pos;
+      seg[0][1] = cap;
+      seg[1][0] = 0;
+      seg[1][1] = pos + n - cap;
+      return 2;
+    };
+    size_t a[2][2], b[2][2];
+    int na = segments(start, len, a);
+    int nb = segments(r.arena_pos, r.length, b);
+    for (int i = 0; i < na; ++i)
+      for (int j = 0; j < nb; ++j)
+        if (overlap1d(a[i][0], a[i][1], b[j][0], b[j][1])) return true;
+    return false;
+  }
+
+  int64_t publish(const uint8_t* data, uint32_t len) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (!fits_after_eviction(len)) return -1;  // record larger than arena
+    // make room in the record table
+    while (records.size() >= max_records) evict_front();
+    // make room in the arena
+    while (true) {
+      bool clear = true;
+      for (const auto& r : records) {
+        if (range_overlaps(arena_head, len, r)) {
+          clear = false;
+          break;
+        }
+      }
+      if (clear) break;
+      evict_front();
+    }
+    size_t pos = arena_head % arena_capacity;
+    // copy (possibly wrapping)
+    size_t first = std::min(static_cast<size_t>(len), arena_capacity - pos);
+    std::memcpy(arena.data() + pos, data, first);
+    if (first < len) std::memcpy(arena.data(), data + first, len - first);
+
+    Record rec{next_offset, pos, len};
+    records.push_back(rec);
+    arena_head = (pos + len) % arena_capacity;
+    return static_cast<int64_t>(next_offset++);
+  }
+
+  // Copy records with logical offset >= from into out; returns count.
+  int64_t read(uint64_t from, uint8_t* buf, size_t buf_len,
+               uint64_t* out_offsets, uint32_t* out_lengths,
+               int64_t max_out) {
+    std::lock_guard<std::mutex> lock(mu);
+    size_t written = 0;
+    int64_t count = 0;
+    for (const auto& r : records) {
+      if (r.logical_offset < from) continue;
+      if (count >= max_out) break;
+      if (written + r.length > buf_len) break;
+      size_t pos = r.arena_pos;
+      size_t first = std::min(static_cast<size_t>(r.length),
+                              arena_capacity - pos);
+      std::memcpy(buf + written, arena.data() + pos, first);
+      if (first < r.length)
+        std::memcpy(buf + written + first, arena.data(), r.length - first);
+      out_offsets[count] = r.logical_offset;
+      out_lengths[count] = r.length;
+      written += r.length;
+      ++count;
+    }
+    return count;
+  }
+
+  uint64_t end_offset() {
+    std::lock_guard<std::mutex> lock(mu);
+    return next_offset;
+  }
+
+  uint64_t base_offset() {
+    std::lock_guard<std::mutex> lock(mu);
+    return records.empty() ? next_offset : records.front().logical_offset;
+  }
+};
+
+struct Bus {
+  std::mutex topics_mu;
+  std::vector<Topic*> topics;
+  size_t arena_capacity;
+  size_t max_records;
+
+  ~Bus() {
+    for (auto* t : topics) delete t;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a bus. arena_capacity: bytes of payload retention per topic;
+// max_records: record-count retention per topic.
+void* rb_create(uint64_t arena_capacity, uint64_t max_records) {
+  if (arena_capacity == 0 || max_records == 0) return nullptr;
+  Bus* bus = new (std::nothrow) Bus();
+  if (!bus) return nullptr;
+  bus->arena_capacity = arena_capacity;
+  bus->max_records = max_records;
+  return bus;
+}
+
+void rb_destroy(void* handle) { delete static_cast<Bus*>(handle); }
+
+// Register (or look up) a topic by name; returns its id, or -1.
+int64_t rb_topic(void* handle, const char* name) {
+  Bus* bus = static_cast<Bus*>(handle);
+  if (!bus || !name) return -1;
+  std::lock_guard<std::mutex> lock(bus->topics_mu);
+  for (size_t i = 0; i < bus->topics.size(); ++i)
+    if (bus->topics[i]->name == name) return static_cast<int64_t>(i);
+  Topic* t = new (std::nothrow) Topic();
+  if (!t) return -1;
+  // no exception may cross the extern "C" boundary (ctypes FFI frame)
+  try {
+    t->name = name;
+    t->arena_capacity = bus->arena_capacity;
+    t->arena.resize(bus->arena_capacity);
+    t->max_records = bus->max_records;
+    bus->topics.push_back(t);
+  } catch (...) {
+    delete t;
+    return -1;
+  }
+  return static_cast<int64_t>(bus->topics.size() - 1);
+}
+
+static Topic* get_topic(void* handle, int64_t topic_id) {
+  Bus* bus = static_cast<Bus*>(handle);
+  if (!bus) return nullptr;
+  std::lock_guard<std::mutex> lock(bus->topics_mu);
+  if (topic_id < 0 || static_cast<size_t>(topic_id) >= bus->topics.size())
+    return nullptr;
+  return bus->topics[topic_id];
+}
+
+// Append a record; returns its logical offset, or -1 on error.
+int64_t rb_publish(void* handle, int64_t topic_id, const uint8_t* data,
+                   uint32_t len) {
+  Topic* t = get_topic(handle, topic_id);
+  if (!t || !data) return -1;
+  return t->publish(data, len);
+}
+
+// Read records with offset >= from. Payloads are packed back-to-back into
+// buf; out_offsets/out_lengths receive per-record metadata. Returns the
+// number of records copied, or -1 on error.
+int64_t rb_read(void* handle, int64_t topic_id, uint64_t from, uint8_t* buf,
+                uint64_t buf_len, uint64_t* out_offsets, uint32_t* out_lengths,
+                int64_t max_out) {
+  Topic* t = get_topic(handle, topic_id);
+  if (!t || !buf || !out_offsets || !out_lengths) return -1;
+  return t->read(from, buf, buf_len, out_offsets, out_lengths, max_out);
+}
+
+// One past the last assigned offset (Kafka end offset).
+int64_t rb_end_offset(void* handle, int64_t topic_id) {
+  Topic* t = get_topic(handle, topic_id);
+  if (!t) return -1;
+  return static_cast<int64_t>(t->end_offset());
+}
+
+// Oldest retained offset (Kafka log-start offset).
+int64_t rb_base_offset(void* handle, int64_t topic_id) {
+  Topic* t = get_topic(handle, topic_id);
+  if (!t) return -1;
+  return static_cast<int64_t>(t->base_offset());
+}
+
+}  // extern "C"
