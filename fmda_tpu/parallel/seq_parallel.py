@@ -70,7 +70,7 @@ def sp_gru_scan(
     # Mark the (replicated) initial carry as varying over the mesh axes the
     # inputs vary on, so the lax.scan carry type matches the per-device gate
     # outputs (shard_map's varying-manual-axes typing).
-    h0 = jax.lax.pvary(h0, vary_axes or (axis_name,))
+    h0 = jax.lax.pcast(h0, vary_axes or (axis_name,), to="varying")
     carry = h0
     hs_local = jnp.zeros(xp_local.shape[:2] + (w_hh.shape[-1],), xp_local.dtype)
     h_final = jnp.zeros_like(h0)

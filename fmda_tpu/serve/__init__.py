@@ -1,3 +1,4 @@
 from fmda_tpu.serve.predictor import Prediction, Predictor
+from fmda_tpu.serve.streaming import StreamingBiGRU, StreamingPredictor
 
-__all__ = ["Prediction", "Predictor"]
+__all__ = ["Prediction", "Predictor", "StreamingBiGRU", "StreamingPredictor"]

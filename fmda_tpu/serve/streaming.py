@@ -1,0 +1,183 @@
+"""Streaming inference with carried hidden state — the O(1)-per-tick path.
+
+The reference (and the flagship bidirectional :class:`Predictor`) re-scan a
+full window per tick (predict.py:161-178).  For a *unidirectional* model the
+recurrence makes that redundant: the hidden state after row ``t`` summarises
+all history, so each tick only needs to feed the **newest row** and carry
+the state — O(1) device work per tick instead of O(window), and tick
+latency is one fused step (the north-star "jit state-carry" serving config,
+BASELINE.json configs[4]).
+
+The pooling head still wants max/mean pools over the last ``window`` steps,
+so the carrier keeps a small ring of per-step hidden outputs (H-sized
+vectors, not feature rows) and pools over it.
+
+Semantics note: carried state means the recurrence sees the *entire*
+session history, not just the trailing window — step ``t`` is bit-identical
+to scanning the whole stream from the start and pooling over the last
+``window`` hidden outputs (verified in tests).  That differs from the
+window-re-scan :class:`~fmda_tpu.serve.predictor.Predictor`, which resets
+``h0 = 0`` at the left edge of every window (the training-time semantics,
+sql_pytorch_dataloader windows).  Longer memory, O(1) ticks — choose per
+deployment; both are exposed.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fmda_tpu.config import ModelConfig, TARGET_COLUMNS
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.ops.gru import GRUWeights, gru_gates
+
+log = logging.getLogger("fmda_tpu.serve")
+
+
+class StreamingBiGRU:
+    """Carried-state streaming inference core for unidirectional models.
+
+    Holds (h, ring of last ``window`` hidden outputs); each ``step(row)``
+    advances the recurrence by one row and produces logits from the pooled
+    head, exactly as a full re-scan of the trailing window would.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        norm: NormParams,
+        *,
+        window: int,
+        batch: int = 1,
+    ) -> None:
+        if cfg.bidirectional:
+            raise ValueError(
+                "carried-state streaming needs bidirectional=False; the "
+                "backward direction would require the future. Use the "
+                "window-re-scan Predictor for bidirectional models."
+            )
+        if cfg.n_layers != 1:
+            raise ValueError("streaming core currently covers 1-layer models")
+        self.cfg = cfg
+        self.window = window
+        self.batch = batch
+        self._params = params
+        x_min = jnp.asarray(norm.x_min)
+        x_range = jnp.asarray(norm.x_max - norm.x_min)
+
+        hidden = cfg.hidden_size
+
+        def step(params, h, ring, ring_pos, row):
+            """One tick: row (B, F) -> (logits, new_h, new_ring, new_pos)."""
+            p = params
+            w = GRUWeights(
+                p["weight_ih_l0"], p["weight_hh_l0"],
+                p["bias_ih_l0"], p["bias_hh_l0"],
+            )
+            x = (row - x_min) / x_range
+            xp = x @ w.w_ih.T + w.b_ih
+            h_new = gru_gates(xp, h, w.w_hh, w.b_hh)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, h_new, ring_pos % self.window, axis=1
+            )
+            # pooled head over the trailing window of hidden outputs
+            # (biGRU_model.py:108-137 semantics; last_hidden == h_new here)
+            n_valid = jnp.minimum(ring_pos + 1, self.window)
+            steps = jnp.arange(self.window)
+            valid = (steps < n_valid)[None, :, None]
+            neg = jnp.finfo(ring.dtype).min
+            max_pool = jnp.max(jnp.where(valid, ring, neg), axis=1)
+            avg_pool = jnp.sum(jnp.where(valid, ring, 0.0), axis=1) / n_valid
+            concat = jnp.concatenate([h_new, max_pool, avg_pool], axis=-1)
+            logits = concat @ p["linear"]["kernel"] + p["linear"]["bias"]
+            return logits, h_new, ring, ring_pos + 1
+
+        self._step = jax.jit(step)
+        self.reset()
+
+    def reset(self) -> None:
+        hidden = self.cfg.hidden_size
+        self._h = jnp.zeros((self.batch, hidden))
+        self._ring = jnp.zeros((self.batch, self.window, hidden))
+        self._pos = jnp.asarray(0, jnp.int32)
+
+    @property
+    def ticks_seen(self) -> int:
+        return int(self._pos)
+
+    def step(self, row: np.ndarray) -> np.ndarray:
+        """Advance one tick with the newest feature row (B, F) or (F,);
+        returns sigmoid probabilities (B, n_classes)."""
+        row = jnp.asarray(row, jnp.float32)
+        if row.ndim == 1:
+            row = row[None, :]
+        logits, self._h, self._ring, self._pos = self._step(
+            self._params, self._h, self._ring, self._pos, row
+        )
+        return np.asarray(jax.nn.sigmoid(logits))
+
+
+class StreamingPredictor:
+    """Bus-facing wrapper: consume predict-timestamp signals, feed only the
+    newest landed row through the carried-state core, publish predictions."""
+
+    def __init__(
+        self,
+        bus,
+        warehouse,
+        core: StreamingBiGRU,
+        *,
+        threshold: float = 0.5,
+        y_fields=TARGET_COLUMNS,
+        signal_topic: str = "predict_timestamp",
+        prediction_topic: str = "prediction",
+        from_end: bool = True,
+    ) -> None:
+        self.bus = bus
+        self.warehouse = warehouse
+        self.core = core
+        self.threshold = threshold
+        self.y_fields = tuple(y_fields)
+        self.prediction_topic = prediction_topic
+        self._consumer = bus.consumer(signal_topic, from_end=from_end)
+        self._last_row_id = 0
+
+    def poll(self) -> List[Tuple[str, np.ndarray, Tuple[str, ...]]]:
+        """Serve new signals; returns [(timestamp, probs, labels)].
+
+        Rows are consumed strictly in id order; if signals skipped rows
+        (e.g. predictor started mid-session), the gap rows are fed through
+        the recurrence first so the carried state stays exact.
+        """
+        out = []
+        for rec in self._consumer.poll():
+            ts = rec.value.get("Timestamp")
+            if not ts:
+                continue
+            row_id = self.warehouse.id_for_timestamp(ts)
+            if row_id is None or row_id <= self._last_row_id:
+                continue
+            # catch up any gap rows to keep the recurrence exact
+            for rid in range(self._last_row_id + 1, row_id + 1):
+                x = self.warehouse.fetch([rid])
+                probs = self.core.step(x)[0]
+            self._last_row_id = row_id
+            idx = np.where(probs > self.threshold)[0]
+            labels = tuple(self.y_fields[i] for i in idx)
+            self.bus.publish(
+                self.prediction_topic,
+                {
+                    "timestamp": ts,
+                    "probabilities": [float(p) for p in probs],
+                    "prob_threshold": self.threshold,
+                    "pred_indices": [int(i) for i in idx],
+                    "pred_labels": list(labels),
+                },
+            )
+            out.append((ts, probs, labels))
+        return out

@@ -52,6 +52,7 @@ from fmda_tpu.ops.microstructure import deep_features, wick_percentage
 from fmda_tpu.stream.bus import MessageBus
 from fmda_tpu.stream.warehouse import Warehouse
 from fmda_tpu.utils.timeutils import floor_epoch, parse_ts, to_epoch
+from fmda_tpu.utils.tracing import StageTimer
 
 log = logging.getLogger("fmda_tpu.stream")
 
@@ -201,6 +202,9 @@ class StreamEngine:
         self._pending_deep: List[_Event] = []
         self._emitted = 0
         self._dropped = 0
+        #: per-stage wall-clock accounting (SURVEY.md §5: the reference has
+        #: no tracing; here every step exposes ingest/join/land/signal time)
+        self.timer = StageTimer()
         if checkpoint_path and os.path.exists(checkpoint_path):
             self.restore()
 
@@ -238,45 +242,55 @@ class StreamEngine:
         Returns the number of rows emitted this step.
         """
         fc = self.features
-        self._ingest()
+        with self.timer.stage("ingest"):
+            self._ingest()
         emitted_rows: List[Dict[str, float]] = []
         still_pending: List[_Event] = []
 
-        for deep_ev in sorted(self._pending_deep, key=lambda e: e.ts):
-            matches: Dict[str, _Event] = {}
-            expired = False  # some stream can provably never match
-            waiting = False  # some stream might still deliver a match
-            for topic, buf in self._side_streams.items():
-                m = buf.match(deep_ev.ts, fc.floor_s, fc.join_tolerance_s)
-                if m is not None:
-                    matches[topic] = m
-                elif buf.watermark(fc.watermark_s) > deep_ev.ts + fc.join_tolerance_s:
-                    expired = True
-                else:
-                    waiting = True
-            if expired:
-                # inner join: one unmatched stream past its horizon kills the row
-                self._dropped += 1
-                log.warning(
-                    "dropping unjoinable book row at %s (no side match within "
-                    "tolerance)", deep_ev.ts_str,
-                )
-            elif waiting:
-                still_pending.append(deep_ev)
-            else:  # all side streams matched
-                row: Dict[str, float] = {"Timestamp": deep_ev.ts_str}
-                row.update(deep_ev.payload)
-                for m in matches.values():
-                    row.update(m.payload)
-                emitted_rows.append(row)
+        with self.timer.stage("join"):
+            for deep_ev in sorted(self._pending_deep, key=lambda e: e.ts):
+                matches: Dict[str, _Event] = {}
+                expired = False  # some stream can provably never match
+                waiting = False  # some stream might still deliver a match
+                for topic, buf in self._side_streams.items():
+                    m = buf.match(deep_ev.ts, fc.floor_s, fc.join_tolerance_s)
+                    if m is not None:
+                        matches[topic] = m
+                    elif (
+                        buf.watermark(fc.watermark_s)
+                        > deep_ev.ts + fc.join_tolerance_s
+                    ):
+                        expired = True
+                    else:
+                        waiting = True
+                if expired:
+                    # inner join: one unmatched stream past its horizon
+                    # kills the row
+                    self._dropped += 1
+                    log.warning(
+                        "dropping unjoinable book row at %s (no side match "
+                        "within tolerance)", deep_ev.ts_str,
+                    )
+                elif waiting:
+                    still_pending.append(deep_ev)
+                else:  # all side streams matched
+                    row: Dict[str, float] = {"Timestamp": deep_ev.ts_str}
+                    row.update(deep_ev.payload)
+                    for m in matches.values():
+                        row.update(m.payload)
+                    emitted_rows.append(row)
 
         self._pending_deep = still_pending
 
         if emitted_rows:
-            self.warehouse.insert_rows(emitted_rows)
+            with self.timer.stage("land"):
+                self.warehouse.insert_rows(emitted_rows)
             # signal AFTER the write commits: no sleep-and-retry race
-            for row in emitted_rows:
-                self.bus.publish(self.signal_topic, {"Timestamp": row["Timestamp"]})
+            with self.timer.stage("signal"):
+                for row in emitted_rows:
+                    self.bus.publish(
+                        self.signal_topic, {"Timestamp": row["Timestamp"]}
+                    )
             self._emitted += len(emitted_rows)
 
         # bound buffer state by the global watermark

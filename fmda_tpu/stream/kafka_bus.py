@@ -1,0 +1,106 @@
+"""Kafka adapter: run the framework against real brokers (deployment parity).
+
+The framework's default transports are the in-process Python bus and the
+native C++ ring bus; this adapter lets the same engine/serving code run
+against external Kafka brokers like the reference's deployment
+(config.py:15, README.md:186-292).  Gated on ``kafka-python`` being
+installed — the constructor raises a clear error otherwise, so air-gapped
+environments never pay for the import.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Iterable, List, Optional, Sequence
+
+from fmda_tpu.stream.bus import Consumer, Record
+
+log = logging.getLogger("fmda_tpu.stream")
+
+
+class KafkaBus:
+    """MessageBus over kafka-python producers/consumers.
+
+    Offsets are Kafka's native partition-0 offsets, matching the
+    reference's single-partition topic usage (predict.py:26-27).
+    """
+
+    def __init__(
+        self,
+        topics: Iterable[str],
+        servers: Sequence[str] = ("localhost:9092",),
+    ) -> None:
+        try:
+            from kafka import KafkaConsumer, KafkaProducer, TopicPartition  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "KafkaBus needs the 'kafka-python' package; use "
+                "InProcessBus or NativeBus otherwise"
+            ) from e
+        self._TopicPartition = TopicPartition
+        self._KafkaConsumer = KafkaConsumer
+        self._topics = tuple(topics)
+        self._servers = list(servers)
+        self._producer = KafkaProducer(
+            bootstrap_servers=self._servers,
+            value_serializer=lambda v: json.dumps(v).encode("utf-8"),
+        )
+        # one metadata consumer reused for offset queries
+        self._meta = KafkaConsumer(
+            bootstrap_servers=self._servers, group_id=None,
+            enable_auto_commit=False,
+        )
+
+    def _check(self, topic: str) -> None:
+        if topic not in self._topics:
+            raise KeyError(
+                f"unknown topic {topic!r}; configured: {sorted(self._topics)}"
+            )
+
+    def publish(self, topic: str, value: dict) -> int:
+        self._check(topic)
+        future = self._producer.send(topic, value=value)
+        meta = future.get(timeout=30)
+        return meta.offset
+
+    def read(
+        self, topic: str, offset: int, max_records: Optional[int] = None
+    ) -> List[Record]:
+        self._check(topic)
+        tp = self._TopicPartition(topic, 0)
+        consumer = self._KafkaConsumer(
+            bootstrap_servers=self._servers, group_id=None,
+            enable_auto_commit=False,
+            value_deserializer=lambda b: json.loads(b.decode("utf-8")),
+        )
+        try:
+            consumer.assign([tp])
+            consumer.seek(tp, max(offset, 0))
+            out: List[Record] = []
+            while max_records is None or len(out) < max_records:
+                polled = consumer.poll(timeout_ms=500)
+                records = polled.get(tp, [])
+                if not records:
+                    break
+                for r in records:
+                    out.append(Record(topic, r.offset, r.value))
+                    if max_records is not None and len(out) >= max_records:
+                        break
+            return out
+        finally:
+            consumer.close()
+
+    def end_offset(self, topic: str) -> int:
+        self._check(topic)
+        tp = self._TopicPartition(topic, 0)
+        return self._meta.end_offsets([tp])[tp]
+
+    def topics(self) -> Sequence[str]:
+        return self._topics
+
+    def consumer(self, topic: str, *, from_end: bool = False) -> Consumer:
+        c = Consumer(self, topic)
+        if from_end:
+            c.seek_to_end()
+        return c

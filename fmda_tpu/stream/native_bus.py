@@ -169,6 +169,11 @@ class NativeBus:
                 self.READ_CHUNK, remaining)
             if chunk <= 0:
                 break
+            # Snapshot end BEFORE reading: if rb_read then returns 0 while
+            # the snapshot shows a retained record at the cursor, that
+            # record provably predates the read and didn't fit the buffer
+            # (a publish racing after the snapshot can't trip this).
+            end_snapshot = self.end_offset(topic)
             n = self._lib.rb_read(
                 self._handle, tid, cursor, buf, self.READ_BUF_BYTES,
                 offsets, lengths, chunk,
@@ -176,9 +181,7 @@ class NativeBus:
             if n < 0:
                 raise RuntimeError(f"rb_read failed on {topic!r}")
             if n == 0:
-                # no record fit: either end-of-log, or a record larger than
-                # the read buffer (must not silently stall the consumer)
-                if cursor < self.end_offset(topic) and cursor >= self.base_offset(topic):
+                if cursor < end_snapshot and cursor >= self.base_offset(topic):
                     raise RuntimeError(
                         f"record at {topic!r} offset {cursor} exceeds the "
                         f"read buffer ({self.READ_BUF_BYTES}B)"

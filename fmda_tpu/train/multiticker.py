@@ -1,0 +1,85 @@
+"""Multi-ticker shared-encoder training (north-star config 2).
+
+The reference trains on exactly one ticker (SPY hard-coded,
+producer.py:262).  The scale-out config batches windows from many tickers
+through one shared encoder: every ticker contributes its own chunked,
+per-ticker-normalized windows (windows never span tickers), and batches
+interleave tickers so each step's gradient mixes instruments — on TPU this
+just makes the batch dimension bigger, which is exactly what the MXU wants.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fmda_tpu.data.pipeline import Batch, ChunkDataset, WindowBatches
+from fmda_tpu.data.source import FeatureSource
+
+
+class MultiTickerDataset:
+    """Per-ticker chunk datasets over a shared feature schema."""
+
+    def __init__(
+        self,
+        sources: Dict[str, FeatureSource],
+        chunk_size: int,
+        window: int,
+        *,
+        bid_levels: int = 0,
+        ask_levels: int = 0,
+    ) -> None:
+        if not sources:
+            raise ValueError("no sources")
+        fields = {tuple(s.x_fields) for s in sources.values()}
+        if len(fields) != 1:
+            raise ValueError(
+                "tickers must share one feature schema (shared encoder); "
+                f"got {len(fields)} distinct schemas"
+            )
+        self.tickers = tuple(sources)
+        self.datasets: Dict[str, ChunkDataset] = {
+            t: ChunkDataset(
+                src, chunk_size, window,
+                bid_levels=bid_levels, ask_levels=ask_levels,
+            )
+            for t, src in sources.items()
+        }
+
+    def splits(
+        self, val_size: float, test_size: float
+    ) -> Tuple[List[Tuple[str, int]], List[Tuple[str, int]], List[Tuple[str, int]]]:
+        """Per-ticker chunk splits, interleaved across tickers so every
+        epoch pass mixes instruments."""
+        train: List[Tuple[str, int]] = []
+        val: List[Tuple[str, int]] = []
+        test: List[Tuple[str, int]] = []
+        per_ticker = {
+            t: ds.split(val_size, test_size) for t, ds in self.datasets.items()
+        }
+        def interleave(select) -> List[Tuple[str, int]]:
+            out: List[Tuple[str, int]] = []
+            queues = {t: list(select(s)) for t, s in per_ticker.items()}
+            while any(queues.values()):
+                for t in self.tickers:
+                    if queues[t]:
+                        out.append((t, queues[t].pop(0)))
+            return out
+
+        return (
+            interleave(lambda s: s[0]),
+            interleave(lambda s: s[1]),
+            interleave(lambda s: s[2]),
+        )
+
+    def batches(
+        self, ticker: str, chunk_idx: int, batch_size: int
+    ) -> WindowBatches:
+        return WindowBatches(self.datasets[ticker], chunk_idx, batch_size)
+
+    def final_norm_params(self) -> Dict[str, "NormParams"]:
+        """Per-ticker serving norm stats (each instrument has its own
+        scale; sharing one min/max across tickers would wash out FX vs
+        equity magnitudes)."""
+        return {t: ds.final_norm_params for t, ds in self.datasets.items()}

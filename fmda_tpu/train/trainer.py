@@ -175,10 +175,9 @@ class Trainer:
 
     # -- batch plumbing ------------------------------------------------------
 
-    def _chunk_batches(
-        self, dataset: ChunkDataset, chunk_idx: int
-    ) -> Iterable[Batch]:
-        batches = WindowBatches(dataset, chunk_idx, self.train_cfg.batch_size)
+    def _place_batches(self, batches: Iterable[Batch]) -> Iterable[Batch]:
+        """Move host batches to the device(s): simple prefetch without a
+        mesh, dp batch sharding with one."""
         sharding = self._batch_sharding()
         if sharding is None:
             return prefetch_to_device(batches)
@@ -191,6 +190,13 @@ class Trainer:
             for b in batches
         )
 
+    def _chunk_batches(
+        self, dataset: ChunkDataset, chunk_idx: int
+    ) -> Iterable[Batch]:
+        return self._place_batches(
+            WindowBatches(dataset, chunk_idx, self.train_cfg.batch_size)
+        )
+
     # -- epochs --------------------------------------------------------------
 
     def _run_chunks(
@@ -201,12 +207,24 @@ class Trainer:
         rng: Optional[jax.Array],
         train: bool,
     ) -> Tuple[TrainState, EpochMetrics, np.ndarray]:
+        batch_iters = (
+            self._chunk_batches(dataset, idx) for idx in chunk_indices
+        )
+        return self._run_batches(state, batch_iters, rng, train)
+
+    def _run_batches(
+        self,
+        state: TrainState,
+        batch_iterables,
+        rng: Optional[jax.Array],
+        train: bool,
+    ) -> Tuple[TrainState, EpochMetrics, np.ndarray]:
         # Per-batch results stay on device (async) — converting them here
         # would block the host on every step and serialize the pipeline.
         # One device_get at the end of the pass drains everything.
         device_results = []
-        for chunk_idx in chunk_indices:
-            for batch in self._chunk_batches(dataset, chunk_idx):
+        for batches in batch_iterables:
+            for batch in batches:
                 if train:
                     state, loss, metrics = self._train_step(state, batch, rng)
                 else:
@@ -277,6 +295,59 @@ class Trainer:
                 val_metrics.hamming,
             )
         return state, history, dataset
+
+    def fit_multi(
+        self,
+        sources: Dict[str, FeatureSource],
+        *,
+        rng: Optional[jax.Array] = None,
+        epochs: Optional[int] = None,
+        bid_levels: int = 0,
+        ask_levels: int = 0,
+    ):
+        """Multi-ticker shared-encoder training (north-star config 2):
+        one model, batches interleaved across instruments, per-ticker
+        chunk normalization.  Returns (state, history, MultiTickerDataset).
+        """
+        from fmda_tpu.train.multiticker import MultiTickerDataset
+
+        tc = self.train_cfg
+        rng = jax.random.PRNGKey(tc.seed) if rng is None else rng
+        init_rng, step_rng = jax.random.split(rng)
+        mtd = MultiTickerDataset(
+            sources, tc.chunk_size, tc.window,
+            bid_levels=bid_levels, ask_levels=ask_levels,
+        )
+        train_chunks, val_chunks, _ = mtd.splits(tc.val_size, tc.test_size)
+        state = self.init_state(init_rng)
+        history: Dict[str, List[EpochMetrics]] = {"train": [], "val": []}
+        for epoch in range(epochs if epochs is not None else tc.epochs):
+            state, train_metrics, _ = self._run_batches(
+                state,
+                (
+                    self._place_batches(mtd.batches(t, c, tc.batch_size))
+                    for t, c in train_chunks
+                ),
+                step_rng,
+                train=True,
+            )
+            history["train"].append(train_metrics)
+            _, val_metrics, _ = self._run_batches(
+                state,
+                (
+                    self._place_batches(mtd.batches(t, c, tc.batch_size))
+                    for t, c in val_chunks
+                ),
+                None,
+                train=False,
+            )
+            history["val"].append(val_metrics)
+            log.info(
+                "multi epoch %d: train loss=%.4f acc=%.4f | val acc=%.4f",
+                epoch + 1, train_metrics.loss, train_metrics.accuracy,
+                val_metrics.accuracy,
+            )
+        return state, history, mtd
 
     def evaluate(
         self,

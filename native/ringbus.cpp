@@ -19,8 +19,10 @@
 //    messages at a 5-minute cadence, contention is not the bottleneck,
 //    crossing the C boundary without dangling pointers is the point.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <mutex>
 #include <new>
 #include <string>
@@ -38,7 +40,9 @@ struct Topic {
   std::string name;
   std::mutex mu;
   std::vector<uint8_t> arena;     // circular byte storage
-  std::vector<Record> records;    // logical record index (FIFO window)
+  // FIFO record index sorted by logical_offset: deque gives O(1) front
+  // eviction; lower_bound gives O(log n) positioning in reads.
+  std::deque<Record> records;
   size_t arena_capacity = 0;
   size_t arena_head = 0;          // next write position in arena
   uint64_t next_offset = 0;       // next logical offset to assign
@@ -46,7 +50,7 @@ struct Topic {
 
   // Drop the oldest record (caller holds mu).
   void evict_front() {
-    if (!records.empty()) records.erase(records.begin());
+    if (!records.empty()) records.pop_front();
   }
 
   bool fits_after_eviction(uint32_t len) const {
@@ -124,8 +128,11 @@ struct Topic {
     std::lock_guard<std::mutex> lock(mu);
     size_t written = 0;
     int64_t count = 0;
-    for (const auto& r : records) {
-      if (r.logical_offset < from) continue;
+    auto it = std::lower_bound(
+        records.begin(), records.end(), from,
+        [](const Record& r, uint64_t off) { return r.logical_offset < off; });
+    for (; it != records.end(); ++it) {
+      const Record& r = *it;
       if (count >= max_out) break;
       if (written + r.length > buf_len) break;
       size_t pos = r.arena_pos;

@@ -1,0 +1,117 @@
+"""MariaDB adapter SQL codegen (string-level parity with create_database.py
+— no server needed)."""
+
+import dataclasses
+
+import pytest
+
+from fmda_tpu.config import FeatureConfig
+from fmda_tpu.stream.mysql_warehouse import (
+    all_view_sql,
+    atr_view_sql,
+    bollinger_view_sql,
+    create_table_sql,
+    join_statement_sql,
+    ma_view_sql,
+    stochastic_view_sql,
+    target_view_sql,
+)
+
+
+@pytest.fixture
+def fc():
+    return FeatureConfig()
+
+
+def test_create_table_contains_every_schema_column(fc):
+    ddl = create_table_sql(fc, "stock_data_joined")
+    assert ddl.startswith("CREATE TABLE IF NOT EXISTS stock_data_joined")
+    assert "ID MEDIUMINT KEY AUTO_INCREMENT" in ddl
+    for col in fc.table_columns():
+        assert col in ddl, col
+    # reference types preserved
+    assert "bid_0_size MEDIUMINT NOT NULL" in ddl
+    assert "vol_imbalance FLOAT(7,4) NOT NULL" in ddl
+    assert "VIX FLOAT(5,2) NOT NULL" in ddl
+    assert "`5_volume` INT NOT NULL" in ddl
+    assert "Asset_long_pos MEDIUMINT NOT NULL" in ddl
+    assert "Nonfarm_Payrolls_Actual FLOAT(8,3) NOT NULL" in ddl
+
+
+def test_create_table_reshapes_with_config(fc):
+    small = dataclasses.replace(fc, bid_levels=2, ask_levels=2,
+                                get_vix=False, get_cot=False)
+    ddl = create_table_sql(small, "t")
+    assert "bid_2_size" not in ddl and "VIX" not in ddl
+    assert "Asset_long_pos" not in ddl
+
+
+def test_ma_view_frame_arithmetic():
+    sql = ma_view_sql("vol_MA", "5_volume", (6, 20), "t", "vol_MA")
+    # period-row frame == period-1 PRECEDING
+    assert "ROWS BETWEEN 5 PRECEDING AND CURRENT ROW" in sql
+    assert "ROWS BETWEEN 19 PRECEDING AND CURRENT ROW" in sql
+    assert "AS vol_MA6" in sql and "AS vol_MA20" in sql
+
+
+def test_stoch_and_atr_keep_15_row_quirk(fc):
+    # the reference hardcodes 14 PRECEDING (15-row windows)
+    assert "ROWS BETWEEN 14 PRECEDING" in stochastic_view_sql(fc, "t")
+    assert "ROWS BETWEEN 14 PRECEDING" in atr_view_sql(fc, "t")
+
+
+def test_bollinger_view(fc):
+    sql = bollinger_view_sql(fc, "t")
+    assert "(BB_avg + 2.0 * BB_std) - `4_close` AS upper_BB_dist" in sql
+    assert "ROWS BETWEEN 19 PRECEDING" in sql
+
+
+def test_target_view(fc):
+    sql = target_view_sql(fc, "t")
+    assert "LEAD(sd.`4_close`, 8)" in sql and "LEAD(sd.`4_close`, 15)" in sql
+    assert "(p0_close + (1.5 * ATR))" in sql
+    assert "(p0_close - (3.0 * ATR))" in sql
+
+
+def test_join_statement_covers_x_fields(fc):
+    sql = join_statement_sql(fc, "stock_data_joined")
+    select_part = sql.split("SELECT ")[1].split(" FROM ")[0]
+    n_selected = len(select_part.split(", "))
+    assert n_selected == fc.n_features  # all 108
+    for view in ("bollinger_bands", "vol_MA", "price_MA", "delta_MA",
+                 "stochastic_oscillator", "ATR", "price_change"):
+        assert view in sql
+
+
+def test_views_narrow_without_volume(fc):
+    no_vol = dataclasses.replace(fc, get_stock_volume=None)
+    stmts = all_view_sql(no_vol, "t")
+    joined = "\n".join(stmts)
+    assert "bollinger" not in joined and "ATR" not in joined
+    assert "delta_MA" in joined  # book-derived MA survives
+    sql = join_statement_sql(no_vol, "t")
+    select_part = sql.split("SELECT ")[1].split(" FROM ")[0]
+    assert len(select_part.split(", ")) == no_vol.n_features
+
+
+def test_gated_clients_raise_without_packages():
+    from fmda_tpu.stream.kafka_bus import KafkaBus
+    from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse
+
+    has_kafka = True
+    try:
+        import kafka  # noqa: F401
+    except ImportError:
+        has_kafka = False
+    if not has_kafka:
+        with pytest.raises(RuntimeError, match="kafka-python"):
+            KafkaBus(["a"])
+
+    has_mysql = True
+    try:
+        import mysql.connector  # noqa: F401
+    except ImportError:
+        has_mysql = False
+    if not has_mysql:
+        with pytest.raises(RuntimeError, match="mysql-connector"):
+            MySQLWarehouse(FeatureConfig())
