@@ -333,6 +333,9 @@ class ModelConfig:
     dtype: str = "float32"
     #: Use the fused Pallas scan cell on TPU (falls back to lax.scan elsewhere).
     use_pallas: bool = True
+    #: Rematerialise the recurrence in backward (jax.checkpoint): trades
+    #: recompute FLOPs for HBM — enable for long-context windows.
+    remat: bool = False
 
 
 @dataclass(frozen=True)

@@ -1,6 +1,7 @@
 from fmda_tpu.ingest.transport import (
     RecordingTransport,
     ReplayTransport,
+    RetryTransport,
     Transport,
     UrllibTransport,
 )
@@ -17,6 +18,7 @@ __all__ = [
     "UrllibTransport",
     "ReplayTransport",
     "RecordingTransport",
+    "RetryTransport",
     "IEXClient",
     "AlphaVantageClient",
     "TradierCalendarClient",

@@ -71,6 +71,44 @@ class ReplayTransport:
         raise TransportError(f"no fixture for {url}")
 
 
+class RetryTransport:
+    """Retry-with-backoff wrapper (SURVEY.md §5: the reference retries only
+    once, with a fixed 15 s sleep, and only in serving — here any transport
+    gets exponential-backoff retries with per-attempt logging)."""
+
+    def __init__(
+        self,
+        inner: Transport,
+        attempts: int = 3,
+        backoff_s: float = 1.0,
+        sleep_fn=None,
+    ) -> None:
+        import time
+
+        self.inner = inner
+        self.attempts = attempts
+        self.backoff_s = backoff_s
+        self.sleep_fn = sleep_fn or time.sleep
+
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        last: Optional[Exception] = None
+        for attempt in range(self.attempts):
+            try:
+                return self.inner.get(url, headers)
+            except TransportError as e:
+                last = e
+                if attempt < self.attempts - 1:
+                    delay = self.backoff_s * (2**attempt)
+                    log.warning(
+                        "GET %s failed (attempt %d/%d): %s; retrying in %.1fs",
+                        url, attempt + 1, self.attempts, e, delay,
+                    )
+                    self.sleep_fn(delay)
+        raise TransportError(
+            f"GET {url} failed after {self.attempts} attempts"
+        ) from last
+
+
 class RecordingTransport:
     """Wrap a live transport and persist every response for later replay."""
 

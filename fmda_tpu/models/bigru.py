@@ -136,6 +136,7 @@ class BiGRU(nn.Module):
                     reverse=reverse,
                     mask=mask,
                     use_pallas=cfg.use_pallas,
+                    remat=cfg.remat,
                 )
                 dir_outputs.append(hs)
                 layer_finals.append(h_last)

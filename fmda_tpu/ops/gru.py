@@ -23,6 +23,7 @@ with gates packed in ``[r, z, n]`` order along the leading axis of
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -117,12 +118,17 @@ def gru_layer(
     reverse: bool = False,
     mask: Optional[jax.Array] = None,
     use_pallas: bool = False,
+    remat: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Full single-direction GRU layer: projection + scan.
 
     ``use_pallas=True`` requests the fused Pallas TPU kernel for the scan;
     it silently falls back to :func:`gru_scan` when the kernel is unavailable
     (non-TPU backend) or unsupported for the given options.
+
+    ``remat=True`` wraps the scan in :func:`jax.checkpoint`: backward
+    recomputes the recurrence instead of storing per-step gate
+    intermediates — the HBM-for-FLOPs trade for long-context windows.
 
     Returns (h_last, hs) with hs: (B, T, H).
     """
@@ -132,9 +138,16 @@ def gru_layer(
         h0 = jnp.zeros((batch, hidden), dtype=x.dtype)
     xp = input_projection(x, weights)
     if use_pallas and mask is None and pallas_scan_available():
+        # The Pallas kernel's custom_vjp already rematerialises: backward
+        # stores only (xp, h0, W, b) and recomputes through the reference
+        # scan (pallas_gru._vjp_bwd), so `remat` is inherently satisfied.
         from fmda_tpu.ops import pallas_gru
 
         return pallas_gru.gru_scan_pallas(
             xp, h0, weights.w_hh, weights.b_hh, reverse=reverse
         )
+    if remat:
+        return jax.checkpoint(
+            functools.partial(gru_scan, reverse=reverse, mask=mask)
+        )(xp, h0, weights.w_hh, weights.b_hh)
     return gru_scan(xp, h0, weights.w_hh, weights.b_hh, reverse=reverse, mask=mask)
