@@ -12,7 +12,12 @@ from fmda_tpu.parallel.collectives import (
     shift_left,
     shift_right,
 )
-from fmda_tpu.parallel.seq_parallel import make_sp_forward, sp_bigru_layer, sp_gru_scan
+from fmda_tpu.parallel.seq_parallel import (
+    make_sp_forward,
+    sp_bigru_layer,
+    sp_gru_scan,
+    sp_gru_scan_pipelined,
+)
 
 __all__ = [
     "build_mesh",
@@ -27,5 +32,6 @@ __all__ = [
     "shift_right",
     "make_sp_forward",
     "sp_gru_scan",
+    "sp_gru_scan_pipelined",
     "sp_bigru_layer",
 ]

@@ -11,9 +11,9 @@ with ``ppermute`` (SURVEY.md §5 "long-context" / §7 hard part (b)):
 - the recurrence is inherently serial across blocks, so the scan runs as
   ``sp`` pipelined stages: at stage k, device k's block scan is the valid
   one, and its final carry is ppermuted to device k+1 for stage k+1.  The
-  other devices' stage-k scans are discarded (the classic pipeline bubble;
-  microbatch staggering can fill it later — the projection savings already
-  dominate for wide features);
+  plain :func:`sp_gru_scan` discards the other devices' stage-k scans (the
+  classic pipeline bubble); :func:`sp_gru_scan_pipelined` fills it by
+  staggering microbatches through the stages;
 - the pooling head reduces locally then crosses the axis with
   ``pmax``/``psum``, so no device ever materialises the full sequence.
 
@@ -95,17 +95,112 @@ def sp_gru_scan(
     return h_last, hs_local
 
 
+def sp_gru_scan_pipelined(
+    xp_local: jax.Array,
+    h0: jax.Array,
+    w_hh: jax.Array,
+    b_hh: jax.Array,
+    axis_name: str,
+    *,
+    n_microbatches: int,
+    reverse: bool = False,
+    vary_axes: Optional[Tuple[str, ...]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Microbatch-pipelined time-sharded recurrence.
+
+    :func:`sp_gru_scan` serializes completely: at stage k only device k's
+    scan is valid, so the recurrence gets *no* speedup from sp.  Splitting
+    the batch into ``M`` microbatches staggers the pipeline — at stage
+    ``s``, device ``k`` scans microbatch ``s - k`` while its neighbor scans
+    the previous one — giving ``sp * M / (sp + M - 1)`` useful-work ratio
+    (≈ sp/2 at M = sp) instead of 1.
+
+    The carry register is single: device k's stage-s output carry belongs
+    to microbatch ``s - k``, and after the neighbor shift device k+1 at
+    stage s+1 needs exactly that microbatch's carry.
+
+    Constraints: batch divisible by ``n_microbatches``.
+    Returns the same (h_last, hs_local) as :func:`sp_gru_scan`.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    batch = xp_local.shape[0]
+    if batch % n_microbatches != 0:
+        raise ValueError(
+            f"local (per-dp-shard) batch {batch} not divisible by "
+            f"n_microbatches {n_microbatches}"
+        )
+    mbs = batch // n_microbatches
+    hidden = w_hh.shape[-1]
+
+    h0 = jax.lax.pcast(h0, vary_axes or (axis_name,), to="varying")
+    fill = h0[:mbs]  # shape donor only; slot-0 devices override with h0 slices
+
+    stage_pos = (n - 1 - idx) if reverse else idx  # device's pipeline slot
+    carry = fill
+    hs_local = jnp.zeros(
+        (batch,) + xp_local.shape[1:2] + (hidden,), xp_local.dtype
+    )
+    h_final = jnp.zeros((batch, hidden), xp_local.dtype)
+
+    for s in range(n + n_microbatches - 1):  # static stage count
+        mb = s - stage_pos  # traced: which microbatch this device handles
+        active = (mb >= 0) & (mb < n_microbatches)
+        mb_c = jnp.clip(mb, 0, n_microbatches - 1)
+        start = mb_c * mbs
+        xp_mb = jax.lax.dynamic_slice_in_dim(xp_local, start, mbs, axis=0)
+        # first pipeline slot seeds each fresh microbatch with ITS h0 rows
+        h0_mb = jax.lax.dynamic_slice_in_dim(h0, start, mbs, axis=0)
+        carry_in = jnp.where(stage_pos == 0, h0_mb, carry)
+        h_out, ys = gru_scan(xp_mb, carry_in, w_hh, b_hh, reverse=reverse)
+        # Mask the slice, then update unconditionally: inactive stages write
+        # back what they read (identity), keeping the dynamic_update_slice
+        # in-place instead of forcing a full-buffer select per stage.
+        ys_masked = jnp.where(
+            active,
+            ys,
+            jax.lax.dynamic_slice_in_dim(hs_local, start, mbs, axis=0),
+        )
+        hs_local = jax.lax.dynamic_update_slice_in_dim(
+            hs_local, ys_masked, start, axis=0
+        )
+        h_out_masked = jnp.where(
+            active,
+            h_out,
+            jax.lax.dynamic_slice_in_dim(h_final, start, mbs, axis=0),
+        )
+        h_final = jax.lax.dynamic_update_slice_in_dim(
+            h_final, h_out_masked, start, axis=0
+        )
+        if s < n + n_microbatches - 2:
+            if reverse:
+                carry = shift_left(h_out, axis_name, fill=fill)
+            else:
+                carry = shift_right(h_out, axis_name, fill=fill)
+
+    # final hidden of the whole sequence lives on the last pipeline slot
+    last_dev = 0 if reverse else n - 1
+    h_last = all_reduce_sum(
+        jnp.where(idx == last_dev, h_final, jnp.zeros_like(h_final)),
+        axis_name,
+    )
+    return h_last, hs_local
+
+
 def sp_bigru_layer(
     x_local: jax.Array,
     weights_fwd: GRUWeights,
     weights_bwd: Optional[GRUWeights],
     axis_name: str,
     vary_axes: Optional[Tuple[str, ...]] = None,
+    n_microbatches: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """One (bi)GRU layer over a time-sharded input block.
 
     The input projection — the MXU-heavy part — is computed on the local
-    block only; the serial recurrence uses :func:`sp_gru_scan`.
+    block only.  The recurrence uses :func:`sp_gru_scan` by default, or
+    :func:`sp_gru_scan_pipelined` when ``n_microbatches > 1`` (bubble-filling
+    staggered pipeline; local batch must be divisible by it).
 
     Returns (last_hidden_sum, gru_out_local): the direction-summed global
     final hidden (B, H) and the direction-summed local outputs
@@ -115,18 +210,26 @@ def sp_bigru_layer(
     hidden = weights_fwd.w_hh.shape[-1]
     h0 = jnp.zeros((batch, hidden), x_local.dtype)
 
+    if n_microbatches > 1:
+        def scan(xp, w, b, reverse):
+            return sp_gru_scan_pipelined(
+                xp, h0, w, b, axis_name,
+                n_microbatches=n_microbatches, reverse=reverse,
+                vary_axes=vary_axes,
+            )
+    else:
+        def scan(xp, w, b, reverse):
+            return sp_gru_scan(
+                xp, h0, w, b, axis_name, reverse=reverse,
+                vary_axes=vary_axes,
+            )
+
     xp_f = input_projection(x_local, weights_fwd)
-    h_last_f, hs_f = sp_gru_scan(
-        xp_f, h0, weights_fwd.w_hh, weights_fwd.b_hh, axis_name,
-        vary_axes=vary_axes,
-    )
+    h_last_f, hs_f = scan(xp_f, weights_fwd.w_hh, weights_fwd.b_hh, False)
     if weights_bwd is None:
         return h_last_f, hs_f
     xp_b = input_projection(x_local, weights_bwd)
-    h_last_b, hs_b = sp_gru_scan(
-        xp_b, h0, weights_bwd.w_hh, weights_bwd.b_hh, axis_name, reverse=True,
-        vary_axes=vary_axes,
-    )
+    h_last_b, hs_b = scan(xp_b, weights_bwd.w_hh, weights_bwd.b_hh, True)
     return h_last_f + h_last_b, hs_f + hs_b
 
 
@@ -146,6 +249,7 @@ def sp_bigru_apply(
     axis_name: str,
     seq_len: int,
     vary_axes: Optional[Tuple[str, ...]] = None,
+    n_microbatches: int = 1,
 ) -> jax.Array:
     """The flagship single-layer BiGRU forward with the pool-concat head,
     sequence-sharded (shard_map body).  Matches ``BiGRU.__call__``
@@ -155,7 +259,8 @@ def sp_bigru_apply(
     w_f = _weights_from_params(params, "l0")
     w_b = _weights_from_params(params, "l0_reverse") if cfg.bidirectional else None
     last_hidden, gru_out_local = sp_bigru_layer(
-        x_local, w_f, w_b, axis_name, vary_axes=vary_axes
+        x_local, w_f, w_b, axis_name, vary_axes=vary_axes,
+        n_microbatches=n_microbatches,
     )
 
     # Pool head across the sharded time axis: local reduce + collective.
@@ -178,11 +283,14 @@ def make_sp_forward(
     *,
     dp_axis: str = "dp",
     sp_axis: str = "sp",
+    n_microbatches: int = 1,
 ):
     """Jit-ready sequence-parallel forward over a (dp, sp) mesh.
 
     Input x: (B, T, F) sharded (dp, sp); params replicated; output logits
-    (B, out) sharded over dp only.
+    (B, out) sharded over dp only.  ``n_microbatches > 1`` switches the
+    recurrence to the pipelined scan (fills the serial bubble; the local
+    batch must be divisible by it).
     """
 
     @functools.partial(
@@ -198,6 +306,7 @@ def make_sp_forward(
         return sp_bigru_apply(
             params, x_local, cfg, sp_axis, seq_len,
             vary_axes=(dp_axis, sp_axis),
+            n_microbatches=n_microbatches,
         )
 
     return forward

@@ -70,6 +70,56 @@ def test_sp_gru_scan_matches_single_device(reverse):
     np.testing.assert_allclose(np.asarray(hs), np.asarray(hs_ref), atol=1e-5)
 
 
+@pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.parametrize("n_micro", [2, 4])
+def test_sp_pipelined_scan_matches_single_device(reverse, n_micro):
+    """Microbatch-pipelined sharded scan == plain scan."""
+    from fmda_tpu.parallel import sp_gru_scan_pipelined
+
+    mesh = build_mesh(MeshConfig(dp=1, sp=8))
+    batch, seq, feats, hidden = 8, 32, 6, 8
+    w = _random_weights(jax.random.PRNGKey(10), feats, hidden)
+    x = jax.random.normal(jax.random.PRNGKey(11), (batch, seq, feats))
+    h0 = jax.random.normal(jax.random.PRNGKey(12), (batch, hidden)) * 0.3
+
+    h_last_ref, hs_ref = gru_layer(x, w, h0, reverse=reverse)
+
+    @jax.jit
+    @lambda f: jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P(), P(None, "sp")),
+        out_specs=(P(), P(None, "sp")), check_vma=False,
+    )
+    def sharded(w_, h0_, x_local):
+        xp = input_projection(x_local, w_)
+        return sp_gru_scan_pipelined(
+            xp, h0_, w_.w_hh, w_.b_hh, "sp",
+            n_microbatches=n_micro, reverse=reverse,
+        )
+
+    x_sharded = jax.device_put(x, NamedSharding(mesh, P(None, "sp")))
+    h_last, hs = sharded(w, h0, x_sharded)
+    np.testing.assert_allclose(
+        np.asarray(h_last), np.asarray(h_last_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(hs_ref), atol=1e-5)
+
+
+def test_sp_forward_pipelined_matches_model():
+    cfg = ModelConfig(hidden_size=12, n_features=7, output_size=4,
+                      dropout=0.0, use_pallas=False)
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    batch, seq = 8, 24
+    model = BiGRU(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(13), (batch, seq, cfg.n_features))
+    variables = model.init({"params": jax.random.PRNGKey(14)}, x)
+    expected = model.apply(variables, x)
+
+    forward = jax.jit(make_sp_forward(mesh, cfg, seq, n_microbatches=2))
+    x_sharded = jax.device_put(x, NamedSharding(mesh, P("dp", "sp")))
+    logits = forward(variables["params"], x_sharded)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(expected), atol=1e-5)
+
+
 def test_sp_forward_matches_model():
     """Sequence-parallel flagship forward == BiGRU.apply on one device."""
     cfg = ModelConfig(hidden_size=16, n_features=10, output_size=4,
