@@ -33,10 +33,21 @@ __version__ = "0.1.0"
 
 from fmda_tpu.config import FrameworkConfig, FeatureConfig, BusConfig, ModelConfig
 
+
+def __getattr__(name):
+    # Application pulls in the streaming stack; keep `import fmda_tpu` light.
+    if name == "Application":
+        from fmda_tpu.app import Application
+
+        return Application
+    raise AttributeError(name)
+
+
 __all__ = [
     "FrameworkConfig",
     "FeatureConfig",
     "BusConfig",
     "ModelConfig",
+    "Application",
     "__version__",
 ]

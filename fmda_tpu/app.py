@@ -1,0 +1,157 @@
+"""Application: the framework's single composition root.
+
+The reference has no entry point — five scripts started by hand in the
+right order against externally administered Kafka/Spark/MariaDB processes
+(README.md:186-292).  :class:`Application` builds the whole stack from one
+:class:`~fmda_tpu.config.FrameworkConfig`:
+
+    app = Application(FrameworkConfig())
+    app.attach_session(iex=..., alpha_vantage=..., calendar=...)  # L1
+    app.run_ticks(...)            # acquire -> join -> land -> signal
+    state, history, ds = app.train()                              # L5 train
+    app.attach_predictor_from_checkpoint(ckpt, window=30)         # L5 serve
+
+Backends are swappable: the bus defaults to the native C++ ring buffer
+(falls back to the Python bus without a toolchain), the warehouse to
+embedded SQLite; Kafka/MariaDB adapters slot in for deployment parity.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from fmda_tpu.config import FrameworkConfig
+from fmda_tpu.stream.bus import InProcessBus, MessageBus
+from fmda_tpu.stream.engine import StreamEngine
+from fmda_tpu.stream.warehouse import Warehouse
+
+log = logging.getLogger("fmda_tpu")
+
+
+def default_bus(config: FrameworkConfig) -> MessageBus:
+    """Native C++ ring-buffer bus when buildable, Python bus otherwise."""
+    try:
+        from fmda_tpu.stream.native_bus import NativeBus, native_available
+
+        if native_available():
+            return NativeBus(
+                config.bus.topics, max_records=config.bus.capacity
+            )
+    except Exception as e:  # noqa: BLE001 — fall back, never fail startup
+        log.warning("native bus unavailable (%s); using InProcessBus", e)
+    return InProcessBus(config.bus.topics, capacity=config.bus.capacity)
+
+
+class Application:
+    """Composition root wiring bus + warehouse + engine (+ session/serving)."""
+
+    def __init__(
+        self,
+        config: Optional[FrameworkConfig] = None,
+        *,
+        bus: Optional[MessageBus] = None,
+        warehouse: Optional[Warehouse] = None,
+        engine_checkpoint: Optional[str] = None,
+    ) -> None:
+        self.config = config or FrameworkConfig()
+        self.bus = bus if bus is not None else default_bus(self.config)
+        self.warehouse = (
+            warehouse
+            if warehouse is not None
+            else Warehouse(self.config.features, self.config.warehouse)
+        )
+        self.engine = StreamEngine(
+            self.bus,
+            self.warehouse,
+            self.config.features,
+            checkpoint_path=engine_checkpoint,
+        )
+        self.session = None
+        self.predictors: List = []
+
+    # -- L1: acquisition ------------------------------------------------------
+
+    def attach_session(self, **clients) -> "SessionDriver":
+        """Create the ingestion session driver; keyword args are the client
+        objects accepted by :class:`~fmda_tpu.ingest.session.SessionDriver`
+        (iex, alpha_vantage, calendar, indicator_scraper, vix_scraper,
+        cot_scraper, now_fn, sleep_fn)."""
+        from fmda_tpu.ingest.session import SessionDriver
+
+        self.session = SessionDriver(self.bus, self.config.session, **clients)
+        return self.session
+
+    # -- L5: serving ----------------------------------------------------------
+
+    def attach_predictor_from_checkpoint(
+        self, checkpoint_path: str, *, window: int, **kwargs
+    ):
+        """Window-re-scan predictor bound to this app's bus + warehouse."""
+        from fmda_tpu.serve.predictor import Predictor
+
+        predictor = Predictor.from_checkpoint(
+            checkpoint_path,
+            self.bus,
+            self.warehouse,
+            self.config.model,
+            window=window,
+            **kwargs,
+        )
+        self.predictors.append(predictor)
+        return predictor
+
+    def attach_streaming_predictor(self, core, **kwargs):
+        """O(1) carried-state predictor (unidirectional models)."""
+        from fmda_tpu.serve.streaming import StreamingPredictor
+
+        predictor = StreamingPredictor(self.bus, self.warehouse, core, **kwargs)
+        self.predictors.append(predictor)
+        return predictor
+
+    # -- the loop -------------------------------------------------------------
+
+    def run_tick(self) -> Dict[str, int]:
+        """One full cycle: acquire (if a session is attached) -> engine
+        micro-batch -> serve all attached predictors."""
+        if self.session is not None:
+            self.session.run_tick()
+        emitted = self.engine.step()
+        served = 0
+        for predictor in self.predictors:
+            served += len(predictor.poll())
+        return {"emitted": emitted, "served": served}
+
+    def run_ticks(self, n: int) -> Dict[str, int]:
+        totals = {"emitted": 0, "served": 0}
+        for _ in range(n):
+            out = self.run_tick()
+            totals["emitted"] += out["emitted"]
+            totals["served"] += out["served"]
+        return totals
+
+    # -- L5: training ---------------------------------------------------------
+
+    def train(self, *, weight=None, pos_weight=None, mesh=None, **fit_kwargs):
+        """Train the configured model on this app's warehouse."""
+        from fmda_tpu.train.trainer import Trainer, imbalance_weights_from_source
+
+        if weight is None and pos_weight is None:
+            weight, pos_weight = imbalance_weights_from_source(self.warehouse)
+        trainer = Trainer(
+            self.config.model,
+            self.config.train,
+            weight=weight,
+            pos_weight=pos_weight,
+            mesh=mesh,
+        )
+        return trainer.fit(
+            self.warehouse,
+            bid_levels=self.config.features.bid_levels,
+            ask_levels=self.config.features.ask_levels,
+            **fit_kwargs,
+        )
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return {**self.engine.stats, "warehouse_rows": len(self.warehouse)}

@@ -32,11 +32,14 @@ def make_sp_train_step(
     pos_weight: Optional[jax.Array] = None,
     dp_axis: str = "dp",
     sp_axis: str = "sp",
+    n_microbatches: int = 1,
 ):
     """Returns ``step(params, opt_state, x, y) -> (params, opt_state, loss)``
-    jitted over the mesh."""
+    jitted over the mesh.  ``n_microbatches > 1`` runs the bubble-filling
+    pipelined recurrence (per-dp-shard batch must be divisible by it)."""
     forward = make_sp_forward(
-        mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis
+        mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
+        n_microbatches=n_microbatches,
     )
 
     @jax.jit

@@ -235,6 +235,11 @@ class Trainer:
         )
         n_classes = self.model_cfg.output_size
         if not results:
+            log.warning(
+                "pass produced no batches (source too short for "
+                "window=%d/chunk_size=%d, or empty chunk split) — metrics "
+                "are NaN", self.train_cfg.window, self.train_cfg.chunk_size,
+            )
             nan = float("nan")
             return (
                 state,

@@ -1,0 +1,158 @@
+"""Application composition root: the full framework loop from one config."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+import jax
+
+from fmda_tpu import Application
+from fmda_tpu.config import (
+    FrameworkConfig,
+    ModelConfig,
+    TrainConfig,
+    WarehouseConfig,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.serve import StreamingBiGRU
+
+from test_stream import _small_features
+
+
+def _app_config(**train_kw):
+    fc = _small_features(get_cot=False)
+    base = dict(batch_size=8, window=3, chunk_size=20, epochs=1)
+    base.update(train_kw)
+    return FrameworkConfig(
+        features=fc,
+        warehouse=WarehouseConfig(path=":memory:"),
+        model=ModelConfig(hidden_size=4, dropout=0.0, use_pallas=False),
+        train=TrainConfig(**base),
+    )
+
+
+class _FakeSessionClients:
+    """Deterministic stand-ins for the ingestion clients."""
+
+    def __init__(self, fc):
+        self.fc = fc
+        self.tick = 0
+
+    def make(self, app):
+        import json
+
+        from fmda_tpu.ingest import (
+            AlphaVantageClient,
+            IEXClient,
+            TradierCalendarClient,
+            VIXScraper,
+        )
+
+        outer = self
+
+        class T:  # transport serving evolving synthetic responses
+            def get(self, url, headers=None):
+                i = outer.tick
+                ts = outer.now().strftime("%Y-%m-%d %H:%M:%S")
+                if "deep/book" in url:
+                    book = {
+                        "bids": [{"price": 100.0 - l * 0.1 + i, "size": 50 + l}
+                                 for l in range(outer.fc.bid_levels)],
+                        "asks": [{"price": 100.2 + l * 0.1 + i, "size": 40 + l}
+                                 for l in range(outer.fc.ask_levels)],
+                    }
+                    return json.dumps({"SPY": book}).encode()
+                if "alphavantage" in url:
+                    return json.dumps({"Meta Data": {}, "S": {ts: {
+                        "1. open": f"{100 + i}", "2. high": f"{101 + i}",
+                        "3. low": f"{99 + i}", "4. close": f"{100.5 + i}",
+                        "5. volume": "1000"}}}).encode()
+                if "calendar" in url:
+                    return json.dumps({"calendar": {"days": {"day": [
+                        {"date": outer.now().strftime("%Y-%m-%d"),
+                         "status": "open",
+                         "open": {"start": "09:30", "end": "16:00"}}]}}}).encode()
+                if "cnbc" in url:
+                    return b'<span class="last original">16.0</span>'
+                raise ValueError(url)
+
+        t = T()
+        return dict(
+            iex=IEXClient("tok", t),
+            alpha_vantage=AlphaVantageClient("tok", t),
+            calendar=TradierCalendarClient("tok", t),
+            vix_scraper=VIXScraper(t),
+            now_fn=self.now,
+        )
+
+    def now(self):
+        return dt.datetime(2020, 2, 7, 9, 30, 0) + dt.timedelta(
+            minutes=5 * self.tick)
+
+
+def _publish_ind(app, fake):
+    """The small config has one event; publish the template per tick."""
+    msg = app.config.features.empty_ind_message()
+    msg["Timestamp"] = fake.now().strftime("%Y-%m-%d %H:%M:%S")
+    app.bus.publish("ind", msg)
+
+
+def test_application_full_loop():
+    cfg = _app_config()
+    app = Application(cfg)
+    fake = _FakeSessionClients(cfg.features)
+    app.attach_session(**fake.make(app))
+
+    for _ in range(30):
+        _publish_ind(app, fake)
+        app.run_tick()
+        fake.tick += 1
+    assert app.stats["warehouse_rows"] == 30
+    assert app.stats["dropped"] == 0
+
+    # train on what was acquired
+    state, history, dataset = app.train()
+    assert np.isfinite(history["train"][0].loss)
+
+    # attach the streaming predictor and serve live ticks
+    core = StreamingBiGRU(
+        ModelConfig(hidden_size=4, n_features=len(app.warehouse.x_fields),
+                    output_size=4, dropout=0.0, bidirectional=False,
+                    use_pallas=False),
+        _init_params(app, 4),
+        NormParams(np.zeros(len(app.warehouse.x_fields), np.float32),
+                   np.ones(len(app.warehouse.x_fields), np.float32)),
+        window=3,
+    )
+    app.attach_streaming_predictor(core, from_end=True)
+    for _ in range(3):
+        _publish_ind(app, fake)
+        out = app.run_tick()
+        fake.tick += 1
+    assert out["served"] == 1
+    assert app.stats["warehouse_rows"] == 33
+
+
+def _init_params(app, hidden):
+    from fmda_tpu.models.bigru import BiGRU
+
+    import jax.numpy as jnp
+
+    cfg = ModelConfig(hidden_size=hidden,
+                      n_features=len(app.warehouse.x_fields),
+                      output_size=4, dropout=0.0, bidirectional=False,
+                      use_pallas=False)
+    return BiGRU(cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 3, cfg.n_features)))["params"]
+
+
+def test_application_defaults_build():
+    app = Application()
+    assert app.stats["warehouse_rows"] == 0
+    assert len(app.warehouse.x_fields) == 108
+    # bus honors the configured topic set
+    app.bus.publish("deep", {"Timestamp": "2020-01-01 00:00:00"})
+    with pytest.raises(KeyError):
+        app.bus.publish("bogus", {})
