@@ -44,16 +44,27 @@ def chunk_norm_params(
 ) -> NormParams:
     """Compute one chunk's min/max stats with the reference's guards."""
     x = np.asarray(x, dtype=np.float64)
-    x_min = np.nanmin(x, axis=0)
-    x_max = np.nanmax(x, axis=0)
+    # Stats are consumed in float32 (the pipeline dtype) — cast BEFORE the
+    # degenerate-range guard, else a range that underflows to zero in
+    # float32 slips past an exact-equality check done in float64 and
+    # normalization divides by zero.
+    x_min = np.nanmin(x, axis=0).astype(np.float32)
+    x_max = np.nanmax(x, axis=0).astype(np.float32)
 
     # Jitter guard: normalization needs MIN != MAX
     # (sql_pytorch_dataloader.py:108-113).
-    degenerate = x_min == x_max
+    degenerate = (x_max - x_min) == 0
     x_max = np.where(
-        degenerate & (x_max != 0), x_max + x_max * 0.001, x_max
+        degenerate & (x_max != 0),
+        x_max + x_max * np.float32(0.001),
+        x_max,
     )
-    x_max = np.where(degenerate & (x_max == 0), 0.001, x_max)
+    x_max = np.where(degenerate & (x_max == 0), np.float32(0.001), x_max)
+    # Subnormal constants (e.g. 1e-44) defeat the multiplicative jitter in
+    # float32 (x * 1.001 rounds back to x); fall back to an absolute bump.
+    x_max = np.where(
+        (x_max - x_min) == 0, x_min + np.float32(0.001), x_max
+    )
 
     # Book-wide shared stats across size columns of each side
     # (sql_pytorch_dataloader.py:119-144; gated on the book being present).
@@ -65,9 +76,7 @@ def chunk_norm_params(
                 x_min[idx] = x_min[idx].min()
                 x_max[idx] = x_max[idx].max()
 
-    return NormParams(
-        x_min.astype(np.float32), x_max.astype(np.float32)
-    )
+    return NormParams(x_min, x_max)
 
 
 def normalize(x: np.ndarray, params: NormParams) -> np.ndarray:

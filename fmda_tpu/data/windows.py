@@ -28,6 +28,13 @@ def chunk_ranges(db_length: int, chunk_size: int, window: int) -> List[range]:
             f"window ({window}) must be smaller than the source length "
             f"({db_length})"
         )
+    if window >= chunk_size and db_length >= chunk_size:
+        # chunk 0 would be empty and no chunk's own region could hold a
+        # full window (the reference implicitly assumes window < chunk_size)
+        raise ValueError(
+            f"window ({window}) must be smaller than chunk_size "
+            f"({chunk_size})"
+        )
     num_chunks = db_length // chunk_size
     if num_chunks == 0:
         # Source shorter than one chunk: a single chunk covering everything

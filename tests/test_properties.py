@@ -1,0 +1,153 @@
+"""Property-based tests (hypothesis) for the framework's core invariants."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from fmda_tpu.data.normalize import chunk_norm_params, normalize
+from fmda_tpu.data.windows import chunk_ranges, train_val_test_split, window_index_matrix
+from fmda_tpu.ops.indicators import (
+    rolling_max,
+    rolling_mean,
+    rolling_min,
+    rolling_std,
+)
+from fmda_tpu.stream.bus import InProcessBus
+
+SETTINGS = dict(max_examples=40, deadline=None)
+
+
+# ------------------------------------------------------------- rolling ops
+
+
+@given(
+    series=st.lists(
+        st.floats(min_value=-1e4, max_value=1e4, allow_nan=False),
+        min_size=1, max_size=60,
+    ),
+    rows=st.integers(min_value=1, max_value=25),
+)
+@settings(**SETTINGS)
+def test_rolling_ops_match_sql_frames(series, rows):
+    x = np.asarray(series, np.float64)
+
+    def frame(i):
+        return x[max(0, i - rows + 1): i + 1]
+
+    mean = rolling_mean(x, rows)
+    std = rolling_std(x, rows)
+    lo = rolling_min(x, rows)
+    hi = rolling_max(x, rows)
+    for i in range(len(x)):
+        f = frame(i)
+        assert mean[i] == pytest.approx(f.mean(), rel=1e-9, abs=1e-9)
+        assert std[i] == pytest.approx(f.std(), rel=1e-7, abs=1e-7)
+        assert lo[i] == f.min() and hi[i] == f.max()
+
+
+# ------------------------------------------------------------- chunk math
+
+
+@given(
+    db_length=st.integers(min_value=10, max_value=2000),
+    chunk_size=st.integers(min_value=5, max_value=300),
+    window=st.integers(min_value=1, max_value=9),
+)
+@settings(**SETTINGS)
+def test_chunk_ranges_cover_all_servable_ids(db_length, chunk_size, window):
+    if window >= chunk_size or window >= db_length:
+        with pytest.raises(ValueError):
+            chunk_ranges(db_length, chunk_size, window)
+        return
+    ranges = chunk_ranges(db_length, chunk_size, window)
+    # every id from `window` to db_length appears in at least one chunk,
+    # and every chunk lies within [1, db_length]
+    covered = set()
+    for r in ranges:
+        assert min(r) >= 1 and max(r) <= db_length
+        covered.update(r)
+    assert set(range(window, db_length + 1)) <= covered
+    # overlap stitching: chunk k (k>=1) starts window-1 rows before its
+    # "own" region, so every chunk after the first holds >= window rows
+    # (each own row has a full window inside the chunk)
+    for r in ranges[1:]:
+        assert len(list(r)) >= window
+
+
+@given(
+    n_chunks=st.integers(min_value=3, max_value=200),
+    val=st.floats(min_value=0.0, max_value=0.4),
+    test=st.floats(min_value=0.0, max_value=0.4),
+)
+@settings(**SETTINGS)
+def test_split_partitions_contiguously(n_chunks, val, test):
+    train, v, t = train_val_test_split(n_chunks, val, test)
+    ids = list(train) + list(v) + list(t)
+    assert ids == sorted(ids)
+    assert len(set(ids)) == len(ids)  # disjoint
+    assert set(ids) <= set(range(n_chunks))
+    assert list(train)  # training never empty
+
+
+@given(
+    n_rows=st.integers(min_value=0, max_value=100),
+    window=st.integers(min_value=1, max_value=20),
+)
+@settings(**SETTINGS)
+def test_window_matrix_shape_and_content(n_rows, window):
+    m = window_index_matrix(n_rows, window)
+    expected = max(n_rows - window + 1, 0)
+    assert m.shape == (expected, window)
+    if expected:
+        assert m[0, 0] == 0 and m[-1, -1] == n_rows - 1
+        assert (np.diff(m, axis=1) == 1).all()
+        assert (np.diff(m[:, 0]) == 1).all()
+
+
+# ------------------------------------------------------------- normalize
+
+
+@given(
+    data=st.lists(
+        st.lists(st.floats(min_value=-1e6, max_value=1e6, allow_nan=False),
+                 min_size=3, max_size=3),
+        min_size=2, max_size=50,
+    ),
+)
+@settings(**SETTINGS)
+def test_normalize_bounded_and_finite(data):
+    x = np.asarray(data, np.float64)
+    fields = ("a", "b", "c")
+    p = chunk_norm_params(x, fields)
+    z = normalize(x, p)
+    assert np.isfinite(z).all()
+    # in-chunk data lands in [0, 1] (tiny slack for the jitter guard)
+    assert z.min() >= -1e-6 and z.max() <= 1.0 + 1e-6
+
+
+# ------------------------------------------------------------- bus
+
+
+@given(
+    ops=st.lists(
+        st.tuples(st.sampled_from(["a", "b"]), st.integers(0, 1000)),
+        min_size=1, max_size=80,
+    ),
+    capacity=st.integers(min_value=1, max_value=30),
+)
+@settings(**SETTINGS)
+def test_bus_order_and_offsets_under_retention(ops, capacity):
+    bus = InProcessBus(["a", "b"], capacity=capacity)
+    published = {"a": [], "b": []}
+    for topic, value in ops:
+        off = bus.publish(topic, {"v": value})
+        published[topic].append((off, value))
+    for topic in ("a", "b"):
+        recs = bus.read(topic, 0)
+        # offsets strictly increasing, suffix of what was published
+        offsets = [r.offset for r in recs]
+        assert offsets == sorted(offsets)
+        assert len(recs) <= capacity
+        expect = published[topic][-len(recs):] if recs else []
+        assert [(r.offset, r.value["v"]) for r in recs] == expect
+        assert bus.end_offset(topic) == len(published[topic])
