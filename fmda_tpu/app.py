@@ -152,6 +152,42 @@ class Application:
             **fit_kwargs,
         )
 
+    def run_forever(
+        self,
+        *,
+        interval_s: float = 1.0,
+        max_restarts: int = 5,
+        sleep_fn=None,
+        should_stop=None,
+    ) -> None:
+        """Supervised serving loop: tick, sleep, repeat.
+
+        A crashing tick is logged and retried with exponential backoff up to
+        ``max_restarts`` consecutive failures (then re-raised) — the
+        elastic-recovery story the reference lacks (SURVEY.md §5: its only
+        recovery is a single 15s retry).  The engine checkpoint (if
+        configured) makes restarts resume exactly.
+        """
+        import time as _time
+
+        sleep_fn = sleep_fn or _time.sleep
+        failures = 0
+        while not (should_stop is not None and should_stop()):
+            try:
+                self.run_tick()
+                failures = 0
+                sleep_fn(interval_s)
+            except Exception:
+                failures += 1
+                log.exception(
+                    "tick failed (%d consecutive); %s",
+                    failures,
+                    "giving up" if failures > max_restarts else "backing off",
+                )
+                if failures > max_restarts:
+                    raise
+                sleep_fn(min(interval_s * (2**failures), 60.0))
+
     @property
     def stats(self) -> Dict[str, int]:
         return {**self.engine.stats, "warehouse_rows": len(self.warehouse)}

@@ -1,5 +1,11 @@
 from fmda_tpu.train.losses import class_weights, weighted_bce_with_logits
-from fmda_tpu.train.trainer import EpochMetrics, Trainer, TrainState
+from fmda_tpu.train.trainer import (
+    EpochMetrics,
+    Trainer,
+    TrainState,
+    imbalance_weights_from_source,
+)
+from fmda_tpu.train.multiticker import MultiTickerDataset
 from fmda_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
 
 __all__ = [
@@ -8,6 +14,8 @@ __all__ = [
     "Trainer",
     "TrainState",
     "EpochMetrics",
+    "imbalance_weights_from_source",
+    "MultiTickerDataset",
     "save_checkpoint",
     "restore_checkpoint",
 ]

@@ -148,6 +148,38 @@ def _init_params(app, hidden):
         jnp.zeros((1, 3, cfg.n_features)))["params"]
 
 
+def test_run_forever_supervision():
+    """Crashing ticks back off and recover; persistent failure re-raises."""
+    cfg = _app_config()
+    app = Application(cfg)
+    calls = {"n": 0}
+    sleeps = []
+
+    original = app.run_tick
+
+    def flaky_tick():
+        calls["n"] += 1
+        if calls["n"] in (2, 3):
+            raise RuntimeError("transient")
+        return original()
+
+    app.run_tick = flaky_tick
+    app.run_forever(
+        interval_s=1.0,
+        max_restarts=5,
+        sleep_fn=sleeps.append,
+        should_stop=lambda: calls["n"] >= 6,
+    )
+    assert calls["n"] >= 6
+    assert 2.0 in sleeps and 4.0 in sleeps  # exponential backoff on failures
+
+    # persistent failure gives up after max_restarts
+    app2 = Application(cfg)
+    app2.run_tick = lambda: (_ for _ in ()).throw(RuntimeError("down"))
+    with pytest.raises(RuntimeError, match="down"):
+        app2.run_forever(max_restarts=2, sleep_fn=lambda s: None)
+
+
 def test_application_defaults_build():
     app = Application()
     assert app.stats["warehouse_rows"] == 0

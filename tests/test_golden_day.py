@@ -1,0 +1,55 @@
+"""Golden-day regression: a recorded session (checked-in JSONL) must produce
+bit-stable warehouse features and targets through the whole streaming stack
+(SURVEY.md §4's golden-file strategy).  Guards every refactor of the engine,
+microstructure kernels, indicators, and warehouse against silent numeric
+drift."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fmda_tpu.config import DEFAULT_TOPICS, WarehouseConfig
+from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
+
+from test_stream import _small_features
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+@pytest.fixture
+def golden():
+    with open(os.path.join(DATA, "golden_day.jsonl")) as fh:
+        messages = [json.loads(line) for line in fh]
+    expected = np.load(os.path.join(DATA, "golden_day_expected.npz"),
+                       allow_pickle=False)
+    return messages, expected
+
+
+@pytest.mark.parametrize("backend", ["python", "native"])
+def test_golden_day_replay(golden, backend):
+    messages, expected = golden
+    fc = _small_features(get_cot=False)
+    if backend == "native":
+        from fmda_tpu.stream.native_bus import NativeBus, native_available
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable")
+        bus = NativeBus(DEFAULT_TOPICS)
+    else:
+        bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+
+    for msg in messages:
+        bus.publish(msg["topic"], msg["value"])
+    eng.step()
+
+    n = len(expected["x"])
+    assert len(wh) == n
+    assert tuple(expected["fields"]) == wh.x_fields
+    np.testing.assert_allclose(
+        wh.fetch(range(1, n + 1)), expected["x"], atol=1e-6)
+    np.testing.assert_allclose(
+        wh.fetch_targets(range(1, n + 1)), expected["y"], atol=0)
