@@ -1,4 +1,13 @@
+from fmda_tpu.serve.backtest import BacktestResult, backtest, backtest_from_checkpoint
 from fmda_tpu.serve.predictor import Prediction, Predictor
 from fmda_tpu.serve.streaming import StreamingBiGRU, StreamingPredictor
 
-__all__ = ["Prediction", "Predictor", "StreamingBiGRU", "StreamingPredictor"]
+__all__ = [
+    "Prediction",
+    "Predictor",
+    "StreamingBiGRU",
+    "StreamingPredictor",
+    "BacktestResult",
+    "backtest",
+    "backtest_from_checkpoint",
+]

@@ -1,0 +1,111 @@
+"""Backtesting: score a trained model over warehoused history.
+
+The reference has no way to evaluate served predictions against what the
+market actually did — its serving loop only prints probabilities
+(predict.py:190-197).  The backtester replays every servable row of a
+warehouse (or any FeatureSource) through the model exactly as serving
+would — trailing window, training norm stats — and scores the thresholded
+predictions against the realized ATR-scaled movement labels with the same
+in-graph metrics used in training.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fmda_tpu.config import ModelConfig
+from fmda_tpu.data.normalize import NormParams, normalize
+from fmda_tpu.data.source import FeatureSource
+from fmda_tpu.data.windows import window_index_matrix
+from fmda_tpu.models.bigru import BiGRU
+from fmda_tpu.ops.metrics import MultilabelMetrics, multilabel_metrics
+
+
+@dataclass(frozen=True)
+class BacktestResult:
+    metrics: MultilabelMetrics
+    probabilities: np.ndarray  # (n_served, n_classes)
+    targets: np.ndarray  # (n_served, n_classes)
+    first_row_id: int  # first servable row (1-based)
+
+
+def backtest(
+    source: FeatureSource,
+    model_cfg: ModelConfig,
+    params,
+    norm: NormParams,
+    *,
+    window: int,
+    threshold: float = 0.5,
+    beta: float = 0.5,
+    batch_size: int = 256,
+    ids: Optional[Tuple[int, int]] = None,
+) -> BacktestResult:
+    """Serve every row of ``source`` (or the inclusive 1-based id range
+    ``ids``) with the trailing-window model and score against realized
+    labels."""
+    n = len(source)
+    if ids is not None:
+        lo, hi = ids
+        if lo < window:
+            raise ValueError(
+                f"ids lower bound {lo} has no full trailing window "
+                f"(first servable row is {window})"
+            )
+    else:
+        lo, hi = window, n  # first row with a full trailing window
+    if hi > n or lo > hi:
+        raise ValueError(f"id range [{lo}, {hi}] invalid for source of {n} rows")
+
+    model = BiGRU(model_cfg)
+    forward = jax.jit(lambda p, x: model.apply({"params": p}, x))
+
+    # one gather covers all windows: rows [lo-window+1, hi]
+    base = lo - window + 1
+    rows = normalize(source.fetch(range(base, hi + 1)), norm)
+    widx = window_index_matrix(len(rows), window)
+    targets = source.fetch_targets(range(lo, hi + 1))
+
+    logits_out = []
+    for start in range(0, len(widx), batch_size):
+        xb = rows[widx[start : start + batch_size]]
+        logits_out.append(np.asarray(forward(params, jnp.asarray(xb))))
+    logits = (
+        np.concatenate(logits_out)
+        if logits_out
+        else np.zeros((0, model_cfg.output_size), np.float32)
+    )
+    probabilities = np.asarray(jax.nn.sigmoid(jnp.asarray(logits)))
+
+    metrics = multilabel_metrics(
+        jnp.asarray(logits), jnp.asarray(targets), threshold=threshold, beta=beta
+    )
+    return BacktestResult(
+        metrics=MultilabelMetrics(*(np.asarray(m) for m in metrics)),
+        probabilities=probabilities,
+        targets=np.asarray(targets),
+        first_row_id=lo,
+    )
+
+
+def backtest_from_checkpoint(
+    source: FeatureSource,
+    checkpoint_path: str,
+    model_cfg: ModelConfig,
+    *,
+    window: int,
+    **kwargs,
+) -> BacktestResult:
+    from fmda_tpu.train.checkpoint import restore_checkpoint
+
+    tree, norm = restore_checkpoint(checkpoint_path)
+    if norm is None:
+        raise ValueError(f"checkpoint {checkpoint_path} has no norm stats")
+    return backtest(
+        source, model_cfg, tree["params"], norm, window=window, **kwargs
+    )
