@@ -23,7 +23,6 @@ from fmda_tpu.ingest import (
     COTScraper,
     EconomicCalendarScraper,
     IEXClient,
-    ReplayTransport,
     SessionDriver,
     TradierCalendarClient,
     VIXScraper,

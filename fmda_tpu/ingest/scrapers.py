@@ -25,7 +25,7 @@ import json
 import logging
 import os
 import re
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 from fmda_tpu.config import FeatureConfig
 from fmda_tpu.ingest.htmldom import Element, parse_html

@@ -20,7 +20,7 @@ from __future__ import annotations
 import datetime as _dt
 import logging
 import time as _time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, Optional
 
 from fmda_tpu.config import (
     SessionConfig,

@@ -30,7 +30,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from fmda_tpu.config import ModelConfig
 from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection

@@ -10,11 +10,10 @@ just makes the batch dimension bigger, which is exactly what the MXU wants.
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, List, Tuple
 
-import numpy as np
-
-from fmda_tpu.data.pipeline import Batch, ChunkDataset, WindowBatches
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.data.pipeline import ChunkDataset, WindowBatches
 from fmda_tpu.data.source import FeatureSource
 
 
@@ -78,7 +77,7 @@ class MultiTickerDataset:
     ) -> WindowBatches:
         return WindowBatches(self.datasets[ticker], chunk_idx, batch_size)
 
-    def final_norm_params(self) -> Dict[str, "NormParams"]:
+    def final_norm_params(self) -> Dict[str, NormParams]:
         """Per-ticker serving norm stats (each instrument has its own
         scale; sharing one min/max across tickers would wash out FX vs
         equity magnitudes)."""

@@ -16,7 +16,6 @@ metrics averaged per epoch — but everything device-side:
 
 from __future__ import annotations
 
-import dataclasses
 import logging
 from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
 

@@ -8,7 +8,7 @@ epoch seconds and ``datetime`` objects.
 from __future__ import annotations
 
 import datetime as _dt
-from typing import Dict, Optional
+from typing import Dict
 
 try:  # stdlib zoneinfo needs tzdata on disk; fall back to pytz, then UTC.
     from zoneinfo import ZoneInfo

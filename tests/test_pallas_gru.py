@@ -7,7 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection, gru_layer
+from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection
 from fmda_tpu.ops.pallas_gru import gru_scan_pallas
 
 

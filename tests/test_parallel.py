@@ -1,8 +1,6 @@
 """Parallelism on the virtual 8-device CPU mesh: mesh construction, DP
 training equivalence, sequence-parallel scan correctness."""
 
-import dataclasses
-
 import numpy as np
 import pytest
 

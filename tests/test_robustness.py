@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 
 from fmda_tpu.config import DEFAULT_TOPICS, ModelConfig, WarehouseConfig
-from fmda_tpu.ingest.transport import ReplayTransport, RetryTransport, TransportError
+from fmda_tpu.ingest.transport import RetryTransport, TransportError
 from fmda_tpu.models.bigru import BiGRU
 from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
 

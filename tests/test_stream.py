@@ -1,7 +1,6 @@
 """Streaming core: bus semantics, warehouse, and the replay of a synthetic
 session through the full engine (the golden-file strategy from SURVEY.md §4)."""
 
-import dataclasses
 import datetime as dt
 
 import numpy as np
