@@ -89,7 +89,10 @@ def main():
     )
     # stream a fresh hour of ticks through the engine, serving each one
     served = 0
-    for topic, msg in synth_session(fc, 12, start="2020-02-07 15:00:00"):
+    # the 300 training ticks at 5-min cadence run through 2020-02-08 10:25;
+    # the live hour starts after them (a rewinding clock would trigger the
+    # warehouse's out-of-order full recompute on every tick)
+    for topic, msg in synth_session(fc, 12, start="2020-02-08 11:00:00"):
         bus.publish(topic, msg)
         if topic == TOPIC_COT:  # one full tick published
             engine.step()

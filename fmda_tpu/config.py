@@ -331,8 +331,11 @@ class ModelConfig:
     bidirectional: bool = True
     #: Compute dtype for the GRU/head; params are kept in float32.
     dtype: str = "float32"
-    #: Use the fused Pallas scan cell on TPU (falls back to lax.scan elsewhere).
-    use_pallas: bool = True
+    #: Use the fused Pallas scan cell on TPU (falls back to lax.scan
+    #: elsewhere).  Default off: the flagship default path must be the one
+    #: exercised everywhere; bench.py and TPU-gated tests opt in explicitly
+    #: (ADVICE r1 — flip the default once the kernel has a TPU CI job).
+    use_pallas: bool = False
     #: Rematerialise the recurrence in backward (jax.checkpoint): trades
     #: recompute FLOPs for HBM — enable for long-context windows.
     remat: bool = False

@@ -110,7 +110,13 @@ class RetryTransport:
 
 
 class RecordingTransport:
-    """Wrap a live transport and persist every response for later replay."""
+    """Wrap a live transport and persist every response for later replay.
+
+    Bodies are stored base64-encoded so binary/gzip responses survive the
+    round-trip bit-exact (a lossy ``errors='replace'`` decode would make
+    replay diverge from the live response), and the fixture file is written
+    once on :meth:`flush`/``close``/context exit, not per request.
+    """
 
     def __init__(self, inner: Transport, path: str) -> None:
         self.inner = inner
@@ -120,6 +126,33 @@ class RecordingTransport:
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         body = self.inner.get(url, headers)
         self.recorded[url] = body
-        with open(self.path, "w") as fh:
-            json.dump({u: b.decode("utf-8", "replace") for u, b in self.recorded.items()}, fh)
         return body
+
+    def flush(self) -> None:
+        import base64
+
+        with open(self.path, "w") as fh:
+            json.dump(
+                {
+                    u: base64.b64encode(b).decode("ascii")
+                    for u, b in self.recorded.items()
+                },
+                fh,
+            )
+
+    close = flush
+
+    def __enter__(self) -> "RecordingTransport":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.flush()
+
+    @staticmethod
+    def load_fixtures(path: str) -> Dict[str, bytes]:
+        """Read a recorded fixture file back into ReplayTransport form."""
+        import base64
+
+        with open(path) as fh:
+            raw = json.load(fh)
+        return {u: base64.b64decode(s) for u, s in raw.items()}

@@ -195,36 +195,55 @@ def all_view_sql(fc: FeatureConfig, table: str) -> List[str]:
     return out
 
 
+def join_select_fields(fc: FeatureConfig) -> List[str]:
+    """Select expressions of the canonical X-query, one per
+    ``fc.x_fields()`` entry, in the same order (the structured form of the
+    reference's introspected select list, create_database.py:240-241)."""
+    has_ohlc = bool(fc.get_stock_volume)
+    selects = [f"sd.`{c}`" for c in fc.table_columns()]
+    if has_ohlc and fc.bollinger_period and fc.bollinger_std:
+        selects += ["bb.upper_BB_dist", "bb.lower_BB_dist"]
+    if has_ohlc and fc.volume_ma_periods:
+        selects += [f"vol.vol_MA{p}" for p in fc.volume_ma_periods]
+    if has_ohlc and fc.price_ma_periods:
+        selects += [f"p.price_MA{p}" for p in fc.price_ma_periods]
+    if fc.delta_ma_periods:
+        selects += [f"d.delta_MA{p}" for p in fc.delta_ma_periods]
+    if has_ohlc and fc.stochastic_oscillator:
+        selects += ["so.stoch"]
+    if has_ohlc:
+        selects += ["ATR.ATR", "pc.price_change"]
+    return selects
+
+
+def join_from_clause(fc: FeatureConfig, table: str) -> str:
+    """FROM + JOIN clause of the canonical X-query (no trailing ';')."""
+    has_ohlc = bool(fc.get_stock_volume)
+    joins = []
+    if has_ohlc and fc.bollinger_period and fc.bollinger_std:
+        joins.append("JOIN bollinger_bands bb ON sd.Timestamp = bb.Timestamp")
+    if has_ohlc and fc.volume_ma_periods:
+        joins.append("JOIN vol_MA vol ON sd.Timestamp = vol.Timestamp")
+    if has_ohlc and fc.price_ma_periods:
+        joins.append("JOIN price_MA p ON sd.Timestamp = p.Timestamp")
+    if fc.delta_ma_periods:
+        joins.append("JOIN delta_MA d ON sd.Timestamp = d.Timestamp")
+    if has_ohlc and fc.stochastic_oscillator:
+        joins.append(
+            "JOIN stochastic_oscillator so ON sd.Timestamp = so.Timestamp")
+    if has_ohlc:
+        joins.append("JOIN ATR ON sd.Timestamp = ATR.Timestamp")
+        joins.append("JOIN price_change pc ON sd.Timestamp = pc.Timestamp")
+    return f"FROM {table} sd " + " ".join(joins)
+
+
 def join_statement_sql(fc: FeatureConfig, table: str) -> str:
     """The canonical X-query selecting every table + view column — the
     reference's ``join_statement`` (create_database.py:240-258), generated
     directly from config instead of DESCRIBE introspection."""
-    has_ohlc = bool(fc.get_stock_volume)
-    selects = [f"sd.`{c}`" for c in fc.table_columns()]
-    joins = []
-    if has_ohlc and fc.bollinger_period and fc.bollinger_std:
-        selects += ["bb.upper_BB_dist", "bb.lower_BB_dist"]
-        joins.append("JOIN bollinger_bands bb ON sd.Timestamp = bb.Timestamp")
-    if has_ohlc and fc.volume_ma_periods:
-        selects += [f"vol.vol_MA{p}" for p in fc.volume_ma_periods]
-        joins.append("JOIN vol_MA vol ON sd.Timestamp = vol.Timestamp")
-    if has_ohlc and fc.price_ma_periods:
-        selects += [f"p.price_MA{p}" for p in fc.price_ma_periods]
-        joins.append("JOIN price_MA p ON sd.Timestamp = p.Timestamp")
-    if fc.delta_ma_periods:
-        selects += [f"d.delta_MA{p}" for p in fc.delta_ma_periods]
-        joins.append("JOIN delta_MA d ON sd.Timestamp = d.Timestamp")
-    if has_ohlc and fc.stochastic_oscillator:
-        selects += ["so.stoch"]
-        joins.append(
-            "JOIN stochastic_oscillator so ON sd.Timestamp = so.Timestamp")
-    if has_ohlc:
-        selects += ["ATR.ATR", "pc.price_change"]
-        joins.append("JOIN ATR ON sd.Timestamp = ATR.Timestamp")
-        joins.append("JOIN price_change pc ON sd.Timestamp = pc.Timestamp")
     return (
-        "SELECT " + ", ".join(selects) + f" FROM {table} sd "
-        + " ".join(joins) + ";"
+        "SELECT " + ", ".join(join_select_fields(fc)) + " "
+        + join_from_clause(fc, table) + ";"
     )
 
 
@@ -268,7 +287,6 @@ class MySQLWarehouse:
         for stmt in all_view_sql(features, self.config.table_name):
             cur.execute(stmt)
         self._cursor = cur
-        self._join = join_statement_sql(features, self.config.table_name)
 
     @property
     def x_fields(self) -> Tuple[str, ...]:
@@ -280,24 +298,46 @@ class MySQLWarehouse:
         return int(self._cursor.fetchone()[0])
 
     def fetch(self, ids: Sequence[int]):
+        """Feature rows in the *requested id order* (multi-join row order is
+        otherwise unspecified — silently scrambled training windows on a
+        real server; ADVICE r1).  Raises on ids the warehouse doesn't have,
+        like the embedded Warehouse."""
         import numpy as np
 
+        ids = [int(i) for i in ids]
         fields = ", ".join(
-            f"IFNULL({f}, 0)"
-            for f in self._join.split("SELECT ")[1].split(" FROM ")[0].split(", ")
+            f"IFNULL({f}, 0)" for f in join_select_fields(self.features)
         )
-        from_part = "FROM " + self._join.split(" FROM ", 1)[1].rstrip(";")
         self._cursor.execute(
-            f"SELECT {fields} {from_part} WHERE sd.ID IN "
-            f"({', '.join(str(int(i)) for i in ids)});"
+            f"SELECT sd.ID, {fields} "
+            + join_from_clause(self.features, self.config.table_name)
+            + f" WHERE sd.ID IN ({', '.join(map(str, set(ids)))})"
+            " ORDER BY sd.ID;"
         )
-        return np.asarray(self._cursor.fetchall(), np.float32)
+        by_id = {int(r[0]): r[1:] for r in self._cursor.fetchall()}
+        missing = [i for i in ids if i not in by_id]
+        if missing:
+            raise IndexError(
+                f"warehouse has no rows for ids {missing[:10]}"
+                f"{'...' if len(missing) > 10 else ''}"
+            )
+        return np.asarray([by_id[i] for i in ids], np.float32)
 
     def fetch_targets(self, ids: Sequence[int]):
+        """Target labels in the requested id order (same contract as
+        :meth:`fetch`)."""
         import numpy as np
 
+        ids = [int(i) for i in ids]
         self._cursor.execute(
-            "SELECT up1, up2, down1, down2 FROM target WHERE ID IN "
-            f"({', '.join(str(int(i)) for i in ids)});"
+            "SELECT ID, up1, up2, down1, down2 FROM target WHERE ID IN "
+            f"({', '.join(map(str, set(ids)))}) ORDER BY ID;"
         )
-        return np.asarray(self._cursor.fetchall(), np.float32)
+        by_id = {int(r[0]): r[1:] for r in self._cursor.fetchall()}
+        missing = [i for i in ids if i not in by_id]
+        if missing:
+            raise IndexError(
+                f"target view has no rows for ids {missing[:10]}"
+                f"{'...' if len(missing) > 10 else ''}"
+            )
+        return np.asarray([by_id[i] for i in ids], np.float32)

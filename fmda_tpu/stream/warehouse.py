@@ -54,8 +54,19 @@ class Warehouse:
         self._create_table()
         # Incrementally-maintained caches: the raw table matrix plus the
         # derived views/targets, extended (not recomputed) as rows land.
+        # Derived views follow the reference's ``OVER (ORDER BY Timestamp)``
+        # window semantics (create_database.py:78-190), NOT insertion order:
+        # caches live in *timestamp-sorted* position space, with
+        # ``_sorted_idx`` (sorted position -> row index) / ``_rank`` (row
+        # index -> sorted position) translating to/from ID space.  Rows
+        # landing in order extend the caches incrementally; a late row
+        # triggers a full recompute over the sorted view (rare — the engine
+        # emits in commit order — and logged).
         self._cache_rows = 0
         self._matrix = np.empty((0, len(self._columns)), np.float64)
+        self._ts: List[str] = []
+        self._sorted_idx = np.empty(0, np.int64)
+        self._rank = np.empty(0, np.int64)
         self._derived: Dict[str, np.ndarray] = {
             c: np.empty(0, np.float64) for c in self.features.derived_columns()
         }
@@ -126,26 +137,38 @@ class Warehouse:
             ).fetchone()
         return None if row is None else int(row[0])
 
-    def _fetch_rows_after(self, row_id: int) -> np.ndarray:
+    def _fetch_rows_after(self, row_id: int) -> Tuple[np.ndarray, List[str]]:
         cols = ", ".join(_quote(c) for c in self._columns)
         with self._lock:
             rows = self._conn.execute(
-                f"SELECT {cols} FROM {self.table} WHERE ID > ? ORDER BY ID",
+                f"SELECT Timestamp, {cols} FROM {self.table} "
+                "WHERE ID > ? ORDER BY ID",
                 (row_id,),
             ).fetchall()
-        return np.asarray(rows, np.float64).reshape(len(rows), len(self._columns))
+        matrix = np.asarray(
+            [r[1:] for r in rows], np.float64
+        ).reshape(len(rows), len(self._columns))
+        return matrix, [r[0] or "" for r in rows]
 
     # -- derived views -------------------------------------------------------
 
     def _refresh_derived(self) -> None:
         """Extend the derived-view caches to cover newly landed rows.
 
-        Incremental: only the tail is recomputed.  Trailing-window views for
-        a row need at most ``max_lookback-1`` context rows before it; target
-        labels of the last ``max_lead`` cached rows can still change as LEAD
-        rows arrive, so the recompute region starts there.  Results are
-        bit-identical to a full recompute (verified in tests) at O(new+const)
-        per refresh instead of O(total).
+        Views are computed over *timestamp order* — the reference's
+        ``OVER (ORDER BY Timestamp)`` (create_database.py:78-190) — so a row
+        landing late (older timestamp than the newest cached row, e.g. a
+        pending engine join that matched after a newer row committed) cannot
+        permanently poison the rolling windows.
+
+        In-order arrivals take the incremental path: only the tail is
+        recomputed.  Trailing-window views for a row need at most
+        ``max_lookback-1`` context rows before it; target labels of the last
+        ``max_lead`` cached rows can still change as LEAD rows arrive, so the
+        recompute region starts there.  Results are bit-identical to a full
+        recompute (verified in tests) at O(new+const) per refresh instead of
+        O(total).  Out-of-order arrivals trigger a full recompute over the
+        sorted view (logged; rare — the engine emits in commit order).
 
         Caller must hold ``self._lock`` (writers mutate the shared caches;
         concurrent readers would otherwise observe torn state).
@@ -157,14 +180,48 @@ class Warehouse:
         if n < old_n:  # table replaced/truncated externally: full rebuild
             old_n = 0
             self._matrix = self._matrix[:0]
-        new_rows = self._fetch_rows_after(old_n)
+            self._ts = []
+            self._sorted_idx = self._sorted_idx[:0]
+            self._rank = self._rank[:0]
+        new_rows, new_ts = self._fetch_rows_after(old_n)
         self._matrix = np.concatenate([self._matrix, new_rows])
+        self._ts.extend(new_ts)
+
+        in_order = old_n == 0 or (
+            len(self._sorted_idx)
+            and min(new_ts) >= self._ts[self._sorted_idx[-1]]
+        )
+        # order among the new rows themselves: by (Timestamp, ID)
+        new_order = old_n + np.lexsort(
+            (np.arange(len(new_ts)), np.asarray(new_ts))
+        )
+        if in_order:
+            recompute_start = max(0, old_n - self.features.max_lead)
+            self._sorted_idx = np.concatenate([self._sorted_idx, new_order])
+            # incremental rank extension: new sorted positions are
+            # old_n..n-1, scattered to the new rows' insertion order
+            new_rank = np.empty(len(new_ts), np.int64)
+            new_rank[new_order - old_n] = np.arange(old_n, n)
+            self._rank = np.concatenate([self._rank, new_rank])
+        else:
+            import logging
+
+            logging.getLogger("fmda_tpu.stream").warning(
+                "out-of-timestamp-order row landed (new min ts %s < cached "
+                "max ts %s): full derived-view recompute over sorted order",
+                min(new_ts), self._ts[self._sorted_idx[-1]],
+            )
+            recompute_start = 0
+            self._sorted_idx = np.lexsort(
+                (np.arange(n), np.asarray(self._ts))
+            )
+            self._rank = np.empty(n, np.int64)
+            self._rank[self._sorted_idx] = np.arange(n)
 
         fc = self.features
-        recompute_start = max(0, old_n - fc.max_lead)
         context_start = max(0, recompute_start - (fc.max_lookback - 1))
-        sl = slice(context_start, n)
-        table = {c: self._matrix[sl, i] for i, c in enumerate(self._columns)}
+        rows = self._sorted_idx[context_start:n]
+        table = {c: self._matrix[rows, i] for i, c in enumerate(self._columns)}
         derived = derived_features(table, fc)
         offset = recompute_start - context_start
         for c in self.features.derived_columns():
@@ -201,8 +258,9 @@ class Warehouse:
             derived_cols = self.features.derived_columns()
             out = np.empty((len(idx), len(self.x_fields)), np.float64)
             out[:, : len(self._columns)] = self._matrix[idx]
+            pos = self._rank[idx]  # derived caches live in sorted-ts space
             for j, c in enumerate(derived_cols):
-                out[:, len(self._columns) + j] = self._derived[c][idx]
+                out[:, len(self._columns) + j] = self._derived[c][pos]
         return np.nan_to_num(out, nan=0.0).astype(np.float32)
 
     def fetch_targets(self, ids: Sequence[int]) -> np.ndarray:
@@ -218,7 +276,7 @@ class Warehouse:
             n = self._cache_rows
             if idx.size and (idx.min() < 0 or idx.max() >= n):
                 raise IndexError(f"row ids out of range 1..{n}")
-            return np.asarray(self._targets[idx], np.float32)
+            return np.asarray(self._targets[self._rank[idx]], np.float32)
 
     def close(self) -> None:
         self._conn.close()

@@ -1,0 +1,75 @@
+"""Process-environment helpers for robust backend selection.
+
+The TPU plugin is registered by a sitecustomize at interpreter start; a
+wedged accelerator tunnel then hangs ``jax.devices()`` forever (the round-1
+driver failure).  Entry points that must *never* hang (bench.py,
+__graft_entry__) therefore probe or force backends in throwaway
+subprocesses built from these environments instead of touching the ambient
+backend in-process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Optional
+
+#: Env vars whose presence triggers TPU-plugin registration at interpreter
+#: start; scrubbed when forcing the CPU platform.
+_TPU_TRIGGER_VARS = ("PALLAS_AXON_POOL_IPS", "TPU_WORKER_HOSTNAMES")
+
+
+def cpu_forced_env(
+    n_devices: Optional[int] = None, repo_dir: Optional[str] = None
+) -> Dict[str, str]:
+    """A child environment in which jax can only ever see the host CPU.
+
+    ``n_devices`` sets ``--xla_force_host_platform_device_count`` (replacing
+    any existing value) for virtual-mesh runs.  ``repo_dir`` is prepended to
+    ``PYTHONPATH`` — prepended, never replacing: the ambient path carries the
+    interpreter's sitecustomize.
+    """
+    env = dict(os.environ)
+    for var in _TPU_TRIGGER_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    if repo_dir is not None:
+        env["PYTHONPATH"] = repo_dir + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def probe_backend(timeout_s: float = 120.0) -> Dict:
+    """Ask a throwaway subprocess what the ambient jax backend is.
+
+    Returns ``{"backend", "n_devices", "device_kind"}`` or ``{"error": ...}``;
+    a hung TPU plugin costs ``timeout_s`` here instead of wedging the caller.
+    """
+    code = (
+        "import jax, json; d = jax.devices(); "
+        "print(json.dumps({'backend': jax.default_backend(), "
+        "'n_devices': len(d), 'device_kind': d[0].device_kind}))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": f"backend probe timed out after {timeout_s:.0f}s"}
+    if proc.returncode != 0:
+        return {"error": proc.stderr.decode(errors="replace")[-300:]}
+    try:
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except (IndexError, json.JSONDecodeError):
+        return {"error": "unparseable probe output"}

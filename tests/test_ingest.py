@@ -315,3 +315,22 @@ def test_session_feed_failure_isolated(caplog):
     assert results["deep"] and results["ind"] and not results["vix"]
     assert bus.end_offset(TOPIC_DEEP) == 1
     assert bus.end_offset(TOPIC_VIX) == 0
+
+
+def test_recording_transport_binary_roundtrip(tmp_path):
+    """Recorded bodies must replay bit-exact, including non-UTF-8 binary
+    (gzip etc.) — base64 persistence, written once on flush (ADVICE r1)."""
+    from fmda_tpu.ingest import RecordingTransport
+
+    binary = bytes(range(256)) * 3
+    inner = ReplayTransport({r"binary": binary, r"text": b'{"ok": 1}'})
+    path = tmp_path / "fixtures.json"
+    with RecordingTransport(inner, str(path)) as rec:
+        assert rec.get("https://x/binary") == binary
+        assert not path.exists()  # no per-request rewrite
+        rec.get("https://x/text")
+    fixtures = RecordingTransport.load_fixtures(str(path))
+    assert fixtures["https://x/binary"] == binary
+    replay = ReplayTransport(fixtures)
+    assert replay.get("https://x/binary") == binary
+    assert replay.get("https://x/text") == b'{"ok": 1}'

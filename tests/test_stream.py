@@ -149,6 +149,48 @@ def test_warehouse_incremental_derived_matches_full_recompute():
         streamed.fetch_targets(ids), bulk.fetch_targets(ids), atol=0)
 
 
+def test_warehouse_out_of_order_insert_sorts_derived_by_timestamp():
+    """Derived views follow OVER (ORDER BY Timestamp) — a row landing late
+    (older ts after a newer row committed) must yield the same per-timestamp
+    derived values as inserting everything in timestamp order
+    (create_database.py:78-190; ADVICE r1 medium)."""
+    fc = _small_features()
+    rng = np.random.default_rng(11)
+
+    def make_row(i):
+        row = {c: float(rng.uniform()) for c in fc.table_columns()}
+        row["Timestamp"] = f"2020-02-07 09:{30 + i:02d}:00"
+        row["4_close"] = 100.0 + float(rng.normal())
+        row["2_high"] = row["4_close"] + 1.0
+        row["3_low"] = row["4_close"] - 1.0
+        row["5_volume"] = float(rng.integers(100, 1000))
+        row["delta"] = float(rng.normal())
+        return row
+
+    rows = [make_row(i) for i in range(14)]
+    ordered = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    ordered.insert_rows(rows)
+
+    # same rows, but row 6 arrives three ticks late (engine pending-join)
+    late = rows[6]
+    shuffled = rows[:6] + rows[7:10] + [late] + rows[10:]
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    for row in shuffled:
+        wh.insert_rows([row])
+        wh.fetch([len(wh)])  # force incremental refresh mid-stream
+
+    # align by timestamp: warehouse id of each original row
+    ids = [wh.id_for_timestamp(r["Timestamp"]) for r in rows]
+    got_x = wh.fetch(ids)
+    want_x = ordered.fetch(range(1, len(rows) + 1))
+    derived_lo = len(fc.table_columns())
+    np.testing.assert_allclose(
+        got_x[:, derived_lo:], want_x[:, derived_lo:], atol=1e-12)
+    np.testing.assert_allclose(
+        wh.fetch_targets(ids), ordered.fetch_targets(range(1, len(rows) + 1)),
+        atol=0)
+
+
 def test_warehouse_volume_disabled_schema_narrows():
     fc = _small_features(get_stock_volume=None)
     wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
