@@ -138,9 +138,9 @@ def gru_layer(
         h0 = jnp.zeros((batch, hidden), dtype=x.dtype)
     xp = input_projection(x, weights)
     if use_pallas and mask is None and pallas_scan_available():
-        # The Pallas kernel's custom_vjp already rematerialises: backward
-        # stores only (xp, h0, W, b) and recomputes through the reference
-        # scan (pallas_gru._vjp_bwd), so `remat` is inherently satisfied.
+        # The Pallas kernel pair already rematerialises: the backward
+        # kernel stores only the forward outputs (hs) and recomputes the
+        # gates in-VMEM per step, so `remat` is inherently satisfied.
         from fmda_tpu.ops import pallas_gru
 
         return pallas_gru.gru_scan_pallas(

@@ -6,8 +6,15 @@ through XLA's loop machinery each step; this kernel instead keeps ``h``
 resident in a VMEM scratch buffer for the whole sequence and runs one grid
 step per timestep:
 
-- grid = (T,); grid steps execute sequentially on the TPU core, so VMEM
-  scratch legitimately carries state across steps;
+- grid = (T,) with ``dimension_semantics=("arbitrary",)``: grid steps
+  execute sequentially on the TPU core, so VMEM scratch legitimately
+  carries state across steps;
+- the sequence is laid out **time-major** ``(T, B, 3H)`` so each grid
+  step's block is ``(1, B, 3H)`` — its last two dims (B, 3H) satisfy
+  Mosaic's (8, 128)-divisible-or-full-dim tiling rule for any B % 8 == 0,
+  where the batch-major ``(B, 1, 3H)`` block (sublane dim 1) does not
+  lower at all (validated against the Mosaic TPU lowering via
+  jax.export);
 - per step: one (B,H) x (H,3H) matmul on the MXU (the input projection
   ``x @ W_ih^T`` is NOT in the kernel — it is a big batched matmul XLA
   already tiles perfectly, computed once outside; see fmda_tpu.ops.gru);
@@ -15,10 +22,24 @@ step per timestep:
 - ``reverse=True`` runs the same kernel with a mirrored time index map
   (for the backward direction of the bidirectional model).
 
+VMEM footprint per grid step is the block working set, independent of T:
+xp (B x 3H) + hs (B x H) + h scratch/h0/h_last (B x H each) + weights
+(H x 3H) ≈ 0.9 MB at the flagship B=256, H=32 in f32 — far inside the
+~16 MB/core budget; batch blocking only becomes necessary past B ~ 10k.
+
 Gate math and packing match :func:`fmda_tpu.ops.gru.gru_gates` exactly
 (torch-convention ``[r, z, n]``), verified in tests against the lax.scan
-path, including gradients (the VJP recomputes via the reference scan — the
-kernel is forward-only, wrapped in ``jax.custom_vjp``).
+path, including gradients.
+
+The backward pass is a Pallas kernel too (``_gru_bwd_kernel``): a
+reverse-processing-order grid that carries ``dh`` in VMEM scratch,
+*recomputes* the gates in-kernel from the saved ``hs`` (fused
+rematerialisation — residuals are just the forward outputs, no per-step
+gate storage in HBM), and accumulates the weight/bias gradients in VMEM
+output blocks revisited across all grid steps.  Per step it runs three
+MXU matmuls (gate recompute, ``dh`` chain through the recurrent weights,
+and the ``dW_hh`` outer-product accumulation) plus VPU gate algebra, so a
+full train step never leaves the fused path.
 """
 
 from __future__ import annotations
@@ -31,15 +52,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from fmda_tpu.ops import gru as gru_ref
-
 
 def _gru_step_kernel(
-    xp_ref,  # (B, 1, 3H) this timestep's input projection
+    xp_ref,  # (1, B, 3H) this timestep's input projection
     h0_ref,  # (B, H) initial hidden
     w_hh_t_ref,  # (H, 3H) recurrent weights, pre-transposed
     b_hh_ref,  # (1, 3H)
-    hs_ref,  # out: (B, 1, H) this timestep's hidden
+    hs_ref,  # out: (1, B, H) this timestep's hidden
     h_last_ref,  # out: (B, H) final hidden (written every step, last wins)
     h_scratch,  # VMEM carry (B, H)
 ):
@@ -51,7 +70,7 @@ def _gru_step_kernel(
 
     h = h_scratch[:]
     hidden = h.shape[-1]
-    xp_t = xp_ref[:, 0, :]
+    xp_t = xp_ref[0]
     hp = (
         jnp.dot(h, w_hh_t_ref[:], preferred_element_type=jnp.float32)
         + b_hh_ref[:]
@@ -62,7 +81,7 @@ def _gru_step_kernel(
     h_new = (1.0 - z) * n + z * h
 
     h_scratch[:] = h_new
-    hs_ref[:, 0, :] = h_new
+    hs_ref[0] = h_new
     h_last_ref[:] = h_new
 
 
@@ -79,34 +98,172 @@ def _gru_scan_pallas_fwd_impl(
     hidden = h0.shape[-1]
     w_hh_t = jnp.swapaxes(w_hh, 0, 1)  # (H, 3H): dot(h, w_hh_t)
     b_hh_2d = b_hh[None, :]
+    # time-major for the kernel: per-step blocks carry (B, 3H) in their
+    # last two dims, the only layout Mosaic can tile for B % 8 == 0
+    xp_tm = jnp.swapaxes(xp, 0, 1)  # (T, B, 3H)
 
-    # time index: step t touches xp[:, t] forward, xp[:, T-1-t] reversed
+    # time index: step t touches xp_tm[t] forward, xp_tm[T-1-t] reversed
     if reverse:
-        time_map = lambda t: (0, seq_len - 1 - t, 0)
+        time_map = lambda t: (seq_len - 1 - t, 0, 0)
     else:
-        time_map = lambda t: (0, t, 0)
+        time_map = lambda t: (t, 0, 0)
 
-    hs, h_last = pl.pallas_call(
+    hs_tm, h_last = pl.pallas_call(
         _gru_step_kernel,
         grid=(seq_len,),
         in_specs=[
-            pl.BlockSpec((batch, 1, 3 * hidden), time_map),
+            pl.BlockSpec((1, batch, 3 * hidden), time_map),
             pl.BlockSpec((batch, hidden), lambda t: (0, 0)),
             pl.BlockSpec((hidden, 3 * hidden), lambda t: (0, 0)),
             pl.BlockSpec((1, 3 * hidden), lambda t: (0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((batch, 1, hidden), time_map),
+            pl.BlockSpec((1, batch, hidden), time_map),
             pl.BlockSpec((batch, hidden), lambda t: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((batch, seq_len, hidden), xp.dtype),
+            jax.ShapeDtypeStruct((seq_len, batch, hidden), xp.dtype),
             jax.ShapeDtypeStruct((batch, hidden), xp.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((batch, hidden), xp.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
         interpret=interpret,
-    )(xp, h0.astype(xp.dtype), w_hh_t.astype(xp.dtype), b_hh_2d.astype(xp.dtype))
-    return hs, h_last
+    )(xp_tm, h0.astype(xp.dtype), w_hh_t.astype(xp.dtype), b_hh_2d.astype(xp.dtype))
+    return jnp.swapaxes(hs_tm, 0, 1), h_last
+
+
+def _gru_bwd_kernel(
+    xp_ref,  # (1, B, 3H) this timestep's input projection
+    hprev_ref,  # (1, B, H) hidden entering this step (h0 at the first step)
+    dhs_ref,  # (1, B, H) cotangent of this step's hs output
+    dhlast_ref,  # (B, H) cotangent of h_last
+    w_hh_ref,  # (3H, H) recurrent weights (for the dh chain)
+    w_hh_t_ref,  # (H, 3H) transposed (for the gate recompute)
+    b_hh_ref,  # (1, 3H)
+    dxp_ref,  # out: (1, B, 3H) grad of this timestep's input projection
+    dh0_ref,  # out: (B, H) grad of h0 (written every step, last wins)
+    dwt_ref,  # out: (H, 3H) grad of w_hh_t, accumulated across steps
+    db_ref,  # out: (1, 3H) grad of b_hh, accumulated across steps
+    dh_scratch,  # VMEM carry (B, H)
+):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dh_scratch[:] = dhlast_ref[:]
+        dwt_ref[:] = jnp.zeros_like(dwt_ref[:])
+        db_ref[:] = jnp.zeros_like(db_ref[:])
+
+    h_prev = hprev_ref[0]
+    xp_t = xp_ref[0]
+    hidden = h_prev.shape[-1]
+    f32 = jnp.float32
+
+    # gate recompute — identical math to the forward kernel
+    hp = (
+        jnp.dot(h_prev, w_hh_t_ref[:], preferred_element_type=f32)
+        + b_hh_ref[:]
+    ).astype(h_prev.dtype)
+    r = jax.nn.sigmoid(xp_t[:, :hidden] + hp[:, :hidden])
+    z = jax.nn.sigmoid(xp_t[:, hidden : 2 * hidden] + hp[:, hidden : 2 * hidden])
+    n = jnp.tanh(xp_t[:, 2 * hidden :] + r * hp[:, 2 * hidden :])
+
+    # h_t = (1-z)*n + z*h_prev
+    dh = dh_scratch[:] + dhs_ref[0]
+    dn = dh * (1.0 - z)
+    dz = dh * (h_prev - n)
+    dn_pre = dn * (1.0 - n * n)
+    dr = dn_pre * hp[:, 2 * hidden :]
+    dr_pre = dr * r * (1.0 - r)
+    dz_pre = dz * z * (1.0 - z)
+    # gradient w.r.t. the pre-activations: the x-projection sees dn_pre
+    # directly, the h-projection sees it through the reset gate
+    dg_x = jnp.concatenate([dr_pre, dz_pre, dn_pre], axis=-1)
+    dg_h = jnp.concatenate([dr_pre, dz_pre, dn_pre * r], axis=-1)
+
+    dxp_ref[0] = dg_x
+    dh_prev = dh * z + jnp.dot(
+        dg_h, w_hh_ref[:], preferred_element_type=f32
+    ).astype(dh.dtype)
+    dwt_ref[:] += jax.lax.dot_general(
+        h_prev, dg_h, (((0,), (0,)), ((), ())), preferred_element_type=f32
+    ).astype(dwt_ref.dtype)
+    db_ref[:] += jnp.sum(dg_h, axis=0, keepdims=True).astype(db_ref.dtype)
+    dh_scratch[:] = dh_prev
+    dh0_ref[:] = dh_prev
+
+
+def _gru_scan_pallas_bwd_impl(
+    xp, h0, w_hh, b_hh, hs, dh_last, dhs, *, reverse: bool, interpret: bool
+):
+    batch, seq_len, _ = xp.shape
+    hidden = h0.shape[-1]
+    dtype = xp.dtype
+    w_hh_t = jnp.swapaxes(w_hh, 0, 1)
+    b_hh_2d = b_hh[None, :]
+
+    # hidden state *entering* each timestep, in time order: h0 precedes the
+    # first-processed step (index 0 forward, T-1 reversed)
+    if reverse:
+        h_prev = jnp.concatenate([hs[:, 1:], h0[:, None]], axis=1)
+    else:
+        h_prev = jnp.concatenate([h0[:, None], hs[:, :-1]], axis=1)
+    xp_tm = jnp.swapaxes(xp, 0, 1)  # (T, B, 3H)
+    hprev_tm = jnp.swapaxes(h_prev, 0, 1)  # (T, B, H)
+    dhs_tm = jnp.swapaxes(dhs, 0, 1)  # (T, B, H)
+
+    # grid step i processes timesteps in reverse *processing* order
+    if reverse:
+        time_map = lambda i: (i, 0, 0)
+    else:
+        time_map = lambda i: (seq_len - 1 - i, 0, 0)
+
+    dxp_tm, dh0, dwt, db = pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=(seq_len,),
+        in_specs=[
+            pl.BlockSpec((1, batch, 3 * hidden), time_map),
+            pl.BlockSpec((1, batch, hidden), time_map),
+            pl.BlockSpec((1, batch, hidden), time_map),
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((3 * hidden, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((hidden, 3 * hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3 * hidden), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, batch, 3 * hidden), time_map),
+            pl.BlockSpec((batch, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((hidden, 3 * hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3 * hidden), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((seq_len, batch, 3 * hidden), dtype),
+            jax.ShapeDtypeStruct((batch, hidden), dtype),
+            jax.ShapeDtypeStruct((hidden, 3 * hidden), dtype),
+            jax.ShapeDtypeStruct((1, 3 * hidden), dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((batch, hidden), dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(
+        xp_tm,
+        hprev_tm,
+        dhs_tm,
+        dh_last.astype(dtype),
+        w_hh.astype(dtype),
+        w_hh_t.astype(dtype),
+        b_hh_2d.astype(dtype),
+    )
+    return (
+        jnp.swapaxes(dxp_tm, 0, 1).astype(xp.dtype),
+        dh0.astype(h0.dtype),
+        jnp.swapaxes(dwt, 0, 1).astype(w_hh.dtype),
+        db[0].astype(b_hh.dtype),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -119,18 +276,19 @@ def _gru_scan_pallas(xp, h0, w_hh, b_hh, reverse, interpret):
 
 def _vjp_fwd(xp, h0, w_hh, b_hh, reverse, interpret):
     out = _gru_scan_pallas(xp, h0, w_hh, b_hh, reverse, interpret)
-    return out, (xp, h0, w_hh, b_hh)
+    h_last, hs = out
+    return out, (xp, h0, w_hh, b_hh, hs)
 
 
 def _vjp_bwd(reverse, interpret, residuals, cotangents):
-    """Backward via the reference scan's VJP (recompute-forward): the
-    kernel is a drop-in for gru_scan, so its cotangents are gru_scan's."""
-    xp, h0, w_hh, b_hh = residuals
-    _, vjp = jax.vjp(
-        lambda *args: gru_ref.gru_scan(*args, reverse=reverse),
-        xp, h0, w_hh, b_hh,
+    """Backward through the reverse-time Pallas kernel: gates recomputed
+    in-kernel from the saved hs (fused remat), dh carried in VMEM."""
+    xp, h0, w_hh, b_hh, hs = residuals
+    dh_last, dhs = cotangents
+    return _gru_scan_pallas_bwd_impl(
+        xp, h0, w_hh, b_hh, hs, dh_last, dhs,
+        reverse=reverse, interpret=interpret,
     )
-    return vjp(cotangents)
 
 
 _gru_scan_pallas.defvjp(_vjp_fwd, _vjp_bwd)
