@@ -176,6 +176,53 @@ def phase_multiticker() -> dict:
     )
 
 
+def phase_serving() -> dict:
+    """Tick latency of the carried-state streaming cores on the flagship
+    bidirectional model (north-star config 5: jit state-carry p50 tick
+    latency; the reference's floor is the hard-coded sleep(15) + retry,
+    predict.py:141-157)."""
+    import jax
+
+    from fmda_tpu.config import ModelConfig
+    from fmda_tpu.data.normalize import NormParams
+    from fmda_tpu.models.bigru import BiGRU
+    from fmda_tpu.serve.streaming import StreamingBiGRUBidirectional
+
+    ticks, warmup = 200, 10
+    cfg = ModelConfig(
+        hidden_size=HIDDEN, n_features=FEATURES, output_size=CLASSES,
+        dropout=0.0, use_pallas=False,
+    )
+    model = BiGRU(cfg)
+    import jax.numpy as jnp
+
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, WINDOW, FEATURES)),
+    )["params"]
+    norm = NormParams(np.zeros(FEATURES, np.float32),
+                      np.ones(FEATURES, np.float32))
+    core = StreamingBiGRUBidirectional(cfg, params, norm, window=WINDOW)
+    r = np.random.default_rng(0)
+    rows = r.normal(size=(warmup + ticks, FEATURES)).astype(np.float32)
+    for t in range(warmup):
+        core.step(rows[t])
+    lat = np.empty(ticks)
+    for t in range(ticks):
+        t0 = time.perf_counter()
+        core.step(rows[warmup + t])
+        lat[t] = time.perf_counter() - t0
+    dev = jax.devices()[0]
+    return {
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "backend": jax.default_backend(),
+        "device_kind": dev.device_kind,
+        "model": "bidirectional carried-state",
+        "reference_floor_ms": 15000.0,
+    }
+
+
 def phase_torch() -> dict:
     """The reference stack's training step (torch CPU), same shapes."""
     import torch
@@ -229,6 +276,7 @@ _PHASES = {
     "flagship_scan": lambda: phase_flagship(use_pallas=False),
     "longctx": phase_longctx,
     "multiticker": phase_multiticker,
+    "serving": phase_serving,
     "torch": phase_torch,
 }
 
@@ -297,6 +345,7 @@ def main() -> None:
         ("torch", 300.0),
         ("longctx", 600.0),
         ("multiticker", 420.0),
+        ("serving", 300.0),
     ]
     phases: dict = {}
     for name, budget in plan:

@@ -1,0 +1,191 @@
+"""Accuracy-parity experiment: reference training protocol, end to end.
+
+Reproduces the reference's only published quality evidence — the notebook
+training run (biGRU_model_training.ipynb cells 11-39: 3,980 rows, chunk 100
+/ window 30, batch 2, hidden 32, dropout 0.5, lr 1e-3, clip 50, 25 epochs,
+class-imbalance weighted BCE, test accuracy / Hamming / F-beta(0.5) /
+confusion) — on this framework's full pipeline: synthetic seeded corpus →
+bus → streaming engine → warehouse → chunked normalized windows → jitted
+train step → Orbax checkpoint → backtest over the test range.
+
+The reference's corpus is a private SPY recording we cannot redistribute;
+the committed corpus here is generated (fmda_tpu.data.synthetic) with the
+same row count and cadence and *learnable* structure, so the numbers
+measure real learning under the identical protocol.  Run:
+
+    PYTHONPATH=/root/repo:$PYTHONPATH python experiments/accuracy_parity.py
+
+Writes RESULTS.md, artifacts/parity/ (checkpoint + reports).  ~10 min CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SEED = 0
+N_DAYS = 52  # 52 x 78 bars = 4,056 rows >= the reference's 3,980
+EPOCHS = 25
+
+
+def main() -> None:
+    import jax
+
+    from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+    from fmda_tpu.serve.backtest import backtest
+    from fmda_tpu.train import Trainer, save_checkpoint
+    from fmda_tpu.train.reports import (
+        history_table, plot_confusion, plot_history,
+    )
+    from fmda_tpu.train.trainer import imbalance_weights_from_source
+
+    t0 = time.time()
+    fc = FeatureConfig()
+    market = SyntheticMarketConfig(seed=SEED, n_days=N_DAYS)
+    wh, stats = build_corpus(fc, market)
+    n_rows = len(wh)
+    y_all = wh.fetch_targets(range(1, n_rows + 1))
+    print(f"corpus: {n_rows} rows ({stats}); "
+          f"positives={y_all.sum(axis=0).astype(int).tolist()} "
+          f"[{time.time() - t0:.0f}s]")
+
+    # reference hyperparams, notebook cells 11/29
+    model_cfg = ModelConfig(
+        hidden_size=32, n_features=len(wh.x_fields), output_size=4,
+        dropout=0.5, spatial_dropout=True,
+    )
+    train_cfg = TrainConfig(
+        batch_size=2, window=30, chunk_size=100, learning_rate=1e-3,
+        epochs=EPOCHS, clip=50.0, val_size=0.1, test_size=0.1, seed=SEED,
+    )
+    weight, pos_weight = imbalance_weights_from_source(wh)
+    trainer = Trainer(model_cfg, train_cfg, weight=weight, pos_weight=pos_weight)
+    state, history, dataset = trainer.fit(
+        wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
+    train_chunks, val_chunks, test_chunks = dataset.split(
+        train_cfg.val_size, train_cfg.test_size)
+    print(f"trained {EPOCHS} epochs over {len(train_chunks)} train chunks "
+          f"({len(val_chunks)} val, {len(test_chunks)} test) "
+          f"[{time.time() - t0:.0f}s]")
+
+    test_metrics, test_confusion = trainer.evaluate(state, dataset, test_chunks)
+
+    artifacts = os.path.join(REPO, "artifacts", "parity")
+    os.makedirs(artifacts, exist_ok=True)
+    ckpt = save_checkpoint(
+        os.path.join(artifacts, "checkpoint"), state,
+        dataset.final_norm_params,
+        extra={"seed": SEED, "n_days": N_DAYS, "corpus_rows": n_rows},
+    )
+    plot_history(history, os.path.join(artifacts, "learning_curves.png"))
+    plot_confusion(test_confusion, os.path.join(artifacts, "test_confusion.png"))
+
+    # serving-equivalent scoring over the test tail (backtester)
+    first_test_row = dataset.ranges[test_chunks[0]][0] + 1
+    bt = backtest(
+        wh, model_cfg, state.params, dataset.final_norm_params,
+        window=train_cfg.window, ids=(max(train_cfg.window, first_test_row), n_rows),
+    )
+
+    fbeta = [round(float(v), 3) for v in np.asarray(test_metrics.fbeta)]
+    bt_fbeta = [round(float(v), 3) for v in np.asarray(bt.metrics.fbeta)]
+    results = {
+        "corpus_rows": n_rows,
+        "positives": y_all.sum(axis=0).astype(int).tolist(),
+        "chunks": {"train": len(train_chunks), "val": len(val_chunks),
+                   "test": len(test_chunks)},
+        "final_train": {"accuracy": round(history["train"][-1].accuracy, 3),
+                        "hamming": round(history["train"][-1].hamming, 3),
+                        "loss": round(history["train"][-1].loss, 3)},
+        "best_val_accuracy": round(
+            max(m.accuracy for m in history["val"]), 3),
+        "test": {"accuracy": round(test_metrics.accuracy, 3),
+                 "hamming": round(test_metrics.hamming, 3),
+                 "fbeta": fbeta},
+        "backtest": {"accuracy": round(float(bt.metrics.accuracy), 3),
+                     "hamming": round(float(bt.metrics.hamming), 3),
+                     "fbeta": bt_fbeta,
+                     "rows_served": int(len(bt.probabilities))},
+        "checkpoint": os.path.relpath(ckpt, REPO),
+        "wall_s": round(time.time() - t0, 1),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(results, indent=2))
+
+    write_results_md(results, history_table(history))
+
+
+def write_results_md(r: dict, table: str) -> None:
+    ref = {
+        "rows": 3980, "positives": [948, 575, 917, 672],
+        "chunks": "32 train / 5 val / 4 test",
+        "train_acc": 0.510, "train_hamming": 0.168, "train_loss": 3.357,
+        "best_val_acc": 0.292,
+        "test_acc": 0.216, "test_hamming": 0.317,
+        "test_fbeta": [0.100, 0.033, 0.144, 0.098],
+    }
+    t = r["test"]
+    bt = r["backtest"]
+    lines = [
+        "# RESULTS — accuracy-parity experiment",
+        "",
+        "The reference's training protocol (biGRU_model_training.ipynb cells"
+        " 11-39; BASELINE.md) run end-to-end on this framework: seeded"
+        " synthetic corpus replayed through bus → engine → warehouse, chunked"
+        " min-max-normalized stride-1 windows, weighted-BCE biGRU training"
+        " (batch 2, hidden 32, window 30, chunk 100, lr 1e-3, clip 50,"
+        f" {EPOCHS} epochs), then test-chunk eval and a serving-equivalent"
+        " backtest.",
+        "",
+        "The reference trained on a private SPY recording; this corpus is"
+        " generated (`fmda_tpu/data/synthetic.py`, seed"
+        f" {SEED}) with the same size/cadence and learnable order-book"
+        " structure, so numbers are not row-for-row comparable — the"
+        " comparison shows the full pipeline learns real signal under the"
+        " identical protocol.  Reproduce with"
+        " `python experiments/accuracy_parity.py`.",
+        "",
+        "| Metric | reference (SPY, notebook) | fmda_tpu (synthetic corpus) |",
+        "|---|---|---|",
+        f"| Dataset rows | {ref['rows']} | {r['corpus_rows']} |",
+        f"| Class positives | {ref['positives']} | {r['positives']} |",
+        f"| Chunks | {ref['chunks']} | {r['chunks']['train']} train / "
+        f"{r['chunks']['val']} val / {r['chunks']['test']} test |",
+        f"| Final train accuracy | {ref['train_acc']} | "
+        f"{r['final_train']['accuracy']} |",
+        f"| Final train Hamming | {ref['train_hamming']} | "
+        f"{r['final_train']['hamming']} |",
+        f"| Best val accuracy | {ref['best_val_acc']} | "
+        f"{r['best_val_accuracy']} |",
+        f"| **Test accuracy** | **{ref['test_acc']}** | **{t['accuracy']}** |",
+        f"| **Test Hamming loss** | **{ref['test_hamming']}** | "
+        f"**{t['hamming']}** |",
+        f"| Test F-beta(0.5) per label | {ref['test_fbeta']} | {t['fbeta']} |",
+        f"| Backtest (serving path) accuracy | — | {bt['accuracy']} "
+        f"({bt['rows_served']} rows served) |",
+        f"| Backtest Hamming / F-beta | — | {bt['hamming']} / {bt['fbeta']} |",
+        "",
+        f"Checkpoint: `{r['checkpoint']}` (params + optimizer + step + norm"
+        " stats, Orbax).  Reports: `artifacts/parity/learning_curves.png`,"
+        " `artifacts/parity/test_confusion.png`."
+        f"  Wall clock: {r['wall_s']}s on {r['backend']}.",
+        "",
+        "## Per-epoch history",
+        "",
+        table,
+        "",
+    ]
+    path = os.path.join(REPO, "RESULTS.md")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

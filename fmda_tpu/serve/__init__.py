@@ -1,11 +1,16 @@
 from fmda_tpu.serve.backtest import BacktestResult, backtest, backtest_from_checkpoint
 from fmda_tpu.serve.predictor import Prediction, Predictor
-from fmda_tpu.serve.streaming import StreamingBiGRU, StreamingPredictor
+from fmda_tpu.serve.streaming import (
+    StreamingBiGRU,
+    StreamingBiGRUBidirectional,
+    StreamingPredictor,
+)
 
 __all__ = [
     "Prediction",
     "Predictor",
     "StreamingBiGRU",
+    "StreamingBiGRUBidirectional",
     "StreamingPredictor",
     "BacktestResult",
     "backtest",

@@ -12,14 +12,23 @@ The pooling head still wants max/mean pools over the last ``window`` steps,
 so the carrier keeps a small ring of per-step hidden outputs (H-sized
 vectors, not feature rows) and pools over it.
 
-Semantics note: carried state means the recurrence sees the *entire*
-session history, not just the trailing window — step ``t`` is bit-identical
-to scanning the whole stream from the start and pooling over the last
-``window`` hidden outputs (verified in tests).  That differs from the
-window-re-scan :class:`~fmda_tpu.serve.predictor.Predictor`, which resets
-``h0 = 0`` at the left edge of every window (the training-time semantics,
-sql_pytorch_dataloader windows).  Longer memory, O(1) ticks — choose per
-deployment; both are exposed.
+The flagship model is *bidirectional*; :class:`StreamingBiGRUBidirectional`
+extends the same idea: the forward direction is carried exactly as above,
+and the backward direction — which by definition needs the future of each
+row, i.e. the window's newer rows — is re-scanned per tick over a small
+ring of its *input projections* (3H-sized vectors).  Each tick is then one
+fused jit step of O(window) work on H-sized state: no feature re-fetch, no
+forward re-scan, no O(window x F) matmuls.
+
+Semantics note: carried forward state sees the *entire* session history —
+step ``t`` is bit-identical to scanning the whole stream from the start —
+while the backward direction matches training exactly (h0 = 0 at the
+newest row of the window).  The window-re-scan
+:class:`~fmda_tpu.serve.predictor.Predictor` instead resets both
+directions at the window edges (the training-time semantics,
+sql_pytorch_dataloader windows).  Longer forward memory, O(1)/O(window)
+ticks — choose per deployment; both are exposed, and both are verified
+against explicit reference computations in tests.
 """
 
 from __future__ import annotations
@@ -33,9 +42,17 @@ import numpy as np
 
 from fmda_tpu.config import ModelConfig, TARGET_COLUMNS
 from fmda_tpu.data.normalize import NormParams
-from fmda_tpu.ops.gru import GRUWeights, gru_gates
+from fmda_tpu.ops.gru import GRUWeights, gru_gates, gru_scan
 
 log = logging.getLogger("fmda_tpu.serve")
+
+
+def _layer0_weights(params, reverse: bool) -> GRUWeights:
+    suffix = "l0_reverse" if reverse else "l0"
+    return GRUWeights(
+        params[f"weight_ih_{suffix}"], params[f"weight_hh_{suffix}"],
+        params[f"bias_ih_{suffix}"], params[f"bias_hh_{suffix}"],
+    )
 
 
 class StreamingBiGRU:
@@ -67,19 +84,16 @@ class StreamingBiGRU:
         self.window = window
         self.batch = batch
         self._params = params
+        self._dtype = jnp.dtype(cfg.dtype)  # params stay f32, compute in this
+        dtype = self._dtype
         x_min = jnp.asarray(norm.x_min)
         x_range = jnp.asarray(norm.x_max - norm.x_min)
 
-        hidden = cfg.hidden_size
-
         def step(params, h, ring, ring_pos, row):
             """One tick: row (B, F) -> (logits, new_h, new_ring, new_pos)."""
-            p = params
-            w = GRUWeights(
-                p["weight_ih_l0"], p["weight_hh_l0"],
-                p["bias_ih_l0"], p["bias_hh_l0"],
-            )
-            x = (row - x_min) / x_range
+            p = jax.tree.map(lambda a: a.astype(dtype), params)
+            w = _layer0_weights(p, reverse=False)
+            x = ((row - x_min) / x_range).astype(dtype)
             xp = x @ w.w_ih.T + w.b_ih
             h_new = gru_gates(xp, h, w.w_hh, w.b_hh)
             ring = jax.lax.dynamic_update_index_in_dim(
@@ -102,8 +116,8 @@ class StreamingBiGRU:
 
     def reset(self) -> None:
         hidden = self.cfg.hidden_size
-        self._h = jnp.zeros((self.batch, hidden))
-        self._ring = jnp.zeros((self.batch, self.window, hidden))
+        self._h = jnp.zeros((self.batch, hidden), self._dtype)
+        self._ring = jnp.zeros((self.batch, self.window, hidden), self._dtype)
         self._pos = jnp.asarray(0, jnp.int32)
 
     @property
@@ -122,6 +136,116 @@ class StreamingBiGRU:
         return np.asarray(jax.nn.sigmoid(logits))
 
 
+class StreamingBiGRUBidirectional:
+    """Carried-state streaming inference for the flagship *bidirectional*
+    model (north-star serving config: jit state-carry tick latency).
+
+    Per tick, one fused jit step:
+
+    - forward direction: advance the carried ``h_fwd`` by the newest row
+      (O(1)), push the hidden output onto a ring;
+    - backward direction: re-scan a ring of the window's backward input
+      projections, newest→oldest, with ``h0 = 0`` at the newest row —
+      training-exact backward semantics at O(window) cost on H-sized
+      vectors (the features are projected once, on arrival);
+    - pooled head (last-hidden sum + max/mean pools of the per-step
+      direction sums, biGRU_model.py:108-137) over the valid window.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        norm: NormParams,
+        *,
+        window: int,
+        batch: int = 1,
+    ) -> None:
+        if not cfg.bidirectional:
+            raise ValueError(
+                "use StreamingBiGRU for unidirectional models (pure O(1))")
+        if cfg.n_layers != 1:
+            raise ValueError("streaming core currently covers 1-layer models")
+        self.cfg = cfg
+        self.window = window
+        self.batch = batch
+        self._params = params
+        self._dtype = jnp.dtype(cfg.dtype)  # params stay f32, compute in this
+        dtype = self._dtype
+        x_min = jnp.asarray(norm.x_min)
+        x_range = jnp.asarray(norm.x_max - norm.x_min)
+        w = window
+
+        def step(params, h_fwd, hs_ring, xpb_ring, pos, row):
+            p = jax.tree.map(lambda a: a.astype(dtype), params)
+            wf = _layer0_weights(p, reverse=False)
+            wb = _layer0_weights(p, reverse=True)
+            x = ((row - x_min) / x_range).astype(dtype)
+
+            # forward: one carried-gate step
+            xpf = x @ wf.w_ih.T + wf.b_ih
+            h_new = gru_gates(xpf, h_fwd, wf.w_hh, wf.b_hh)
+            # project the row for the backward direction once, on arrival
+            xpb = x @ wb.w_ih.T + wb.b_ih
+
+            slot = pos % w
+            hs_ring = jax.lax.dynamic_update_index_in_dim(
+                hs_ring, h_new, slot, axis=1)
+            xpb_ring = jax.lax.dynamic_update_index_in_dim(
+                xpb_ring, xpb, slot, axis=1)
+
+            # newest-first view of the ring: k-th entry is the k-th newest
+            n_valid = jnp.minimum(pos + 1, w)
+            idx = (pos - jnp.arange(w)) % w
+            xpb_nf = jnp.take(xpb_ring, idx, axis=1)
+            hs_fwd_nf = jnp.take(hs_ring, idx, axis=1)
+
+            # backward direction: scan newest -> oldest with h0 = 0 (ticks
+            # past n_valid run on stale slots; their outputs are masked out)
+            h0 = jnp.zeros_like(h_new)
+            h_bwd_seq = gru_scan(xpb_nf, h0, wb.w_hh, wb.b_hh)[1]
+            h_bwd_last = jax.lax.dynamic_index_in_dim(
+                h_bwd_seq, n_valid - 1, axis=1, keepdims=False)
+
+            summed = hs_fwd_nf + h_bwd_seq
+            valid = (jnp.arange(w) < n_valid)[None, :, None]
+            neg = jnp.finfo(summed.dtype).min
+            max_pool = jnp.max(jnp.where(valid, summed, neg), axis=1)
+            avg_pool = jnp.sum(jnp.where(valid, summed, 0.0), axis=1) / n_valid
+            last_hidden = h_new + h_bwd_last
+            concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)
+            logits = concat @ p["linear"]["kernel"] + p["linear"]["bias"]
+            return logits, h_new, hs_ring, xpb_ring, pos + 1
+
+        self._step = jax.jit(step)
+        self.reset()
+
+    def reset(self) -> None:
+        hidden = self.cfg.hidden_size
+        self._h = jnp.zeros((self.batch, hidden), self._dtype)
+        self._hs_ring = jnp.zeros(
+            (self.batch, self.window, hidden), self._dtype)
+        self._xpb_ring = jnp.zeros(
+            (self.batch, self.window, 3 * hidden), self._dtype)
+        self._pos = jnp.asarray(0, jnp.int32)
+
+    @property
+    def ticks_seen(self) -> int:
+        return int(self._pos)
+
+    def step(self, row: np.ndarray) -> np.ndarray:
+        """Advance one tick with the newest feature row (B, F) or (F,);
+        returns sigmoid probabilities (B, n_classes)."""
+        row = jnp.asarray(row, jnp.float32)
+        if row.ndim == 1:
+            row = row[None, :]
+        logits, self._h, self._hs_ring, self._xpb_ring, self._pos = self._step(
+            self._params, self._h, self._hs_ring, self._xpb_ring, self._pos,
+            row,
+        )
+        return np.asarray(jax.nn.sigmoid(logits))
+
+
 class StreamingPredictor:
     """Bus-facing wrapper: consume predict-timestamp signals, feed only the
     newest landed row through the carried-state core, publish predictions."""
@@ -130,7 +254,7 @@ class StreamingPredictor:
         self,
         bus,
         warehouse,
-        core: StreamingBiGRU,
+        core: "StreamingBiGRU | StreamingBiGRUBidirectional",
         *,
         threshold: float = 0.5,
         y_fields=TARGET_COLUMNS,

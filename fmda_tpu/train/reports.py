@@ -1,0 +1,94 @@
+"""Training reports: learning curves + per-label confusion heatmaps.
+
+The reference renders these inline in the training notebook (learning
+curves cell 30, validation confusion heatmaps cells 31/37) and they are its
+only published quality evidence; here they are a library call over the
+history/confusion structures the :class:`~fmda_tpu.train.trainer.Trainer`
+already returns, writing PNG/SVG files an experiment can commit.
+
+matplotlib is imported lazily and is NOT a package dependency — these are
+host-side report artifacts, nothing device-side.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from fmda_tpu.config import TARGET_COLUMNS
+
+
+def history_table(history: Dict[str, List]) -> str:
+    """Markdown table of per-epoch train/val metrics."""
+    lines = [
+        "| epoch | train loss | train acc | train Hamming | val acc | val Hamming |",
+        "|---|---|---|---|---|---|",
+    ]
+    for i, (tr, va) in enumerate(zip(history["train"], history["val"])):
+        lines.append(
+            f"| {i + 1} | {tr.loss:.4f} | {tr.accuracy:.4f} | "
+            f"{tr.hamming:.4f} | {va.accuracy:.4f} | {va.hamming:.4f} |"
+        )
+    return "\n".join(lines)
+
+
+def plot_history(history: Dict[str, List], path: str) -> str:
+    """Learning curves (loss, subset accuracy, Hamming loss) to ``path``."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    epochs = np.arange(1, len(history["train"]) + 1)
+    fig, axes = plt.subplots(1, 3, figsize=(13, 3.6))
+    axes[0].plot(epochs, [m.loss for m in history["train"]], label="train")
+    axes[0].plot(epochs, [m.loss for m in history["val"]], label="val")
+    axes[0].set_title("weighted BCE loss")
+    axes[1].plot(epochs, [m.accuracy for m in history["train"]], label="train")
+    axes[1].plot(epochs, [m.accuracy for m in history["val"]], label="val")
+    axes[1].set_title("subset accuracy")
+    axes[2].plot(epochs, [m.hamming for m in history["train"]], label="train")
+    axes[2].plot(epochs, [m.hamming for m in history["val"]], label="val")
+    axes[2].set_title("Hamming loss")
+    for ax in axes:
+        ax.set_xlabel("epoch")
+        ax.grid(True, alpha=0.3)
+        ax.legend()
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path
+
+
+def plot_confusion(
+    confusion: np.ndarray,
+    path: str,
+    labels: Sequence[str] = TARGET_COLUMNS,
+) -> str:
+    """Per-label 2x2 confusion heatmaps (reference notebook cells 31/37).
+
+    ``confusion``: (n_labels, 2, 2) as returned by ``Trainer.evaluate``.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    n = len(labels)
+    fig, axes = plt.subplots(1, n, figsize=(3.2 * n, 3.2))
+    if n == 1:
+        axes = [axes]
+    for ax, label, cm in zip(axes, labels, confusion):
+        ax.imshow(cm, cmap="Blues")
+        for i in range(2):
+            for j in range(2):
+                ax.text(j, i, f"{int(cm[i, j])}", ha="center", va="center",
+                        color="black")
+        ax.set_title(label)
+        ax.set_xticks([0, 1], ["pred 0", "pred 1"])
+        ax.set_yticks([0, 1], ["true 0", "true 1"])
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path

@@ -1,0 +1,49 @@
+"""Synthetic corpus generator + training reports (experiment tooling)."""
+
+import os
+
+import numpy as np
+
+from fmda_tpu.config import FeatureConfig
+from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+from fmda_tpu.train.reports import history_table, plot_confusion, plot_history
+from fmda_tpu.train.trainer import EpochMetrics
+
+
+def test_corpus_deterministic_and_learnable():
+    fc = FeatureConfig()
+    cfg = SyntheticMarketConfig(seed=7, n_days=4)
+    wh1, stats1 = build_corpus(fc, cfg)
+    wh2, _ = build_corpus(fc, cfg)
+    n = len(wh1)
+    assert n == 4 * cfg.bars_per_day
+    assert stats1 == {"emitted": n, "dropped": 0, "pending": 0}
+    ids = range(1, n + 1)
+    np.testing.assert_array_equal(wh1.fetch(ids), wh2.fetch(ids))
+    np.testing.assert_array_equal(
+        wh1.fetch_targets(ids), wh2.fetch_targets(ids))
+
+    # learnable: book-size imbalance must separate the up1/down1 labels
+    x, y = wh1.fetch(ids), wh1.fetch_targets(ids)
+    fields = list(wh1.x_fields)
+    bid = x[:, [fields.index(f"bid_{i}_size") for i in range(fc.bid_levels)]].sum(1)
+    ask = x[:, [fields.index(f"ask_{i}_size") for i in range(fc.ask_levels)]].sum(1)
+    imb = (bid - ask) / (bid + ask)
+    assert imb[y[:, 0] == 1].mean() > imb[y[:, 0] == 0].mean() + 0.1  # up1
+    assert imb[y[:, 2] == 1].mean() < imb[y[:, 2] == 0].mean() - 0.1  # down1
+
+
+def test_reports_render(tmp_path):
+    history = {
+        "train": [EpochMetrics(1.5, 0.4, 0.3, np.ones(4) * 0.2),
+                  EpochMetrics(1.2, 0.5, 0.25, np.ones(4) * 0.3)],
+        "val": [EpochMetrics(1.6, 0.35, 0.33, np.ones(4) * 0.1),
+                EpochMetrics(1.4, 0.45, 0.28, np.ones(4) * 0.2)],
+    }
+    table = history_table(history)
+    assert "| 2 | 1.2000 |" in table
+    curves = plot_history(history, str(tmp_path / "curves.png"))
+    confusion = np.array([[[50, 5], [10, 35]]] * 4)
+    heat = plot_confusion(confusion, str(tmp_path / "conf.png"))
+    assert os.path.getsize(curves) > 1000
+    assert os.path.getsize(heat) > 1000
