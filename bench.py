@@ -136,10 +136,22 @@ def _bench_train_step(
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - t0
 
+    # optional device profile (XProf trace) of a few post-measurement
+    # steps: FMDA_PROFILE_DIR=/path python bench.py
+    profile_dir = os.environ.get("FMDA_PROFILE_DIR")
+    if profile_dir:
+        from fmda_tpu.utils.tracing import device_trace, step_annotation
+
+        with device_trace(profile_dir):
+            for i in range(3):
+                with step_annotation("bench_train_step", i):
+                    state, loss, _ = trainer._train_step(state, b, rng)
+            jax.block_until_ready(loss)
+
     dev = jax.devices()[0]
     step_s = elapsed / steps
     flops = model_flops_per_step(batch, window, features, HIDDEN)
-    return {
+    result = {
         "seq_s": round(batch * steps / elapsed, 1),
         "step_ms": round(step_s * 1e3, 3),
         "backend": jax.default_backend(),
@@ -149,6 +161,9 @@ def _bench_train_step(
         "mfu_est": _mfu(flops, step_s, dev.device_kind),
         "shape": {"B": batch, "T": window, "F": features, "H": HIDDEN},
     }
+    if profile_dir:
+        result["profile_dir"] = profile_dir
+    return result
 
 
 def phase_flagship(use_pallas: bool) -> dict:

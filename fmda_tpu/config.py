@@ -368,6 +368,12 @@ class MeshConfig:
     dp: int = -1
     #: Sequence-parallel axis size (long-context recurrent scan sharding).
     sp: int = 1
+    #: Expected process (host/slice) count.  >1 = multi-host: the mesh
+    #: spans every process's devices with dp crossing the host boundary
+    #: (gradient all-reduce rides DCN between slices, ICI within) and sp
+    #: kept inside one host.  Validated against jax.process_count() at
+    #: mesh build so a mis-launched job fails loudly, not wrongly.
+    processes: int = 1
     dp_axis: str = "dp"
     sp_axis: str = "sp"
 

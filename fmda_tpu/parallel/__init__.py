@@ -12,6 +12,12 @@ from fmda_tpu.parallel.collectives import (
     shift_left,
     shift_right,
 )
+from fmda_tpu.parallel.distributed import (
+    initialize,
+    make_global_batch,
+    place_local_batch,
+    shard_train_inputs_multihost,
+)
 from fmda_tpu.parallel.seq_parallel import (
     make_sp_forward,
     sp_bigru_layer,
@@ -30,6 +36,10 @@ __all__ = [
     "ring_shift",
     "shift_left",
     "shift_right",
+    "initialize",
+    "make_global_batch",
+    "place_local_batch",
+    "shard_train_inputs_multihost",
     "make_sp_forward",
     "sp_gru_scan",
     "sp_gru_scan_pipelined",

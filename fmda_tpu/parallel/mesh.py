@@ -40,11 +40,25 @@ def build_mesh(
     ``dp*sp`` are left unused (explicitly, never silently wrong).
     """
     cfg = cfg or MeshConfig()
+    if cfg.processes != jax.process_count():
+        raise ValueError(
+            f"MeshConfig.processes={cfg.processes} but this job runs "
+            f"{jax.process_count()} process(es) — call "
+            "fmda_tpu.parallel.distributed.initialize on every host first"
+        )
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     sp = cfg.sp
     if sp <= 0 or n % sp != 0 and cfg.dp == -1:
         raise ValueError(f"sp={sp} does not divide device count {n}")
+    if cfg.processes > 1 and jax.local_device_count() % sp != 0:
+        # jax.devices() is process-major, so sp-sized contiguous blocks
+        # stay inside one host only when sp divides the local count —
+        # otherwise the recurrent carry's ppermute would ride DCN
+        raise ValueError(
+            f"sp={sp} must divide the per-host device count "
+            f"{jax.local_device_count()} so the sequence carry stays on ICI"
+        )
     dp = (n // sp) if cfg.dp == -1 else cfg.dp
     needed = dp * sp
     if needed > n:

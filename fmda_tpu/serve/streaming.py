@@ -83,16 +83,18 @@ class StreamingBiGRU:
         self.cfg = cfg
         self.window = window
         self.batch = batch
-        self._params = params
-        self._dtype = jnp.dtype(cfg.dtype)  # params stay f32, compute in this
+        self._dtype = jnp.dtype(cfg.dtype)
         dtype = self._dtype
+        # compute dtype applied once here, not per tick (params are small
+        # but the serving path is latency-critical)
+        self._params = jax.tree.map(
+            lambda a: jnp.asarray(a).astype(dtype), params)
         x_min = jnp.asarray(norm.x_min)
         x_range = jnp.asarray(norm.x_max - norm.x_min)
 
         def step(params, h, ring, ring_pos, row):
             """One tick: row (B, F) -> (logits, new_h, new_ring, new_pos)."""
-            p = jax.tree.map(lambda a: a.astype(dtype), params)
-            w = _layer0_weights(p, reverse=False)
+            w = _layer0_weights(params, reverse=False)
             x = ((row - x_min) / x_range).astype(dtype)
             xp = x @ w.w_ih.T + w.b_ih
             h_new = gru_gates(xp, h, w.w_hh, w.b_hh)
@@ -108,7 +110,9 @@ class StreamingBiGRU:
             max_pool = jnp.max(jnp.where(valid, ring, neg), axis=1)
             avg_pool = jnp.sum(jnp.where(valid, ring, 0.0), axis=1) / n_valid
             concat = jnp.concatenate([h_new, max_pool, avg_pool], axis=-1)
-            logits = concat @ p["linear"]["kernel"] + p["linear"]["bias"]
+            logits = (
+                concat @ params["linear"]["kernel"] + params["linear"]["bias"]
+            )
             return logits, h_new, ring, ring_pos + 1
 
         self._step = jax.jit(step)
@@ -169,15 +173,18 @@ class StreamingBiGRUBidirectional:
         self.cfg = cfg
         self.window = window
         self.batch = batch
-        self._params = params
-        self._dtype = jnp.dtype(cfg.dtype)  # params stay f32, compute in this
+        self._dtype = jnp.dtype(cfg.dtype)
         dtype = self._dtype
+        # compute dtype applied once here, not per tick (params are small
+        # but the serving path is latency-critical)
+        self._params = jax.tree.map(
+            lambda a: jnp.asarray(a).astype(dtype), params)
         x_min = jnp.asarray(norm.x_min)
         x_range = jnp.asarray(norm.x_max - norm.x_min)
         w = window
 
         def step(params, h_fwd, hs_ring, xpb_ring, pos, row):
-            p = jax.tree.map(lambda a: a.astype(dtype), params)
+            p = params
             wf = _layer0_weights(p, reverse=False)
             wb = _layer0_weights(p, reverse=True)
             x = ((row - x_min) / x_range).astype(dtype)

@@ -66,57 +66,98 @@ class _Event:
 
 @dataclass
 class _StreamBuffer:
-    """Per-feed buffer with watermark tracking."""
+    """Per-feed buffer with watermark tracking.
+
+    Events are bucketed by floored timestamp so the join probe is an O(1)
+    dict lookup plus a scan of one bucket (a handful of events), instead of
+    a linear pass over everything buffered — the difference between O(rows)
+    and O(rows^2) total work when replaying months of history through the
+    engine (backtests, recovery)."""
 
     name: str
-    events: List[_Event] = field(default_factory=list)
+    floor_s: int
+    buckets: Dict[int, List[_Event]] = field(default_factory=dict)
     max_ts: int = -1
 
     def add(self, event: _Event) -> None:
-        self.events.append(event)
+        self.buckets.setdefault(
+            floor_epoch(event.ts, self.floor_s), []).append(event)
         self.max_ts = max(self.max_ts, event.ts)
 
     def watermark(self, delay_s: int) -> int:
         return self.max_ts - delay_s if self.max_ts >= 0 else -1
 
     def evict_before(self, ts: int) -> None:
-        self.events = [e for e in self.events if e.ts >= ts]
+        for fl in [f for f in self.buckets if f + self.floor_s <= ts]:
+            del self.buckets[fl]
+        boundary = floor_epoch(ts, self.floor_s)
+        if boundary in self.buckets:  # partial bucket: filter exactly
+            kept = [e for e in self.buckets[boundary] if e.ts >= ts]
+            if kept:
+                self.buckets[boundary] = kept
+            else:
+                del self.buckets[boundary]
 
-    def match(self, deep_ts: int, floor_s: int, tolerance_s: int) -> Optional[_Event]:
+    def match(self, deep_ts: int, tolerance_s: int) -> Optional[_Event]:
         """Earliest event with equal floor and ts in [deep_ts, deep_ts+tol]."""
-        target_floor = floor_epoch(deep_ts, floor_s)
         best: Optional[_Event] = None
-        for e in self.events:
-            if floor_epoch(e.ts, floor_s) != target_floor:
-                continue
+        for e in self.buckets.get(floor_epoch(deep_ts, self.floor_s), ()):
             if not (deep_ts <= e.ts <= deep_ts + tolerance_s):
                 continue
             if best is None or e.ts < best.ts:
                 best = e
         return best
 
+    @property
+    def events(self) -> List[_Event]:
+        """Flattened view (checkpointing and tests)."""
+        return [e for fl in sorted(self.buckets) for e in self.buckets[fl]]
 
-def _parse_deep(value: dict, bid_levels: int, ask_levels: int) -> _Event:
-    """Flatten a DEEP book message (producer reshape, getMarketData.py:117-127;
-    Spark schema spark_consumer.py:281-308).  Missing levels -> 0."""
+
+def _extract_deep_raw(value: dict, bid_levels: int, ask_levels: int):
+    """Pull the raw book ladder out of one DEEP message (producer reshape,
+    getMarketData.py:117-127; Spark schema spark_consumer.py:281-308).
+    Missing levels -> 0.  Returns (ts_str, bids, bid_sizes, asks, ask_sizes)
+    as python lists — feature math happens batched in
+    :func:`_parse_deep_batch`."""
     ts_str = value["Timestamp"]
-    bids = np.zeros((1, bid_levels))
-    bid_sizes = np.zeros((1, bid_levels))
-    asks = np.zeros((1, ask_levels))
-    ask_sizes = np.zeros((1, ask_levels))
+    to_epoch(ts_str)  # validate the timestamp before accepting the message
+    bids, bid_sizes = [0.0] * bid_levels, [0.0] * bid_levels
+    asks, ask_sizes = [0.0] * ask_levels, [0.0] * ask_levels
     for i in range(bid_levels):
         lvl = value.get(f"bids_{i}") or {}
-        bids[0, i] = lvl.get(f"bid_{i}") or 0.0
-        bid_sizes[0, i] = lvl.get(f"bid_{i}_size") or 0.0
+        bids[i] = float(lvl.get(f"bid_{i}") or 0.0)
+        bid_sizes[i] = float(lvl.get(f"bid_{i}_size") or 0.0)
     for i in range(ask_levels):
         lvl = value.get(f"asks_{i}") or {}
-        asks[0, i] = lvl.get(f"ask_{i}") or 0.0
-        ask_sizes[0, i] = lvl.get(f"ask_{i}_size") or 0.0
+        asks[i] = float(lvl.get(f"ask_{i}") or 0.0)
+        ask_sizes[i] = float(lvl.get(f"ask_{i}_size") or 0.0)
+    return ts_str, bids, bid_sizes, asks, ask_sizes
+
+
+def _parse_deep_batch(raws) -> List[_Event]:
+    """Feature-compute a whole poll's DEEP messages in one vectorized pass
+    (one ``deep_features`` call for N rows, not N calls of batch 1 — the
+    replay-throughput difference is ~5x)."""
+    if not raws:
+        return []
+    ts_strs = [r[0] for r in raws]
     feats = deep_features(
-        bids, bid_sizes, asks, ask_sizes, [parse_ts(ts_str)]
+        np.asarray([r[1] for r in raws]),
+        np.asarray([r[2] for r in raws]),
+        np.asarray([r[3] for r in raws]),
+        np.asarray([r[4] for r in raws]),
+        [parse_ts(t) for t in ts_strs],
     )
-    payload = {k: float(v[0]) for k, v in feats.items()}
-    return _Event(to_epoch(ts_str), ts_str, payload)
+    cols = {k: v.tolist() for k, v in feats.items()}
+    return [
+        _Event(
+            to_epoch(ts),
+            ts,
+            {k: float(v[i]) for k, v in cols.items()},
+        )
+        for i, ts in enumerate(ts_strs)
+    ]
 
 
 def _parse_vix(value: dict) -> _Event:
@@ -177,29 +218,44 @@ class StreamEngine:
         signal_topic: str = TOPIC_PREDICT_TIMESTAMP,
         checkpoint_path: Optional[str] = None,
         from_end: bool = False,
+        checkpoint_every: int = 1,
     ) -> None:
         self.bus = bus
         self.warehouse = warehouse
         self.features = features
         self.signal_topic = signal_topic
         self.checkpoint_path = checkpoint_path
+        #: Checkpoint cadence in steps.  1 = after every step (strongest
+        #: durability, the default); N > 1 amortises the state write over
+        #: replay/backtest churn — a crash then replays at most the last N
+        #: steps' messages from the bus (offsets move back with the
+        #: checkpoint), re-landing those rows in the warehouse.
+        self.checkpoint_every = max(1, checkpoint_every)
+        self._steps_since_ckpt = 0
+        self._dirty = False
 
+        floor_s = features.floor_s
         self._side_streams: Dict[str, _StreamBuffer] = {}
         self._consumers = {}
         self._consumers[TOPIC_DEEP] = bus.consumer(TOPIC_DEEP, from_end=from_end)
         if features.get_vix:
-            self._side_streams[TOPIC_VIX] = _StreamBuffer(TOPIC_VIX)
+            self._side_streams[TOPIC_VIX] = _StreamBuffer(TOPIC_VIX, floor_s)
             self._consumers[TOPIC_VIX] = bus.consumer(TOPIC_VIX, from_end=from_end)
         if features.get_stock_volume:
-            self._side_streams[TOPIC_VOLUME] = _StreamBuffer(TOPIC_VOLUME)
+            self._side_streams[TOPIC_VOLUME] = _StreamBuffer(TOPIC_VOLUME, floor_s)
             self._consumers[TOPIC_VOLUME] = bus.consumer(TOPIC_VOLUME, from_end=from_end)
         if features.get_cot:
-            self._side_streams[TOPIC_COT] = _StreamBuffer(TOPIC_COT)
+            self._side_streams[TOPIC_COT] = _StreamBuffer(TOPIC_COT, floor_s)
             self._consumers[TOPIC_COT] = bus.consumer(TOPIC_COT, from_end=from_end)
-        self._side_streams[TOPIC_IND] = _StreamBuffer(TOPIC_IND)
+        self._side_streams[TOPIC_IND] = _StreamBuffer(TOPIC_IND, floor_s)
         self._consumers[TOPIC_IND] = bus.consumer(TOPIC_IND, from_end=from_end)
 
+        #: kept sorted by ts (insertion-sorted on ingest; feeds are nearly
+        #: in order, so the bisect degenerates to an append)
         self._pending_deep: List[_Event] = []
+        #: timestamps already landed in the warehouse — makes replay after
+        #: a crash idempotent (seeded from the warehouse on restore)
+        self._landed_ts: set = set()
         self._emitted = 0
         self._dropped = 0
         #: per-stage wall-clock accounting (SURVEY.md §5: the reference has
@@ -210,15 +266,23 @@ class StreamEngine:
 
     # -- parsing -------------------------------------------------------------
 
-    def _ingest(self) -> None:
+    def _ingest(self) -> bool:
+        """Poll every feed; returns True if anything new arrived."""
+        import bisect
+
         fc = self.features
+        polled_any = False
+        raws = []
         for rec in self._consumers[TOPIC_DEEP].poll():
+            polled_any = True
             try:
-                self._pending_deep.append(
-                    _parse_deep(rec.value, fc.bid_levels, fc.ask_levels)
+                raws.append(
+                    _extract_deep_raw(rec.value, fc.bid_levels, fc.ask_levels)
                 )
             except (KeyError, ValueError, TypeError) as e:
                 log.warning("bad deep message at offset %d: %s", rec.offset, e)
+        for event in _parse_deep_batch(raws):
+            bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
         parsers = {
             TOPIC_VIX: _parse_vix,
             TOPIC_VOLUME: _parse_volume,
@@ -227,12 +291,14 @@ class StreamEngine:
         }
         for topic, buf in self._side_streams.items():
             for rec in self._consumers[topic].poll():
+                polled_any = True
                 try:
                     buf.add(parsers[topic](rec.value))
                 except (KeyError, ValueError, TypeError) as e:
                     log.warning(
                         "bad %s message at offset %d: %s", topic, rec.offset, e
                     )
+        return polled_any
 
     # -- join ----------------------------------------------------------------
 
@@ -243,17 +309,17 @@ class StreamEngine:
         """
         fc = self.features
         with self.timer.stage("ingest"):
-            self._ingest()
+            polled_any = self._ingest()
         emitted_rows: List[Dict[str, float]] = []
         still_pending: List[_Event] = []
 
         with self.timer.stage("join"):
-            for deep_ev in sorted(self._pending_deep, key=lambda e: e.ts):
+            for deep_ev in self._pending_deep:  # insertion-sorted by ts
                 matches: Dict[str, _Event] = {}
                 expired = False  # some stream can provably never match
                 waiting = False  # some stream might still deliver a match
                 for topic, buf in self._side_streams.items():
-                    m = buf.match(deep_ev.ts, fc.floor_s, fc.join_tolerance_s)
+                    m = buf.match(deep_ev.ts, fc.join_tolerance_s)
                     if m is not None:
                         matches[topic] = m
                     elif (
@@ -282,12 +348,27 @@ class StreamEngine:
 
         self._pending_deep = still_pending
 
+        # resume idempotency: rows whose Timestamp the warehouse already
+        # holds (offsets rewound past landed inserts after a crash between
+        # checkpoints) are skipped, not duplicated
+        if emitted_rows and self._landed_ts:
+            fresh = [
+                r for r in emitted_rows
+                if r["Timestamp"] not in self._landed_ts
+            ]
+            if len(fresh) < len(emitted_rows):
+                log.info(
+                    "resume replay: skipping %d already-landed row(s)",
+                    len(emitted_rows) - len(fresh),
+                )
+            emitted_rows = fresh
         if emitted_rows:
             with self.timer.stage("land"):
                 self.warehouse.insert_rows(emitted_rows)
             # signal AFTER the write commits: no sleep-and-retry race
             with self.timer.stage("signal"):
                 for row in emitted_rows:
+                    self._landed_ts.add(row["Timestamp"])
                     self.bus.publish(
                         self.signal_topic, {"Timestamp": row["Timestamp"]}
                     )
@@ -303,7 +384,17 @@ class StreamEngine:
                 buf.evict_before(horizon - fc.join_tolerance_s)
 
         if self.checkpoint_path:
-            self.checkpoint()
+            if polled_any or emitted_rows:
+                self._dirty = True
+            self._steps_since_ckpt += 1
+            # write every N steps while busy, or once when the stream
+            # quiesces (nothing polled, nothing emitted) with state still
+            # unpersisted — a fully idle poll loop writes nothing
+            quiesced = not polled_any and not emitted_rows
+            if self._dirty and (
+                self._steps_since_ckpt >= self.checkpoint_every or quiesced
+            ):
+                self.checkpoint()
         return len(emitted_rows)
 
     # -- observability -------------------------------------------------------
@@ -345,6 +436,8 @@ class StreamEngine:
         with open(tmp, "w") as fh:
             json.dump(state, fh)
         os.replace(tmp, self.checkpoint_path)
+        self._steps_since_ckpt = 0
+        self._dirty = False
 
     def restore(self) -> None:
         with open(self.checkpoint_path) as fh:
@@ -361,8 +454,18 @@ class StreamEngine:
         self._pending_deep = [
             load_event(d) for d in state.get("pending_deep", [])
         ]
+        # the join loop trusts sorted order; make the invariant
+        # self-establishing for checkpoints from any writer
+        self._pending_deep.sort(key=lambda e: e.ts)
+        # seed replay idempotency from the warehouse (the source of truth
+        # for what already landed, however stale this checkpoint is)
+        self._landed_ts = set(self.warehouse.timestamps())
         for topic, dump in state.get("buffers", {}).items():
             if topic in self._side_streams:
                 buf = self._side_streams[topic]
-                buf.events = [load_event(d) for d in dump["events"]]
+                buf.buckets = {}
+                for d in dump["events"]:
+                    buf.add(load_event(d))
+                # the watermark can be ahead of any buffered event (post-
+                # eviction); restore it exactly
                 buf.max_ts = dump["max_ts"]

@@ -176,10 +176,18 @@ class Trainer:
 
     def _place_batches(self, batches: Iterable[Batch]) -> Iterable[Batch]:
         """Move host batches to the device(s): simple prefetch without a
-        mesh, dp batch sharding with one."""
+        mesh, dp batch sharding with one.  When the job spans processes
+        (multi-host DCN), each process's batches are its *local* shard of
+        the global batch and are assembled in place."""
         sharding = self._batch_sharding()
         if sharding is None:
             return prefetch_to_device(batches)
+        if jax.process_count() > 1:
+            from fmda_tpu.parallel.distributed import place_local_batch
+
+            return (
+                place_local_batch(self.mesh, b, self.dp_axis) for b in batches
+            )
         return (
             Batch(
                 jax.device_put(b.x, sharding),
@@ -218,16 +226,24 @@ class Trainer:
         rng: Optional[jax.Array],
         train: bool,
     ) -> Tuple[TrainState, EpochMetrics, np.ndarray]:
+        from fmda_tpu.utils.tracing import step_annotation
+
         # Per-batch results stay on device (async) — converting them here
         # would block the host on every step and serialize the pipeline.
         # One device_get at the end of the pass drains everything.
         device_results = []
+        step_no = 0
         for batches in batch_iterables:
             for batch in batches:
-                if train:
-                    state, loss, metrics = self._train_step(state, batch, rng)
-                else:
-                    loss, metrics = self._eval_step(state.params, batch)
+                # marks each step in a device profile when one is being
+                # captured (utils.tracing.device_trace); free otherwise
+                with step_annotation("train" if train else "eval", step_no):
+                    if train:
+                        state, loss, metrics = self._train_step(
+                            state, batch, rng)
+                    else:
+                        loss, metrics = self._eval_step(state.params, batch)
+                step_no += 1
                 device_results.append((loss, metrics))
         results: List[Tuple[np.ndarray, MultilabelMetrics]] = jax.device_get(
             device_results

@@ -63,3 +63,24 @@ def device_scope(name: str) -> Iterator[None]:
 
     with jax.named_scope(name):
         yield
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    """Capture a JAX device profile (TensorBoard/XProf trace) of the
+    enclosed region.  Wrap a few steps of a hot loop, not a whole run —
+    traces are large.  View with ``tensorboard --logdir <log_dir>``."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+@contextlib.contextmanager
+def step_annotation(name: str, step: int) -> Iterator[None]:
+    """Mark one training step in an active device trace (no-op overhead
+    when no trace is being captured)."""
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield
