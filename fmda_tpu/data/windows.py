@@ -72,11 +72,16 @@ def train_val_test_split(
 
     ``val`` and ``test`` each get ``int(frac * n) + 1`` chunks, matching the
     reference's arithmetic; slices clamp at the end of the chunk list.
+    Training always keeps at least one chunk — when ``n_chunks`` is too
+    small for three non-empty splits, val and then test lose out (the
+    Trainer logs a warning on an empty evaluation pass).
     """
     assert (val_size + test_size) < 1, "val_size + test_size must be < 1"
     assert val_size >= 0 and test_size >= 0, "negative split size"
     train_size = 1 - val_size - test_size
-    train_end = int(train_size * n_chunks)
+    # at least one training chunk: the reference's raw int() arithmetic can
+    # floor to zero for small n with large val+test fractions
+    train_end = max(1, int(train_size * n_chunks)) if n_chunks else 0
     val_end = train_end + int(val_size * n_chunks) + 1
     test_end = val_end + int(test_size * n_chunks) + 1
     chunks = range(n_chunks)

@@ -253,9 +253,13 @@ class StreamEngine:
         #: kept sorted by ts (insertion-sorted on ingest; feeds are nearly
         #: in order, so the bisect degenerates to an append)
         self._pending_deep: List[_Event] = []
-        #: timestamps already landed in the warehouse — makes replay after
-        #: a crash idempotent (seeded from the warehouse on restore)
-        self._landed_ts: set = set()
+        #: timestamps of landed ticks — the "exactly one output row per
+        #: book tick" dropDuplicates semantics (spark_consumer.py:477),
+        #: which also makes crash-replay idempotent.  Seeded from the
+        #: warehouse tail at construction (bounded: offsets can only
+        #: rewind to the last checkpoint, never to history's start) and
+        #: pruned below the join watermark as the session runs.
+        self._landed_ts: set = set(warehouse.recent_timestamps(5000))
         self._emitted = 0
         self._dropped = 0
         #: per-stage wall-clock accounting (SURVEY.md §5: the reference has
@@ -348,24 +352,31 @@ class StreamEngine:
 
         self._pending_deep = still_pending
 
-        # resume idempotency: rows whose Timestamp the warehouse already
-        # holds (offsets rewound past landed inserts after a crash between
-        # checkpoints) are skipped, not duplicated
-        if emitted_rows and self._landed_ts:
-            fresh = [
-                r for r in emitted_rows
-                if r["Timestamp"] not in self._landed_ts
-            ]
+        # one output row per book tick (dropDuplicates intent,
+        # spark_consumer.py:477): a tick whose timestamp already landed —
+        # duplicate feed message, or crash-replay after offsets rewound —
+        # is skipped, warehouse untouched
+        if emitted_rows:
+            fresh, seen_now = [], set()
+            for r in emitted_rows:
+                ts = r["Timestamp"]
+                if ts in self._landed_ts or ts in seen_now:
+                    continue
+                seen_now.add(ts)
+                fresh.append(r)
             if len(fresh) < len(emitted_rows):
                 log.info(
-                    "resume replay: skipping %d already-landed row(s)",
+                    "skipping %d row(s) for already-landed tick(s) "
+                    "(duplicate feed message or resume replay)",
                     len(emitted_rows) - len(fresh),
                 )
             emitted_rows = fresh
         if emitted_rows:
             with self.timer.stage("land"):
                 self.warehouse.insert_rows(emitted_rows)
-            # signal AFTER the write commits: no sleep-and-retry race
+            # mark landed / signal AFTER the write commits: no
+            # sleep-and-retry race, no phantom dedupe entry on a failed
+            # insert
             with self.timer.stage("signal"):
                 for row in emitted_rows:
                     self._landed_ts.add(row["Timestamp"])
@@ -382,6 +393,14 @@ class StreamEngine:
         if horizon > 0:
             for buf in self._side_streams.values():
                 buf.evict_before(horizon - fc.join_tolerance_s)
+            # ticks below the horizon can never be emitted again (their
+            # side matches were just evicted), so their dedupe entries are
+            # dead weight — prune occasionally to bound the set
+            if len(self._landed_ts) > 8192:
+                cutoff = horizon - fc.join_tolerance_s
+                self._landed_ts = {
+                    t for t in self._landed_ts if to_epoch(t) >= cutoff
+                }
 
         if self.checkpoint_path:
             if polled_any or emitted_rows:
@@ -457,9 +476,6 @@ class StreamEngine:
         # the join loop trusts sorted order; make the invariant
         # self-establishing for checkpoints from any writer
         self._pending_deep.sort(key=lambda e: e.ts)
-        # seed replay idempotency from the warehouse (the source of truth
-        # for what already landed, however stale this checkpoint is)
-        self._landed_ts = set(self.warehouse.timestamps())
         for topic, dump in state.get("buffers", {}).items():
             if topic in self._side_streams:
                 buf = self._side_streams[topic]

@@ -127,6 +127,18 @@ class Warehouse:
             ).fetchall()
         return [r[0] for r in rows]
 
+    def recent_timestamps(self, limit: int) -> List[str]:
+        """Timestamps of the newest ``limit`` rows (newest-first) — the
+        engine seeds its landed-tick dedupe set from this without loading
+        a long history."""
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT Timestamp FROM {self.table} ORDER BY ID DESC "
+                "LIMIT ?",
+                (int(limit),),
+            ).fetchall()
+        return [r[0] for r in rows]
+
     def id_for_timestamp(self, ts: str) -> Optional[int]:
         """Row id of a timestamp (predict.py:144 lookup path)."""
         with self._lock:

@@ -443,3 +443,34 @@ def test_engine_resume_replay_is_idempotent(tmp_path):
     assert len(wh) == 7  # no duplicates
     ts = wh.timestamps()
     assert len(ts) == len(set(ts))
+
+
+def test_engine_dedupes_ticks_without_checkpoint():
+    """One output row per book tick (dropDuplicates intent,
+    spark_consumer.py:477): a duplicate DEEP message for an already-landed
+    tick must not land twice — including after a restart with no
+    checkpoint file at all (the engine seeds its landed-tick set from the
+    warehouse tail)."""
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+    msgs = list(_session_messages(3))
+    for topic, msg in msgs:
+        bus.publish(topic, msg)
+    eng.step()
+    assert len(wh) == 3
+
+    # duplicate feed messages for the same ticks (same timestamps)
+    for topic, msg in msgs:
+        bus.publish(topic, msg)
+    eng.step()
+    assert len(wh) == 3  # not six
+
+    # crash WITHOUT any checkpoint: fresh engine, fresh consumers from
+    # offset 0, same warehouse — every message replays, nothing re-lands
+    eng2 = StreamEngine(bus, wh, fc)
+    eng2.step()
+    assert len(wh) == 3
+    ts = wh.timestamps()
+    assert len(ts) == len(set(ts))
