@@ -102,7 +102,8 @@ class Application:
         return predictor
 
     def attach_streaming_predictor(self, core, **kwargs):
-        """O(1) carried-state predictor (unidirectional models)."""
+        """Carried-state predictor: O(1)/tick with a StreamingBiGRU core
+        (unidirectional), O(window)/tick with the bidirectional core."""
         from fmda_tpu.serve.streaming import StreamingPredictor
 
         predictor = StreamingPredictor(self.bus, self.warehouse, core, **kwargs)

@@ -209,6 +209,10 @@ def _parse_ind(value: dict, events: Tuple[str, ...]) -> _Event:
 class StreamEngine:
     """Micro-batch join engine over the bus feeds."""
 
+    #: in-memory landed-tick dedupe entries kept/seeded before falling
+    #: back to indexed warehouse lookups for older ticks
+    _LANDED_SEED_LIMIT = 5000
+
     def __init__(
         self,
         bus: MessageBus,
@@ -255,11 +259,16 @@ class StreamEngine:
         self._pending_deep: List[_Event] = []
         #: timestamps of landed ticks — the "exactly one output row per
         #: book tick" dropDuplicates semantics (spark_consumer.py:477),
-        #: which also makes crash-replay idempotent.  Seeded from the
-        #: warehouse tail at construction (bounded: offsets can only
-        #: rewind to the last checkpoint, never to history's start) and
-        #: pruned below the join watermark as the session runs.
-        self._landed_ts: set = set(warehouse.recent_timestamps(5000))
+        #: which also makes crash-replay idempotent.  Seeded bounded from
+        #: the warehouse tail at construction and pruned below the join
+        #: watermark as the session runs; ticks older than the seed window
+        #: fall back to an indexed warehouse lookup (deep replays stay
+        #: exact without holding all history in memory).
+        seed = warehouse.recent_timestamps(self._LANDED_SEED_LIMIT)
+        self._landed_ts: set = set(seed)
+        self._landed_seed_floor: Optional[str] = (
+            min(seed) if len(seed) >= self._LANDED_SEED_LIMIT else None
+        )
         self._emitted = 0
         self._dropped = 0
         #: per-stage wall-clock accounting (SURVEY.md §5: the reference has
@@ -362,6 +371,14 @@ class StreamEngine:
                 ts = r["Timestamp"]
                 if ts in self._landed_ts or ts in seen_now:
                     continue
+                # older than the bounded in-memory seed (deep replay):
+                # the warehouse itself is the source of truth
+                if (
+                    self._landed_seed_floor is not None
+                    and ts < self._landed_seed_floor
+                    and self.warehouse.id_for_timestamp(ts) is not None
+                ):
+                    continue
                 seen_now.add(ts)
                 fresh.append(r)
             if len(fresh) < len(emitted_rows):
@@ -393,11 +410,12 @@ class StreamEngine:
         if horizon > 0:
             for buf in self._side_streams.values():
                 buf.evict_before(horizon - fc.join_tolerance_s)
-            # ticks below the horizon can never be emitted again (their
-            # side matches were just evicted), so their dedupe entries are
-            # dead weight — prune occasionally to bound the set
+            # ticks more than one tolerance below the eviction boundary
+            # can never be emitted again (no surviving side event can fall
+            # in their [ts, ts+tol] match window), so their dedupe entries
+            # are dead weight — prune occasionally to bound the set
             if len(self._landed_ts) > 8192:
-                cutoff = horizon - fc.join_tolerance_s
+                cutoff = horizon - 2 * fc.join_tolerance_s
                 self._landed_ts = {
                     t for t in self._landed_ts if to_epoch(t) >= cutoff
                 }

@@ -82,6 +82,11 @@ class Warehouse:
         )
         with self._lock:
             self._conn.execute(ddl)
+            # timestamp lookups are on the serving and dedupe hot paths
+            self._conn.execute(
+                f"CREATE INDEX IF NOT EXISTS idx_{self.table}_ts "
+                f"ON {self.table}(Timestamp)"
+            )
             self._conn.commit()
 
     # -- writes --------------------------------------------------------------

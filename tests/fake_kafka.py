@@ -1,0 +1,128 @@
+"""Protocol-faithful in-memory stand-in for the ``kafka-python`` client API.
+
+No Kafka broker ships in this environment, so the KafkaBus adapter is
+exercised against this fake instead (the recorded-protocol strategy the
+transport layer uses for HTTP): it implements the exact client surface the
+adapter touches — producer send futures with RecordMetadata offsets,
+consumer assign/seek/poll batch semantics keyed by TopicPartition,
+end_offsets — over a module-level broker shared by every client with the
+same bootstrap servers, mirroring single-partition topic behavior
+(reference usage: predict.py:19-30, producer.py:103).
+
+Inject with ``monkeypatch.setitem(sys.modules, "kafka", fake_kafka)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+
+class TopicPartition(NamedTuple):
+    topic: str
+    partition: int
+
+
+class RecordMetadata(NamedTuple):
+    topic: str
+    partition: int
+    offset: int
+
+
+class ConsumerRecord(NamedTuple):
+    topic: str
+    partition: int
+    offset: int
+    value: object
+
+
+class _Broker:
+    def __init__(self) -> None:
+        self.topics: Dict[str, List[bytes]] = {}
+
+    def append(self, topic: str, data: bytes) -> int:
+        log = self.topics.setdefault(topic, [])
+        log.append(data)
+        return len(log) - 1
+
+    def end_offset(self, topic: str) -> int:
+        return len(self.topics.get(topic, []))
+
+
+_BROKERS: Dict[Tuple[str, ...], _Broker] = {}
+
+
+def _broker(bootstrap_servers) -> _Broker:
+    if isinstance(bootstrap_servers, str):
+        bootstrap_servers = [bootstrap_servers]
+    key = tuple(bootstrap_servers)
+    return _BROKERS.setdefault(key, _Broker())
+
+
+def reset() -> None:
+    _BROKERS.clear()
+
+
+class _Future:
+    def __init__(self, meta: RecordMetadata) -> None:
+        self._meta = meta
+
+    def get(self, timeout: Optional[float] = None) -> RecordMetadata:
+        return self._meta
+
+
+class KafkaProducer:
+    def __init__(self, bootstrap_servers=("localhost:9092",),
+                 value_serializer=None, **_) -> None:
+        self._broker = _broker(bootstrap_servers)
+        self._serializer = value_serializer or (lambda v: v)
+
+    def send(self, topic: str, value=None) -> _Future:
+        offset = self._broker.append(topic, self._serializer(value))
+        return _Future(RecordMetadata(topic, 0, offset))
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class KafkaConsumer:
+    def __init__(self, bootstrap_servers=("localhost:9092",), group_id=None,
+                 enable_auto_commit=False, value_deserializer=None, **_) -> None:
+        self._broker = _broker(bootstrap_servers)
+        self._deserializer = value_deserializer or (lambda b: b)
+        self._positions: Dict[TopicPartition, int] = {}
+        self._closed = False
+
+    def assign(self, partitions) -> None:
+        for tp in partitions:
+            self._positions.setdefault(tp, 0)
+
+    def seek(self, tp: TopicPartition, offset: int) -> None:
+        if tp not in self._positions:
+            raise AssertionError("seek() before assign() — client protocol bug")
+        self._positions[tp] = offset
+
+    def poll(self, timeout_ms: int = 0, max_records: Optional[int] = None):
+        if self._closed:
+            raise AssertionError("poll() on closed consumer")
+        out: Dict[TopicPartition, List[ConsumerRecord]] = {}
+        for tp, pos in self._positions.items():
+            log = self._broker.topics.get(tp.topic, [])
+            records = [
+                ConsumerRecord(tp.topic, 0, off, self._deserializer(log[off]))
+                for off in range(pos, len(log))
+            ]
+            if max_records is not None:
+                records = records[:max_records]
+            if records:
+                out[tp] = records
+                self._positions[tp] = records[-1].offset + 1
+        return out
+
+    def end_offsets(self, partitions) -> Dict[TopicPartition, int]:
+        return {tp: self._broker.end_offset(tp.topic) for tp in partitions}
+
+    def close(self) -> None:
+        self._closed = True

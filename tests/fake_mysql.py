@@ -1,0 +1,137 @@
+"""Protocol-faithful in-memory stand-in for ``mysql.connector`` (DB-API).
+
+No MariaDB server ships in this environment, so the MySQLWarehouse client
+runs against this fake: it enforces the client-side protocol (connect →
+CREATE DATABASE → USE before any table statement), records the bootstrap
+DDL, and serves the exact query shapes the client issues — COUNT, the
+IN (...) ORDER BY ID join fetch, the target-view fetch — from seeded rows.
+Rows are stored *unordered* and served strictly in ID order when (and only
+when) the query says ORDER BY, so the client's requested-order reordering
+and missing-row detection are genuinely exercised (ADVICE r1: a real
+multi-join SELECT without ORDER BY has unspecified row order).
+
+Inject with::
+
+    monkeypatch.setitem(sys.modules, "mysql", fake_mysql)
+    monkeypatch.setitem(sys.modules, "mysql.connector", fake_mysql.connector)
+"""
+
+from __future__ import annotations
+
+import re
+import types
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class FakeServer:
+    """One 'server' instance: seeded rows + a statement journal."""
+
+    def __init__(self) -> None:
+        self.statements: List[str] = []
+        self.databases: set = set()
+        self.current_db: Optional[str] = None
+        self.tables: set = set()
+        self.views: List[str] = []
+        #: id -> full join-select row (len == len(fc.x_fields()))
+        self.join_rows: Dict[int, Tuple[float, ...]] = {}
+        #: id -> (up1, up2, down1, down2)
+        self.target_rows: Dict[int, Tuple[float, ...]] = {}
+
+    def seed(self, join_rows: Dict[int, Sequence[float]],
+             target_rows: Dict[int, Sequence[float]]) -> None:
+        self.join_rows = {int(k): tuple(v) for k, v in join_rows.items()}
+        self.target_rows = {int(k): tuple(v) for k, v in target_rows.items()}
+
+
+_IN_CLAUSE = re.compile(r"IN \(([\d, ]+)\)")
+
+
+class _Cursor:
+    def __init__(self, server: FakeServer) -> None:
+        self._server = server
+        self._result: List[tuple] = []
+
+    # -- statement dispatch (the only protocol a DB-API client sees) ------
+
+    def execute(self, sql: str, params: Sequence = ()) -> None:
+        s = self._server
+        s.statements.append(sql)
+        stmt = sql.strip()
+        upper = stmt.upper()
+        if upper.startswith("CREATE DATABASE"):
+            s.databases.add(stmt.split()[-1].rstrip(";"))
+            return
+        if upper.startswith("USE "):
+            db = stmt.split()[-1].rstrip(";")
+            if db not in s.databases:
+                raise AssertionError(f"USE {db} before CREATE DATABASE")
+            s.current_db = db
+            return
+        if s.current_db is None:
+            raise AssertionError(f"statement before USE: {stmt[:60]}")
+        if upper.startswith("CREATE TABLE"):
+            s.tables.add(stmt.split()[5 if "IF NOT" in upper else 2])
+            return
+        if upper.startswith("CREATE OR REPLACE VIEW"):
+            s.views.append(stmt)
+            return
+        if upper.startswith("SELECT COUNT(ID)"):
+            self._result = [(len(s.join_rows),)]
+            return
+        if upper.startswith("SELECT SD.ID,"):
+            self._serve(stmt, s.join_rows, "sd.ID")
+            return
+        if upper.startswith("SELECT ID, UP1"):
+            self._serve(stmt, s.target_rows, "ID")
+            return
+        raise AssertionError(f"unexpected statement: {stmt[:80]}")
+
+    def _serve(self, stmt: str, rows: Dict[int, tuple], id_col: str) -> None:
+        m = _IN_CLAUSE.search(stmt)
+        if not m:
+            raise AssertionError(f"fetch without IN (...): {stmt[:80]}")
+        ids = [int(x) for x in m.group(1).split(",")]
+        found = [i for i in ids if i in rows]
+        # a real server is free to return any order *unless* ORDER BY is
+        # present; enforce that the client asked for it, then honor it
+        if f"ORDER BY {id_col}" not in stmt:
+            raise AssertionError(
+                "fetch without ORDER BY — row order would be unspecified "
+                "on a real multi-join SELECT (ADVICE r1)"
+            )
+        self._result = [(i,) + rows[i] for i in sorted(found)]
+
+    def fetchone(self) -> Optional[tuple]:
+        return self._result[0] if self._result else None
+
+    def fetchall(self) -> List[tuple]:
+        out, self._result = self._result, []
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class _Connection:
+    def __init__(self, server: FakeServer) -> None:
+        self._server = server
+
+    def cursor(self) -> _Cursor:
+        return _Cursor(self._server)
+
+    def close(self) -> None:
+        pass
+
+
+#: the singleton server the next connect() call attaches to
+SERVER = FakeServer()
+
+
+def _connect(host=None, port=None, user=None, password=None, **_) -> _Connection:
+    if not host or not user:
+        raise AssertionError("connect() without host/user")
+    return _Connection(SERVER)
+
+
+connector = types.ModuleType("mysql.connector")
+connector.connect = _connect

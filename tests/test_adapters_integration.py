@@ -1,0 +1,126 @@
+"""Deployment adapters against protocol-faithful fakes.
+
+The Kafka bus and MariaDB warehouse adapters previously had only
+string-level codegen tests; here they run end-to-end against in-memory
+stand-ins implementing the exact client-library surfaces they consume
+(tests/fake_kafka.py, tests/fake_mysql.py) — the same recorded-protocol
+strategy the HTTP transport layer uses.  With a real broker/server
+available these tests' subjects run unchanged; only the injected modules
+differ."""
+
+import sys
+
+import numpy as np
+import pytest
+
+import fake_kafka
+import fake_mysql
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    FeatureConfig,
+    TOPIC_PREDICT_TIMESTAMP,
+    WarehouseConfig,
+)
+from fmda_tpu.stream import StreamEngine, Warehouse
+
+from test_stream import _session_messages, _small_features
+
+
+@pytest.fixture
+def kafka_env(monkeypatch):
+    fake_kafka.reset()
+    monkeypatch.setitem(sys.modules, "kafka", fake_kafka)
+    yield
+    fake_kafka.reset()
+
+
+def test_kafka_bus_offsets_and_reads(kafka_env):
+    from fmda_tpu.stream.kafka_bus import KafkaBus
+
+    bus = KafkaBus(["a", "b"])
+    assert bus.publish("a", {"x": 1}) == 0
+    assert bus.publish("a", {"x": 2}) == 1
+    assert bus.end_offset("a") == 2
+    assert bus.end_offset("b") == 0
+    recs = bus.read("a", 0)
+    assert [r.value["x"] for r in recs] == [1, 2]
+    assert [r.offset for r in recs] == [0, 1]
+    assert [r.value["x"] for r in bus.read("a", 1)] == [2]
+    assert bus.read("a", 0, max_records=1)[0].value["x"] == 1
+    with pytest.raises(KeyError):
+        bus.publish("nope", {})
+
+    c = bus.consumer("a")
+    assert len(c.poll()) == 2
+    assert c.poll() == []
+    bus.publish("a", {"x": 3})
+    assert [r.value["x"] for r in c.poll()] == [3]
+    tail = bus.consumer("a", from_end=True)
+    assert tail.poll() == []
+    bus.publish("a", {"x": 4})
+    assert [r.value["x"] for r in tail.poll()] == [4]
+
+
+def test_kafka_bus_drives_full_engine(kafka_env):
+    """The whole streaming stack (engine joins, warehouse lands, signals
+    published) over the Kafka adapter instead of the in-process bus."""
+    from fmda_tpu.stream.kafka_bus import KafkaBus
+
+    fc = _small_features(get_cot=False)
+    bus = KafkaBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+    for topic, msg in _session_messages(5):
+        bus.publish(topic, msg)
+    eng.step()
+    assert len(wh) == 5
+    assert eng.stats["dropped"] == 0
+    signals = bus.read(TOPIC_PREDICT_TIMESTAMP, 0)
+    assert len(signals) == 5
+    assert signals[0].value["Timestamp"] == "2020-02-07 09:30:00"
+
+
+@pytest.fixture
+def mysql_env(monkeypatch):
+    fake_mysql.SERVER = fake_mysql.FakeServer()
+    monkeypatch.setitem(sys.modules, "mysql", fake_mysql)
+    monkeypatch.setitem(sys.modules, "mysql.connector", fake_mysql.connector)
+    yield fake_mysql.SERVER
+
+
+def test_mysql_warehouse_bootstrap_and_ordered_fetch(mysql_env):
+    from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse, all_view_sql
+
+    fc = FeatureConfig()
+    wh = MySQLWarehouse(fc, WarehouseConfig(backend="mysql"))
+
+    # bootstrap protocol: database created + selected, table + every view
+    server = mysql_env
+    assert server.current_db is not None
+    assert server.tables
+    assert len(server.views) == len(all_view_sql(fc, "stock_data_joined"))
+
+    n_fields = len(fc.x_fields())
+    server.seed(
+        join_rows={i: [float(i) * 10 + j for j in range(n_fields)]
+                   for i in range(1, 8)},
+        target_rows={i: [i % 2, 0.0, 1.0, i % 3] for i in range(1, 8)},
+    )
+    assert len(wh) == 7
+
+    # rows come back in the REQUESTED order, not the server's id order
+    x = wh.fetch([5, 2, 7])
+    assert x.shape == (3, n_fields)
+    np.testing.assert_allclose(x[:, 0], [50.0, 20.0, 70.0])
+    y = wh.fetch_targets([5, 2, 7])
+    np.testing.assert_allclose(y[:, 0], [1.0, 0.0, 1.0])
+
+    # duplicate ids in a window overlap fetch are honored per-position
+    x2 = wh.fetch([2, 2, 3])
+    np.testing.assert_allclose(x2[:, 0], [20.0, 20.0, 30.0])
+
+    # a missing id raises instead of silently misaligning the window
+    with pytest.raises(IndexError, match="no rows"):
+        wh.fetch([2, 99])
+    with pytest.raises(IndexError, match="no rows"):
+        wh.fetch_targets([99])

@@ -474,3 +474,28 @@ def test_engine_dedupes_ticks_without_checkpoint():
     assert len(wh) == 3
     ts = wh.timestamps()
     assert len(ts) == len(set(ts))
+
+
+def test_engine_dedupe_survives_replay_deeper_than_seed(monkeypatch):
+    """A replay rewinding past more rows than the bounded in-memory seed
+    must still not duplicate: ticks older than the seed window fall back
+    to the (indexed) warehouse lookup."""
+    monkeypatch.setattr(StreamEngine, "_LANDED_SEED_LIMIT", 4)
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+    for topic, msg in _session_messages(12):
+        bus.publish(topic, msg)
+    eng.step()
+    assert len(wh) == 12
+
+    # crash with no checkpoint: fresh engine replays all 12 ticks but its
+    # seed holds only the newest 4 timestamps
+    eng2 = StreamEngine(bus, wh, fc)
+    assert len(eng2._landed_ts) == 4
+    assert eng2._landed_seed_floor is not None
+    eng2.step()
+    assert len(wh) == 12
+    ts = wh.timestamps()
+    assert len(ts) == len(set(ts))
