@@ -223,6 +223,7 @@ class StreamEngine:
         checkpoint_path: Optional[str] = None,
         from_end: bool = False,
         checkpoint_every: int = 1,
+        join_backend: str = "python",
     ) -> None:
         self.bus = bus
         self.warehouse = warehouse
@@ -257,6 +258,21 @@ class StreamEngine:
         #: kept sorted by ts (insertion-sorted on ingest; feeds are nearly
         #: in order, so the bisect degenerates to an append)
         self._pending_deep: List[_Event] = []
+        #: optional C++ scheduler for the matching loop (join decisions
+        #: only — payloads stay in the Python buffers/pending list); the
+        #: "native" backend is bit-identical to "python", test-locked
+        self._core = None
+        if join_backend == "native":
+            from fmda_tpu.stream.native_join import NativeJoinCore
+
+            self._stream_topics = list(self._side_streams)
+            self._core = NativeJoinCore(
+                features.floor_s, features.join_tolerance_s,
+                features.watermark_s, len(self._stream_topics),
+            )
+        elif join_backend != "python":
+            raise ValueError(
+                f"join_backend {join_backend!r}; use 'python' or 'native'")
         #: timestamps of landed ticks — the "exactly one output row per
         #: book tick" dropDuplicates semantics (spark_consumer.py:477),
         #: which also makes crash-replay idempotent.  Seeded bounded from
@@ -296,21 +312,27 @@ class StreamEngine:
                 log.warning("bad deep message at offset %d: %s", rec.offset, e)
         for event in _parse_deep_batch(raws):
             bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
+            if self._core is not None:
+                self._core.add_deep(event.ts)
         parsers = {
             TOPIC_VIX: _parse_vix,
             TOPIC_VOLUME: _parse_volume,
             TOPIC_COT: _parse_cot,
             TOPIC_IND: lambda v: _parse_ind(v, fc.event_list_repl),
         }
-        for topic, buf in self._side_streams.items():
+        for idx, (topic, buf) in enumerate(self._side_streams.items()):
             for rec in self._consumers[topic].poll():
                 polled_any = True
                 try:
-                    buf.add(parsers[topic](rec.value))
+                    event = parsers[topic](rec.value)
                 except (KeyError, ValueError, TypeError) as e:
                     log.warning(
                         "bad %s message at offset %d: %s", topic, rec.offset, e
                     )
+                    continue
+                buf.add(event)
+                if self._core is not None:
+                    self._core.add_side(idx, event.ts)
         return polled_any
 
     # -- join ----------------------------------------------------------------
@@ -327,37 +349,40 @@ class StreamEngine:
         still_pending: List[_Event] = []
 
         with self.timer.stage("join"):
-            for deep_ev in self._pending_deep:  # insertion-sorted by ts
-                matches: Dict[str, _Event] = {}
-                expired = False  # some stream can provably never match
-                waiting = False  # some stream might still deliver a match
-                for topic, buf in self._side_streams.items():
-                    m = buf.match(deep_ev.ts, fc.join_tolerance_s)
-                    if m is not None:
-                        matches[topic] = m
-                    elif (
-                        buf.watermark(fc.watermark_s)
-                        > deep_ev.ts + fc.join_tolerance_s
-                    ):
-                        expired = True
-                    else:
-                        waiting = True
-                if expired:
-                    # inner join: one unmatched stream past its horizon
-                    # kills the row
-                    self._dropped += 1
-                    log.warning(
-                        "dropping unjoinable book row at %s (no side match "
-                        "within tolerance)", deep_ev.ts_str,
-                    )
-                elif waiting:
-                    still_pending.append(deep_ev)
-                else:  # all side streams matched
-                    row: Dict[str, float] = {"Timestamp": deep_ev.ts_str}
-                    row.update(deep_ev.payload)
-                    for m in matches.values():
-                        row.update(m.payload)
-                    emitted_rows.append(row)
+            if self._core is not None:
+                emitted_rows, still_pending = self._join_native()
+            else:
+                for deep_ev in self._pending_deep:  # insertion-sorted by ts
+                    matches: Dict[str, _Event] = {}
+                    expired = False  # some stream can provably never match
+                    waiting = False  # some stream might still deliver one
+                    for topic, buf in self._side_streams.items():
+                        m = buf.match(deep_ev.ts, fc.join_tolerance_s)
+                        if m is not None:
+                            matches[topic] = m
+                        elif (
+                            buf.watermark(fc.watermark_s)
+                            > deep_ev.ts + fc.join_tolerance_s
+                        ):
+                            expired = True
+                        else:
+                            waiting = True
+                    if expired:
+                        # inner join: one unmatched stream past its horizon
+                        # kills the row
+                        self._dropped += 1
+                        log.warning(
+                            "dropping unjoinable book row at %s (no side "
+                            "match within tolerance)", deep_ev.ts_str,
+                        )
+                    elif waiting:
+                        still_pending.append(deep_ev)
+                    else:  # all side streams matched
+                        row: Dict[str, float] = {"Timestamp": deep_ev.ts_str}
+                        row.update(deep_ev.payload)
+                        for m in matches.values():
+                            row.update(m.payload)
+                        emitted_rows.append(row)
 
         self._pending_deep = still_pending
 
@@ -434,6 +459,48 @@ class StreamEngine:
                 self.checkpoint()
         return len(emitted_rows)
 
+    def _find_side_event(self, topic: str, ts: int) -> _Event:
+        """Payload of the side event the native scheduler matched (the
+        first-added event at that timestamp, the C++ tie rule)."""
+        buf = self._side_streams[topic]
+        for e in buf.buckets.get(floor_epoch(ts, buf.floor_s), ()):
+            if e.ts == ts:
+                return e
+        raise RuntimeError(
+            f"native join matched {topic}@{ts} but the payload buffer has "
+            "no such event (state divergence)"
+        )
+
+    def _join_native(self) -> Tuple[List[Dict[str, float]], List[_Event]]:
+        """Join decisions from the C++ scheduler; payload assembly here."""
+        from collections import defaultdict
+
+        by_ts: Dict[int, List[_Event]] = defaultdict(list)
+        for e in self._pending_deep:
+            by_ts[e.ts].append(e)
+        emitted, dropped = self._core.step()
+        for ts in dropped:
+            deep_ev = by_ts[ts].pop(0)
+            self._dropped += 1
+            log.warning(
+                "dropping unjoinable book row at %s (no side match within "
+                "tolerance)", deep_ev.ts_str,
+            )
+        rows: List[Dict[str, float]] = []
+        for tup in emitted:
+            deep_ev = by_ts[tup[0]].pop(0)
+            row: Dict[str, float] = {"Timestamp": deep_ev.ts_str}
+            row.update(deep_ev.payload)
+            for i, topic in enumerate(self._stream_topics):
+                row.update(self._find_side_event(topic, tup[1 + i]).payload)
+            rows.append(row)
+        still_pending = [
+            e
+            for e in self._pending_deep
+            if any(kept is e for kept in by_ts[e.ts])
+        ]
+        return rows, still_pending
+
     # -- observability -------------------------------------------------------
 
     @property
@@ -503,3 +570,21 @@ class StreamEngine:
                 # the watermark can be ahead of any buffered event (post-
                 # eviction); restore it exactly
                 buf.max_ts = dump["max_ts"]
+        if self._core is not None:
+            # mirror the restored state into a FRESH C++ scheduler (the
+            # Python side fully reset above; appending to a used core
+            # would duplicate its state)
+            from fmda_tpu.stream.native_join import NativeJoinCore
+
+            fc = self.features
+            self._core = NativeJoinCore(
+                fc.floor_s, fc.join_tolerance_s, fc.watermark_s,
+                len(self._stream_topics),
+            )
+            for idx, (topic, buf) in enumerate(self._side_streams.items()):
+                for e in buf.events:
+                    self._core.add_side(idx, e.ts)
+                if buf.max_ts >= 0:
+                    self._core.force_max_ts(idx, buf.max_ts)
+            for e in self._pending_deep:
+                self._core.add_deep(e.ts)

@@ -13,47 +13,20 @@ from __future__ import annotations
 import ctypes
 import json
 import logging
-import os
-import subprocess
 from typing import Iterable, List, Optional, Sequence
 
+from fmda_tpu.stream._native import build_and_load
 from fmda_tpu.stream.bus import Consumer, Record
 
 log = logging.getLogger("fmda_tpu.stream")
-
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-)
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libringbus.so")
 
 
 class NativeBusUnavailable(RuntimeError):
     pass
 
 
-def _build_library() -> str:
-    if os.path.exists(_LIB_PATH):
-        return _LIB_PATH
-    try:
-        subprocess.run(
-            ["make", "-C", _NATIVE_DIR],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError) as e:
-        detail = ""
-        if isinstance(e, subprocess.CalledProcessError):
-            detail = f": {e.stderr.decode(errors='replace')[-500:]}"
-        raise NativeBusUnavailable(f"cannot build libringbus ({e}){detail}") from e
-    if not os.path.exists(_LIB_PATH):
-        raise NativeBusUnavailable("build succeeded but library missing")
-    return _LIB_PATH
-
-
 def _load_library() -> ctypes.CDLL:
-    lib = ctypes.CDLL(_build_library())
+    lib = build_and_load("libringbus.so", NativeBusUnavailable)
     lib.rb_create.restype = ctypes.c_void_p
     lib.rb_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
     lib.rb_destroy.argtypes = [ctypes.c_void_p]

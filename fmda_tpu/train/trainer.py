@@ -29,7 +29,7 @@ from fmda_tpu.config import ModelConfig, TrainConfig
 from fmda_tpu.data.pipeline import Batch, ChunkDataset, WindowBatches, prefetch_to_device
 from fmda_tpu.data.source import FeatureSource
 from fmda_tpu.models.bigru import BiGRU
-from fmda_tpu.ops.metrics import MultilabelMetrics, multilabel_metrics
+from fmda_tpu.ops.metrics import multilabel_metrics
 from fmda_tpu.train.losses import class_weights, weighted_bce_with_logits
 
 log = logging.getLogger("fmda_tpu.train")
@@ -228,10 +228,11 @@ class Trainer:
     ) -> Tuple[TrainState, EpochMetrics, np.ndarray]:
         from fmda_tpu.utils.tracing import step_annotation
 
-        # Per-batch results stay on device (async) — converting them here
-        # would block the host on every step and serialize the pipeline.
-        # One device_get at the end of the pass drains everything.
-        device_results = []
+        # Per-batch results are folded into running on-device accumulators
+        # (async adds) — the host never blocks mid-pass and memory stays
+        # O(1) instead of holding every batch's arrays live across an
+        # epoch.  One device_get at the end drains the totals.
+        acc = None
         step_no = 0
         for batches in batch_iterables:
             for batch in batches:
@@ -244,12 +245,12 @@ class Trainer:
                     else:
                         loss, metrics = self._eval_step(state.params, batch)
                 step_no += 1
-                device_results.append((loss, metrics))
-        results: List[Tuple[np.ndarray, MultilabelMetrics]] = jax.device_get(
-            device_results
-        )
+                vals = (loss, metrics.accuracy, metrics.hamming,
+                        metrics.fbeta, metrics.confusion)
+                acc = vals if acc is None else jax.tree.map(
+                    jnp.add, acc, vals)
         n_classes = self.model_cfg.output_size
-        if not results:
+        if acc is None:
             log.warning(
                 "pass produced no batches (source too short for "
                 "window=%d/chunk_size=%d, or empty chunk split) — metrics "
@@ -261,16 +262,16 @@ class Trainer:
                 EpochMetrics(nan, nan, nan, np.zeros(n_classes)),
                 np.zeros((n_classes, 2, 2), np.int64),
             )
+        loss_sum, acc_sum, ham_sum, fbeta_sum, confusion_total = (
+            jax.device_get(acc)
+        )
         epoch = EpochMetrics(
-            loss=float(np.mean([r[0] for r in results])),
-            accuracy=float(np.mean([r[1].accuracy for r in results])),
-            hamming=float(np.mean([r[1].hamming for r in results])),
-            fbeta=np.mean([r[1].fbeta for r in results], axis=0),
+            loss=float(loss_sum) / step_no,
+            accuracy=float(acc_sum) / step_no,
+            hamming=float(ham_sum) / step_no,
+            fbeta=np.asarray(fbeta_sum) / step_no,
         )
-        confusion_total = np.sum(
-            [r[1].confusion.astype(np.int64) for r in results], axis=0
-        )
-        return state, epoch, confusion_total
+        return state, epoch, np.asarray(confusion_total, np.int64)
 
     def fit(
         self,

@@ -1,0 +1,179 @@
+// Native interval-join scheduler: the hot matching loop of the streaming
+// engine (the role Spark's micro-batch join scheduler plays in the
+// reference, spark_consumer.py:434-477) as a C++ core.
+//
+// Semantics are exactly fmda_tpu/stream/engine.py's:
+//   - side events bucket by floored timestamp; max_ts tracks the watermark;
+//   - a book (deep) row matches a side stream iff an event shares its floor
+//     AND lies in [deep_ts, deep_ts + tolerance] — earliest such event wins;
+//   - a row with every stream matched emits; a row that some stream can
+//     provably never match (watermark past its horizon) drops; otherwise it
+//     stays pending;
+//   - buffers evict below min-watermark - tolerance.
+//
+// Payloads never cross the boundary: the Python engine keeps them keyed by
+// (stream, ts) and this core schedules pure int64 timestamps.  C ABI for
+// ctypes; single-threaded by design (the engine steps one micro-batch at a
+// time) with a mutex guarding against accidental concurrent stepping.
+
+#include <algorithm>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Stream {
+  // floor -> sorted-on-demand event timestamps
+  std::map<int64_t, std::vector<int64_t>> buckets;
+  int64_t max_ts = -1;
+};
+
+struct JoinCore {
+  int64_t floor_s;
+  int64_t tol_s;
+  int64_t watermark_s;
+  int32_t n_streams;
+  std::vector<Stream> streams;
+  std::vector<int64_t> pending;  // deep rows, kept sorted
+  std::mutex mu;
+
+  int64_t floor_of(int64_t ts) const {
+    int64_t f = ts / floor_s;
+    if (ts < 0 && ts % floor_s != 0) --f;  // floor toward -inf, like Python
+    return f * floor_s;
+  }
+};
+
+int64_t stream_watermark(const JoinCore& jc, const Stream& s) {
+  return s.max_ts >= 0 ? s.max_ts - jc.watermark_s : -1;
+}
+
+// earliest event with equal floor and ts in [deep, deep+tol]; -1 if none
+int64_t match_stream(const JoinCore& jc, Stream& s, int64_t deep_ts) {
+  auto it = s.buckets.find(jc.floor_of(deep_ts));
+  if (it == s.buckets.end()) return -1;
+  int64_t best = -1;
+  for (int64_t ts : it->second) {
+    if (ts < deep_ts || ts > deep_ts + jc.tol_s) continue;
+    if (best < 0 || ts < best) best = ts;
+  }
+  return best;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* jc_create(int64_t floor_s, int64_t tol_s, int64_t watermark_s,
+                int32_t n_streams) {
+  if (floor_s <= 0 || n_streams < 0) return nullptr;
+  auto* jc = new JoinCore{floor_s, tol_s, watermark_s, n_streams, {}, {}, {}};
+  jc->streams.resize(static_cast<size_t>(n_streams));
+  return jc;
+}
+
+void jc_destroy(void* h) { delete static_cast<JoinCore*>(h); }
+
+void jc_add_side(void* h, int32_t stream, int64_t ts) {
+  auto* jc = static_cast<JoinCore*>(h);
+  std::lock_guard<std::mutex> lock(jc->mu);
+  if (stream < 0 || stream >= jc->n_streams) return;
+  Stream& s = jc->streams[static_cast<size_t>(stream)];
+  s.buckets[jc->floor_of(ts)].push_back(ts);
+  s.max_ts = std::max(s.max_ts, ts);
+}
+
+// checkpoint restore: the watermark can be ahead of every buffered event
+// (post-eviction); force it without inserting a synthetic event
+void jc_force_max_ts(void* h, int32_t stream, int64_t max_ts) {
+  auto* jc = static_cast<JoinCore*>(h);
+  std::lock_guard<std::mutex> lock(jc->mu);
+  if (stream < 0 || stream >= jc->n_streams) return;
+  Stream& s = jc->streams[static_cast<size_t>(stream)];
+  s.max_ts = std::max(s.max_ts, max_ts);
+}
+
+void jc_add_deep(void* h, int64_t ts) {
+  auto* jc = static_cast<JoinCore*>(h);
+  std::lock_guard<std::mutex> lock(jc->mu);
+  auto it = std::upper_bound(jc->pending.begin(), jc->pending.end(), ts);
+  jc->pending.insert(it, ts);
+}
+
+int64_t jc_pending(void* h) {
+  auto* jc = static_cast<JoinCore*>(h);
+  std::lock_guard<std::mutex> lock(jc->mu);
+  return static_cast<int64_t>(jc->pending.size());
+}
+
+// One micro-batch. out_rows: cap_rows x (1 + n_streams) int64s — deep ts
+// then the matched ts per stream. out_drops: dropped deep ts.  Returns the
+// number of emitted rows; *n_dropped is set.  Caller sizes cap_* >= the
+// current pending count, so truncation cannot occur.
+int64_t jc_step(void* h, int64_t* out_rows, int64_t cap_rows,
+                int64_t* out_drops, int64_t cap_drops, int64_t* n_dropped) {
+  auto* jc = static_cast<JoinCore*>(h);
+  std::lock_guard<std::mutex> lock(jc->mu);
+  std::vector<int64_t> still_pending;
+  still_pending.reserve(jc->pending.size());
+  int64_t emitted = 0, dropped = 0;
+  const size_t ns = static_cast<size_t>(jc->n_streams);
+  std::vector<int64_t> matches(ns);
+
+  for (int64_t deep_ts : jc->pending) {
+    bool expired = false, waiting = false;
+    for (size_t i = 0; i < ns; ++i) {
+      int64_t m = match_stream(*jc, jc->streams[i], deep_ts);
+      matches[i] = m;
+      if (m >= 0) continue;
+      if (stream_watermark(*jc, jc->streams[i]) > deep_ts + jc->tol_s)
+        expired = true;
+      else
+        waiting = true;
+    }
+    if (expired) {
+      if (dropped < cap_drops) out_drops[dropped] = deep_ts;
+      ++dropped;
+    } else if (waiting) {
+      still_pending.push_back(deep_ts);
+    } else {
+      if (emitted < cap_rows) {
+        int64_t* row = out_rows + emitted * (1 + jc->n_streams);
+        row[0] = deep_ts;
+        for (size_t i = 0; i < ns; ++i) row[1 + i] = matches[i];
+      }
+      ++emitted;
+    }
+  }
+  jc->pending = std::move(still_pending);
+
+  // evict below the global watermark horizon
+  int64_t horizon = INT64_MAX;
+  for (const Stream& s : jc->streams)
+    horizon = std::min(horizon, stream_watermark(*jc, s));
+  if (!jc->streams.empty() && horizon > 0) {
+    const int64_t cutoff = horizon - jc->tol_s;
+    for (Stream& s : jc->streams) {
+      for (auto it = s.buckets.begin(); it != s.buckets.end();) {
+        if (it->first + jc->floor_s <= cutoff) {
+          it = s.buckets.erase(it);
+        } else if (it->first < cutoff) {  // boundary bucket: exact filter
+          auto& v = it->second;
+          v.erase(std::remove_if(v.begin(), v.end(),
+                                 [cutoff](int64_t t) { return t < cutoff; }),
+                  v.end());
+          if (v.empty()) it = s.buckets.erase(it);
+          else ++it;
+        } else {
+          ++it;
+        }
+      }
+    }
+  }
+  *n_dropped = dropped;
+  return emitted;
+}
+
+}  // extern "C"

@@ -499,3 +499,82 @@ def test_engine_dedupe_survives_replay_deeper_than_seed(monkeypatch):
     assert len(wh) == 12
     ts = wh.timestamps()
     assert len(ts) == len(set(ts))
+
+
+def _native_join_available():
+    from fmda_tpu.stream.native_join import native_join_available
+
+    return native_join_available()
+
+
+def test_native_join_backend_matches_python():
+    """The C++ join scheduler must make bit-identical decisions to the
+    Python path over a full synthetic session, including late-stream waits
+    and watermark drops (some VIX ticks are withheld so their book rows
+    provably expire)."""
+    if not _native_join_available():
+        pytest.skip("native toolchain unavailable")
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, synthetic_session_messages
+
+    fc = FeatureConfig()
+    msgs = []
+    vix_seen = 0
+    for topic, msg in synthetic_session_messages(
+            fc, SyntheticMarketConfig(seed=9, n_days=2)):
+        if topic == TOPIC_VIX:
+            vix_seen += 1
+            if vix_seen % 11 == 0:  # unmatched book rows -> watermark drops
+                continue
+        msgs.append((topic, msg))
+
+    results = {}
+    for backend in ("python", "native"):
+        bus = InProcessBus(DEFAULT_TOPICS)
+        wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+        eng = StreamEngine(bus, wh, fc, join_backend=backend)
+        for i, (topic, msg) in enumerate(msgs):
+            bus.publish(topic, msg)
+            if i % 37 == 0:  # interleave polling with publishing
+                eng.step()
+        eng.step()
+        results[backend] = (
+            dict(eng.stats), wh.timestamps(),
+            wh.fetch(range(1, len(wh) + 1)),
+        )
+    assert results["python"][0]["dropped"] > 0  # the drop path really ran
+    assert results["python"][0] == results["native"][0]
+    assert results["python"][1] == results["native"][1]
+    np.testing.assert_array_equal(results["python"][2], results["native"][2])
+
+
+def test_native_join_checkpoint_resume(tmp_path):
+    """Checkpoint/resume restores the C++ scheduler's state (buffers,
+    watermarks, pending rows) exactly."""
+    if not _native_join_available():
+        pytest.skip("native toolchain unavailable")
+    fc = _small_features(get_cot=False)
+    ckpt = str(tmp_path / "engine.json")
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc, checkpoint_path=ckpt,
+                       join_backend="native")
+    # book rows published without their late side streams: stay pending
+    msgs = list(_session_messages(4))
+    for topic, msg in msgs:
+        if topic == TOPIC_DEEP:
+            bus.publish(topic, msg)
+    eng.step()
+    assert eng.stats["pending"] == 4
+    eng.checkpoint()
+
+    eng2 = StreamEngine(bus, wh, fc, checkpoint_path=ckpt,
+                        join_backend="native")
+    assert eng2.stats["pending"] == 4
+    eng2.restore()  # re-restoring must not duplicate the C++ core's state
+    assert eng2._core.pending == 4
+    for topic, msg in msgs:  # now the side streams arrive
+        if topic != TOPIC_DEEP:
+            bus.publish(topic, msg)
+    eng2.step()
+    assert eng2.stats == {"emitted": 4, "dropped": 0, "pending": 0}
+    assert len(wh) == 4
