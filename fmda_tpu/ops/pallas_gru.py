@@ -70,15 +70,18 @@ def _gru_step_kernel(
 
     h = h_scratch[:]
     hidden = h.shape[-1]
-    xp_t = xp_ref[0]
-    hp = (
-        jnp.dot(h, w_hh_t_ref[:], preferred_element_type=jnp.float32)
-        + b_hh_ref[:]
-    ).astype(h.dtype)
+    # gate algebra in f32 on the VPU regardless of the I/O dtype: the MXU
+    # matmul already accumulates f32, and Mosaic rejects mixed-dtype
+    # scalar broadcasts (e.g. sigmoid's constants) on bf16 vectors
+    f32 = jnp.float32
+    xp_t = xp_ref[0].astype(f32)
+    hp = jnp.dot(
+        h, w_hh_t_ref[:], preferred_element_type=f32
+    ) + b_hh_ref[:].astype(f32)
     r = jax.nn.sigmoid(xp_t[:, :hidden] + hp[:, :hidden])
     z = jax.nn.sigmoid(xp_t[:, hidden : 2 * hidden] + hp[:, hidden : 2 * hidden])
     n = jnp.tanh(xp_t[:, 2 * hidden :] + r * hp[:, 2 * hidden :])
-    h_new = (1.0 - z) * n + z * h
+    h_new = ((1.0 - z) * n + z * h.astype(f32)).astype(h.dtype)
 
     h_scratch[:] = h_new
     hs_ref[0] = h_new
@@ -156,22 +159,22 @@ def _gru_bwd_kernel(
         dwt_ref[:] = jnp.zeros_like(dwt_ref[:])
         db_ref[:] = jnp.zeros_like(db_ref[:])
 
-    h_prev = hprev_ref[0]
-    xp_t = xp_ref[0]
-    hidden = h_prev.shape[-1]
+    hidden = hprev_ref.shape[-1]
     f32 = jnp.float32
+    # all gate/cotangent algebra in f32 (see forward kernel note)
+    h_prev = hprev_ref[0].astype(f32)
+    xp_t = xp_ref[0].astype(f32)
 
     # gate recompute — identical math to the forward kernel
-    hp = (
-        jnp.dot(h_prev, w_hh_t_ref[:], preferred_element_type=f32)
-        + b_hh_ref[:]
-    ).astype(h_prev.dtype)
+    hp = jnp.dot(
+        hprev_ref[0], w_hh_t_ref[:], preferred_element_type=f32
+    ) + b_hh_ref[:].astype(f32)
     r = jax.nn.sigmoid(xp_t[:, :hidden] + hp[:, :hidden])
     z = jax.nn.sigmoid(xp_t[:, hidden : 2 * hidden] + hp[:, hidden : 2 * hidden])
     n = jnp.tanh(xp_t[:, 2 * hidden :] + r * hp[:, 2 * hidden :])
 
     # h_t = (1-z)*n + z*h_prev
-    dh = dh_scratch[:] + dhs_ref[0]
+    dh = dh_scratch[:].astype(f32) + dhs_ref[0].astype(f32)
     dn = dh * (1.0 - z)
     dz = dh * (h_prev - n)
     dn_pre = dn * (1.0 - n * n)
@@ -183,14 +186,23 @@ def _gru_bwd_kernel(
     dg_x = jnp.concatenate([dr_pre, dz_pre, dn_pre], axis=-1)
     dg_h = jnp.concatenate([dr_pre, dz_pre, dn_pre * r], axis=-1)
 
-    dxp_ref[0] = dg_x
+    io_dtype = dxp_ref.dtype
+    dxp_ref[0] = dg_x.astype(io_dtype)
+    # MXU operands in the I/O dtype (bf16 matmuls on TPU) with f32
+    # accumulation; the SAME rounded dg_h feeds both the dh chain and the
+    # weight/bias gradients so they stay mutually consistent.  The dwt/db
+    # accumulators, the dh carry, and dh0 are f32 regardless of the I/O
+    # dtype — a bf16 `+=` over T grid steps would stall once the running
+    # sum outgrows the per-step terms (8 mantissa bits).
+    dg_h_c = dg_h.astype(io_dtype)
     dh_prev = dh * z + jnp.dot(
-        dg_h, w_hh_ref[:], preferred_element_type=f32
-    ).astype(dh.dtype)
+        dg_h_c, w_hh_ref[:], preferred_element_type=f32
+    )
     dwt_ref[:] += jax.lax.dot_general(
-        h_prev, dg_h, (((0,), (0,)), ((), ())), preferred_element_type=f32
-    ).astype(dwt_ref.dtype)
-    db_ref[:] += jnp.sum(dg_h, axis=0, keepdims=True).astype(db_ref.dtype)
+        hprev_ref[0], dg_h_c, (((0,), (0,)), ((), ())),
+        preferred_element_type=f32,
+    )
+    db_ref[:] += jnp.sum(dg_h_c.astype(f32), axis=0, keepdims=True)
     dh_scratch[:] = dh_prev
     dh0_ref[:] = dh_prev
 
@@ -240,11 +252,13 @@ def _gru_scan_pallas_bwd_impl(
         ],
         out_shape=[
             jax.ShapeDtypeStruct((seq_len, batch, 3 * hidden), dtype),
-            jax.ShapeDtypeStruct((batch, hidden), dtype),
-            jax.ShapeDtypeStruct((hidden, 3 * hidden), dtype),
-            jax.ShapeDtypeStruct((1, 3 * hidden), dtype),
+            # dh0 / dwt / db accumulate in f32 whatever the I/O dtype (see
+            # kernel note); cast to the residual dtypes on return
+            jax.ShapeDtypeStruct((batch, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((hidden, 3 * hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, 3 * hidden), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((batch, hidden), dtype)],
+        scratch_shapes=[pltpu.VMEM((batch, hidden), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
@@ -253,7 +267,7 @@ def _gru_scan_pallas_bwd_impl(
         xp_tm,
         hprev_tm,
         dhs_tm,
-        dh_last.astype(dtype),
+        dh_last.astype(jnp.float32),
         w_hh.astype(dtype),
         w_hh_t.astype(dtype),
         b_hh_2d.astype(dtype),

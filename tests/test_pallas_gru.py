@@ -52,6 +52,36 @@ def test_pallas_kernel_nonzero_h0():
 
 
 @pytest.mark.parametrize("reverse", [False, True])
+def test_pallas_kernel_bf16_numerics_close_to_scan(reverse):
+    """bf16 kernel outputs and gradients track the bf16 lax.scan path
+    within bf16 tolerance (catches precision bugs the all-zero lowering
+    test cannot — e.g. low-precision accumulators)."""
+    w, _, xp32, _ = _setup(batch=8, seq=16, hidden=8)
+    bf16 = jnp.bfloat16
+    xp = xp32.astype(bf16)
+    h0 = jax.random.normal(jax.random.PRNGKey(5), (8, 8), bf16)
+
+    def loss(fn, *args):
+        h_last, hs = fn(*args)
+        return (jnp.sum(h_last.astype(jnp.float32) ** 2)
+                + jnp.sum(jnp.sin(hs.astype(jnp.float32))))
+
+    args = (xp, h0, w.w_hh.astype(bf16), w.b_hh.astype(bf16))
+    g_pal = jax.grad(
+        lambda *a: loss(
+            lambda *x: gru_scan_pallas(*x, reverse=reverse, interpret=True),
+            *a),
+        argnums=(0, 1, 2, 3))(*args)
+    g_ref = jax.grad(
+        lambda *a: loss(lambda *x: gru_scan(*x, reverse=reverse), *a),
+        argnums=(0, 1, 2, 3))(*args)
+    for a, b in zip(g_pal, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("reverse", [False, True])
 def test_pallas_kernel_gradients_match(reverse):
     """The backward Pallas kernel (reverse-time grid, in-kernel gate
     recompute) must give the reference scan's gradients for every input,
@@ -74,26 +104,29 @@ def test_pallas_kernel_gradients_match(reverse):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
 @pytest.mark.parametrize("reverse", [False, True])
 @pytest.mark.parametrize(
     "batch,seq,hidden",
     [(256, 30, 32), (16, 1024, 32), (800, 30, 32)],
     ids=["flagship", "longctx", "multiticker"],
 )
-def test_pallas_kernel_lowers_for_tpu(batch, seq, hidden, reverse):
+def test_pallas_kernel_lowers_for_tpu(batch, seq, hidden, reverse, dtype):
     """Mosaic TPU lowering of the full fwd+bwd kernel pair at every bench
-    shape, both directions, via jax.export — no TPU required.  This is what
-    rejected the original batch-major (B, 1, 3H) block layout (sublane dim
-    1 < 8)."""
-    xp = jnp.zeros((batch, seq, 3 * hidden), jnp.float32)
-    h0 = jnp.zeros((batch, hidden), jnp.float32)
-    w_hh = jnp.zeros((3 * hidden, hidden), jnp.float32)
-    b_hh = jnp.zeros((3 * hidden,), jnp.float32)
+    shape, both directions and compute dtypes, via jax.export — no TPU
+    required.  This is what rejected the original batch-major (B, 1, 3H)
+    block layout (sublane dim 1 < 8) and the mixed-dtype bf16 gate math."""
+    dt = jnp.dtype(dtype)
+    xp = jnp.zeros((batch, seq, 3 * hidden), dt)
+    h0 = jnp.zeros((batch, hidden), dt)
+    w_hh = jnp.zeros((3 * hidden, hidden), dt)
+    b_hh = jnp.zeros((3 * hidden,), dt)
 
     def train_like(xp, h0, w_hh, b_hh):
         def loss(*args):
             h_last, hs = gru_scan_pallas(*args, reverse=reverse)
-            return jnp.sum(h_last) + jnp.sum(hs * hs)
+            return (jnp.sum(h_last.astype(jnp.float32))
+                    + jnp.sum(hs.astype(jnp.float32) ** 2))
 
         return jax.grad(loss, argnums=(0, 1, 2, 3))(xp, h0, w_hh, b_hh)
 
