@@ -110,6 +110,19 @@ def pallas_scan_available() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def select_scan_fn(use_pallas: bool, mask: Optional[jax.Array] = None):
+    """The canonical kernel-vs-lax.scan choice, shared by every caller
+    (single-device :func:`gru_layer` and the sequence-parallel path) so
+    the kernel's support envelope is gated in exactly one place: the
+    fused kernel runs when requested, unmasked, and on a TPU backend;
+    anything else silently falls back to :func:`gru_scan`."""
+    if use_pallas and mask is None and pallas_scan_available():
+        from fmda_tpu.ops import pallas_gru
+
+        return pallas_gru.gru_scan_pallas
+    return gru_scan
+
+
 def gru_layer(
     x: jax.Array,
     weights: GRUWeights,
@@ -137,15 +150,12 @@ def gru_layer(
     if h0 is None:
         h0 = jnp.zeros((batch, hidden), dtype=x.dtype)
     xp = input_projection(x, weights)
-    if use_pallas and mask is None and pallas_scan_available():
+    scan_fn = select_scan_fn(use_pallas, mask)
+    if scan_fn is not gru_scan:
         # The Pallas kernel pair already rematerialises: the backward
         # kernel stores only the forward outputs (hs) and recomputes the
         # gates in-VMEM per step, so `remat` is inherently satisfied.
-        from fmda_tpu.ops import pallas_gru
-
-        return pallas_gru.gru_scan_pallas(
-            xp, h0, weights.w_hh, weights.b_hh, reverse=reverse
-        )
+        return scan_fn(xp, h0, weights.w_hh, weights.b_hh, reverse=reverse)
     if remat:
         return jax.checkpoint(
             functools.partial(gru_scan, reverse=reverse, mask=mask)

@@ -10,11 +10,13 @@ step per timestep:
   execute sequentially on the TPU core, so VMEM scratch legitimately
   carries state across steps;
 - the sequence is laid out **time-major** ``(T, B, 3H)`` so each grid
-  step's block is ``(1, B, 3H)`` — its last two dims (B, 3H) satisfy
-  Mosaic's (8, 128)-divisible-or-full-dim tiling rule for any B % 8 == 0,
-  where the batch-major ``(B, 1, 3H)`` block (sublane dim 1) does not
-  lower at all (validated against the Mosaic TPU lowering via
-  jax.export);
+  step's block is ``(1, B, 3H)`` — its last two dims span the array's
+  full (B, 3H) plane, satisfying Mosaic's divisible-by-(8, 128)-or-
+  full-dim tiling rule for *any* batch (validated against the real
+  Mosaic TPU lowering via jax.export down to B = 2, covering the
+  sub-batch microbatches of the pipelined sp scan), where the
+  batch-major ``(B, 1, 3H)`` block (sublane dim 1) does not lower at
+  all;
 - per step: one (B,H) x (H,3H) matmul on the MXU (the input projection
   ``x @ W_ih^T`` is NOT in the kernel — it is a big batched matmul XLA
   already tiles perfectly, computed once outside; see fmda_tpu.ops.gru);

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fmda_tpu.config import ModelConfig
-from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection
+from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection, select_scan_fn
 from fmda_tpu.parallel.collectives import (
     all_gather,
     all_reduce_sum,
@@ -51,6 +51,7 @@ def sp_gru_scan(
     *,
     reverse: bool = False,
     vary_axes: Optional[Tuple[str, ...]] = None,
+    scan_fn=gru_scan,
 ) -> Tuple[jax.Array, jax.Array]:
     """Time-sharded GRU recurrence (call inside shard_map).
 
@@ -59,6 +60,9 @@ def sp_gru_scan(
       h0: global initial hidden state (B, H), replicated.
       axis_name: the sp mesh axis.
       reverse: backward-direction scan (stages run right-to-left).
+      scan_fn: the local-block recurrence — :func:`gru_scan` (default) or
+        the fused Pallas kernel, which then runs per-shard inside the
+        shard_map (kernel speed composes with sp sharding).
 
     Returns:
       (h_last, hs_local): the *global* final hidden state (replicated on
@@ -76,7 +80,7 @@ def sp_gru_scan(
     h_final = jnp.zeros_like(h0)
     for k in range(n):  # static: mesh size is known at trace time
         stage_dev = (n - 1 - k) if reverse else k
-        h_out, ys = gru_scan(xp_local, carry, w_hh, b_hh, reverse=reverse)
+        h_out, ys = scan_fn(xp_local, carry, w_hh, b_hh, reverse=reverse)
         take = idx == stage_dev
         hs_local = jnp.where(take, ys, hs_local)
         h_final = jnp.where(take, h_out, h_final)
@@ -105,6 +109,7 @@ def sp_gru_scan_pipelined(
     n_microbatches: int,
     reverse: bool = False,
     vary_axes: Optional[Tuple[str, ...]] = None,
+    scan_fn=gru_scan,
 ) -> Tuple[jax.Array, jax.Array]:
     """Microbatch-pipelined time-sharded recurrence.
 
@@ -152,7 +157,7 @@ def sp_gru_scan_pipelined(
         # first pipeline slot seeds each fresh microbatch with ITS h0 rows
         h0_mb = jax.lax.dynamic_slice_in_dim(h0, start, mbs, axis=0)
         carry_in = jnp.where(stage_pos == 0, h0_mb, carry)
-        h_out, ys = gru_scan(xp_mb, carry_in, w_hh, b_hh, reverse=reverse)
+        h_out, ys = scan_fn(xp_mb, carry_in, w_hh, b_hh, reverse=reverse)
         # Mask the slice, then update unconditionally: inactive stages write
         # back what they read (identity), keeping the dynamic_update_slice
         # in-place instead of forcing a full-buffer select per stage.
@@ -194,6 +199,7 @@ def sp_bigru_layer(
     axis_name: str,
     vary_axes: Optional[Tuple[str, ...]] = None,
     n_microbatches: int = 1,
+    scan_fn=gru_scan,
 ) -> Tuple[jax.Array, jax.Array]:
     """One (bi)GRU layer over a time-sharded input block.
 
@@ -215,13 +221,13 @@ def sp_bigru_layer(
             return sp_gru_scan_pipelined(
                 xp, h0, w, b, axis_name,
                 n_microbatches=n_microbatches, reverse=reverse,
-                vary_axes=vary_axes,
+                vary_axes=vary_axes, scan_fn=scan_fn,
             )
     else:
         def scan(xp, w, b, reverse):
             return sp_gru_scan(
                 xp, h0, w, b, axis_name, reverse=reverse,
-                vary_axes=vary_axes,
+                vary_axes=vary_axes, scan_fn=scan_fn,
             )
 
     xp_f = input_projection(x_local, weights_fwd)
@@ -256,11 +262,23 @@ def sp_bigru_apply(
     (deterministic mode) output exactly.
     """
     assert cfg.n_layers == 1, "sp forward currently covers the 1-layer flagship"
-    w_f = _weights_from_params(params, "l0")
-    w_b = _weights_from_params(params, "l0_reverse") if cfg.bidirectional else None
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x_local = x_local.astype(compute_dtype)
+
+    def direction(suffix):
+        w = _weights_from_params(params, suffix)
+        # params live in f32; compute in cfg.dtype like BiGRU.__call__
+        return GRUWeights(*(a.astype(compute_dtype) for a in w))
+
+    w_f = direction("l0")
+    w_b = direction("l0_reverse") if cfg.bidirectional else None
+    # canonical kernel gate (fmda_tpu.ops.gru): when selected, the fused
+    # kernel scans each sp shard's local time block in VMEM; the ppermute
+    # carry handoff is unchanged
+    scan_fn = select_scan_fn(cfg.use_pallas)
     last_hidden, gru_out_local = sp_bigru_layer(
         x_local, w_f, w_b, axis_name, vary_axes=vary_axes,
-        n_microbatches=n_microbatches,
+        n_microbatches=n_microbatches, scan_fn=scan_fn,
     )
 
     # Pool head across the sharded time axis: local reduce + collective.
@@ -273,7 +291,8 @@ def sp_bigru_apply(
 
     concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)
     dense = params["linear"]
-    return concat @ dense["kernel"] + dense["bias"]
+    logits = concat @ dense["kernel"] + dense["bias"]
+    return logits.astype(jnp.float32)
 
 
 def make_sp_forward(
