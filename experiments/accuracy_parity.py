@@ -38,7 +38,7 @@ def main() -> None:
 
     from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
     from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
-    from fmda_tpu.serve.backtest import backtest
+    from fmda_tpu.serve.backtest import backtest, trading_summary
     from fmda_tpu.train import Trainer, save_checkpoint
     from fmda_tpu.train.reports import (
         history_table, plot_confusion, plot_history,
@@ -95,6 +95,7 @@ def main() -> None:
 
     fbeta = [round(float(v), 3) for v in np.asarray(test_metrics.fbeta)]
     bt_fbeta = [round(float(v), 3) for v in np.asarray(bt.metrics.fbeta)]
+    summary = trading_summary(bt)
     results = {
         "corpus_rows": n_rows,
         "positives": y_all.sum(axis=0).astype(int).tolist(),
@@ -112,6 +113,14 @@ def main() -> None:
                      "hamming": round(float(bt.metrics.hamming), 3),
                      "fbeta": bt_fbeta,
                      "rows_served": int(len(bt.probabilities))},
+        "signals": {
+            label: {"signals": st.signals, "hits": st.hits,
+                    "precision": round(st.precision, 3),
+                    "recall": round(st.recall, 3),
+                    "base_rate": round(st.base_rate, 3),
+                    "edge": round(st.edge, 3)}
+            for label, st in summary.items()
+        },
         "checkpoint": os.path.relpath(ckpt, REPO),
         "wall_s": round(time.time() - t0, 1),
         "backend": jax.default_backend(),
@@ -175,6 +184,20 @@ def write_results_md(r: dict, table: str) -> None:
         " stats, Orbax).  Reports: `artifacts/parity/learning_curves.png`,"
         " `artifacts/parity/test_confusion.png`."
         f"  Wall clock: {r['wall_s']}s on {r['backend']}.",
+        "",
+        "## Signal quality over the backtest (trading view)",
+        "",
+        "`edge` = precision of fired signals minus the label's base rate"
+        " (what always-firing would score); positive edge = real signal."
+        "  The reference publishes nothing comparable.",
+        "",
+        "| label | signals | hits | precision | recall | base rate | edge |",
+        "|---|---|---|---|---|---|---|",
+        *[
+            f"| {label} | {s['signals']} | {s['hits']} | {s['precision']} |"
+            f" {s['recall']} | {s['base_rate']} | {s['edge']:+} |"
+            for label, s in r["signals"].items()
+        ],
         "",
         "## Per-epoch history",
         "",

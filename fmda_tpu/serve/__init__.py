@@ -1,4 +1,10 @@
-from fmda_tpu.serve.backtest import BacktestResult, backtest, backtest_from_checkpoint
+from fmda_tpu.serve.backtest import (
+    BacktestResult,
+    LabelStats,
+    backtest,
+    backtest_from_checkpoint,
+    trading_summary,
+)
 from fmda_tpu.serve.predictor import Prediction, Predictor
 from fmda_tpu.serve.streaming import (
     StreamingBiGRU,
@@ -13,6 +19,8 @@ __all__ = [
     "StreamingBiGRUBidirectional",
     "StreamingPredictor",
     "BacktestResult",
+    "LabelStats",
+    "trading_summary",
     "backtest",
     "backtest_from_checkpoint",
 ]

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fmda_tpu.config import ModelConfig
+from fmda_tpu.config import ModelConfig, TARGET_COLUMNS
 from fmda_tpu.data.normalize import NormParams, normalize
 from fmda_tpu.data.source import FeatureSource
 from fmda_tpu.data.windows import window_index_matrix
@@ -32,6 +32,7 @@ class BacktestResult:
     probabilities: np.ndarray  # (n_served, n_classes)
     targets: np.ndarray  # (n_served, n_classes)
     first_row_id: int  # first servable row (1-based)
+    threshold: float = 0.5  # decision threshold the metrics were scored at
 
 
 def backtest(
@@ -90,7 +91,73 @@ def backtest(
         probabilities=probabilities,
         targets=np.asarray(targets),
         first_row_id=lo,
+        threshold=threshold,
     )
+
+
+@dataclass(frozen=True)
+class LabelStats:
+    signals: int  # predictions fired (prob > threshold)
+    hits: int  # fired and the movement happened
+    precision: float  # hits / signals (0 when no signals)
+    recall: float  # hits / realized movements
+    base_rate: float  # realized movement frequency
+    edge: float  # precision - base_rate: > 0 = better than always-firing
+
+
+def trading_summary(
+    result: BacktestResult,
+    *,
+    threshold: Optional[float] = None,
+    labels: Tuple[str, ...] = TARGET_COLUMNS,
+) -> dict:
+    """Signal-quality view of a backtest — the question a trader actually
+    asks of the served predictions ("when it fires, how often is it
+    right, and is that better than chance?"), which neither the reference
+    nor plain accuracy/Hamming answers.
+
+    Returns {label: LabelStats} plus an ``overall`` entry; ``edge`` is
+    per-label precision minus the label's base rate (the precision of the
+    always-fire strategy), so positive edge = real signal.
+    """
+    if threshold is None:
+        threshold = result.threshold  # stay consistent with result.metrics
+    if len(labels) != result.targets.shape[1]:
+        raise ValueError(
+            f"{len(labels)} labels for {result.targets.shape[1]}-class "
+            "targets"
+        )
+    pred = result.probabilities > threshold
+    target = result.targets > 0.5
+    out = {}
+    total_signals = total_hits = total_pos = 0
+    for i, label in enumerate(labels):
+        signals = int(pred[:, i].sum())
+        hits = int((pred[:, i] & target[:, i]).sum())
+        pos = int(target[:, i].sum())
+        out[label] = LabelStats(
+            signals=signals,
+            hits=hits,
+            precision=hits / signals if signals else 0.0,
+            recall=hits / pos if pos else 0.0,
+            base_rate=pos / len(target) if len(target) else 0.0,
+            edge=(hits / signals if signals else 0.0)
+            - (pos / len(target) if len(target) else 0.0),
+        )
+        total_signals += signals
+        total_hits += hits
+        total_pos += pos
+    n_cells = len(target) * len(labels)
+    out["overall"] = LabelStats(
+        signals=total_signals,
+        hits=total_hits,
+        precision=total_hits / total_signals if total_signals else 0.0,
+        recall=total_hits / total_pos if total_pos else 0.0,
+        base_rate=total_pos / n_cells if n_cells else 0.0,
+        edge=(total_hits / total_signals if total_signals else 0.0)
+        - (total_pos / n_cells if n_cells else 0.0),
+    )
+    return out
 
 
 def backtest_from_checkpoint(

@@ -82,3 +82,36 @@ def test_backtest_from_checkpoint_learns_signal(tmp_path):
     # it decisively
     assert float(result.metrics.accuracy) > 0.15
     assert float(result.metrics.hamming) < 0.35
+
+
+def test_trading_summary_signal_quality():
+    """Per-label precision/recall/edge over a synthetic result where the
+    signal quality is known exactly."""
+    import numpy as np
+
+    from fmda_tpu.serve.backtest import BacktestResult, trading_summary
+    from fmda_tpu.ops.metrics import MultilabelMetrics
+
+    # 10 rows: label 0 fires 4x with 3 hits (precision .75) over base rate
+    # .4 -> edge +.35; label 1 never fires; labels 2/3 random-ish
+    probs = np.zeros((10, 4), np.float32)
+    targets = np.zeros((10, 4), np.float32)
+    probs[:4, 0] = 0.9
+    targets[:3, 0] = 1.0
+    targets[8, 0] = 1.0  # a movement the model missed (recall 3/4)
+    probs[5:7, 2] = 0.8
+    targets[6, 2] = 1.0
+    result = BacktestResult(
+        metrics=MultilabelMetrics(
+            np.float32(0), np.float32(0), np.zeros(4, np.float32),
+            np.zeros((4, 2, 2), np.int32)),
+        probabilities=probs, targets=targets, first_row_id=1,
+    )
+    s = trading_summary(result)
+    assert s["up1"].signals == 4 and s["up1"].hits == 3
+    assert s["up1"].precision == 0.75
+    assert s["up1"].recall == 0.75
+    assert abs(s["up1"].edge - (0.75 - 0.4)) < 1e-9
+    assert s["up2"].signals == 0 and s["up2"].precision == 0.0
+    assert s["down1"].signals == 2 and s["down1"].hits == 1
+    assert s["overall"].signals == 6 and s["overall"].hits == 4
