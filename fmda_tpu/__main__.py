@@ -1,0 +1,3 @@
+from fmda_tpu.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,251 @@
+"""Command-line entry points: ``python -m fmda_tpu <command>``.
+
+The reference is operated by hand-running five scripts in order
+(producer.py, spark_consumer.py, create_database.py, the training
+notebook, predict.py — reference README.md:186-292); here the same
+operations are subcommands over one file-backed warehouse:
+
+- ``demo``      synthetic end-to-end proof: corpus → warehouse → train →
+                backtest (no network, no accelerator requirements);
+- ``ingest``    replay or live-feed a session into a warehouse file;
+- ``train``     chunked training over a warehouse file → Orbax checkpoint;
+- ``backtest``  serving-equivalent scoring + signal-quality table;
+- ``serve``     the prediction daemon (push-triggered, no sleep-15).
+
+Every command is a thin composition of the public library API — anything
+the CLI does is one import away in a notebook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _warehouse(path: str):
+    from fmda_tpu.config import FeatureConfig, WarehouseConfig
+    from fmda_tpu.stream import Warehouse
+
+    return Warehouse(FeatureConfig(), WarehouseConfig(path=path))
+
+
+def cmd_demo(args) -> int:
+    from fmda_tpu.config import FeatureConfig
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+
+    fc = FeatureConfig()
+    wh, stats = build_corpus(
+        fc, SyntheticMarketConfig(seed=args.seed, n_days=args.days))
+    print(f"corpus: {len(wh)} rows ({stats})")
+    ckpt = _train(wh, epochs=args.epochs, batch_size=32,
+                  checkpoint_dir=args.checkpoint_dir, seed=args.seed)
+    if ckpt is None:
+        return 2
+    # score exactly the checkpoint this demo just trained, never whatever
+    # happens to be newest in a shared checkpoint dir
+    return cmd_backtest(argparse.Namespace(
+        warehouse=None, _wh=wh, checkpoint=ckpt,
+        checkpoint_dir=args.checkpoint_dir, window=30, threshold=0.5,
+    ))
+
+
+def cmd_ingest(args) -> int:
+    from fmda_tpu.config import DEFAULT_TOPICS, FeatureConfig
+    from fmda_tpu.data.synthetic import (
+        SyntheticMarketConfig, synthetic_session_messages,
+    )
+    from fmda_tpu.stream import InProcessBus, StreamEngine
+
+    fc = FeatureConfig()
+    wh = _warehouse(args.warehouse)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    engine = StreamEngine(
+        bus, wh, fc,
+        checkpoint_path=args.engine_checkpoint,
+        checkpoint_every=args.checkpoint_every,
+    )
+    if args.synthetic_days:
+        for topic, msg in synthetic_session_messages(
+                fc, SyntheticMarketConfig(seed=args.seed,
+                                          n_days=args.synthetic_days)):
+            bus.publish(topic, msg)
+        engine.step()
+    else:
+        print("live ingestion needs API tokens; attach a SessionDriver via "
+              "the Application API (docs/OPERATIONS.md §2)", file=sys.stderr)
+        return 2
+    print(f"warehouse {args.warehouse}: {len(wh)} rows; engine {engine.stats}")
+    return 0
+
+
+def _train(wh, *, epochs, batch_size, checkpoint_dir, seed):
+    """Shared by ``train`` and ``demo``; returns the checkpoint path, or
+    None (after printing why) when training cannot run."""
+    import jax
+
+    from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
+    from fmda_tpu.train import Trainer, save_checkpoint
+    from fmda_tpu.train.trainer import imbalance_weights_from_source
+
+    if len(wh) == 0:
+        print("warehouse is empty — run ingest first", file=sys.stderr)
+        return None
+    fc = FeatureConfig()
+    model_cfg = ModelConfig(n_features=len(wh.x_fields))
+    train_cfg = TrainConfig(batch_size=batch_size, epochs=epochs, seed=seed)
+    weight, pos_weight = imbalance_weights_from_source(wh)
+    trainer = Trainer(model_cfg, train_cfg, weight=weight,
+                      pos_weight=pos_weight)
+    state, history, dataset = trainer.fit(
+        wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
+    ckpt = save_checkpoint(checkpoint_dir, state, dataset.final_norm_params)
+    last = history["train"][-1]
+    print(f"trained {len(history['train'])} epochs: "
+          f"loss={last.loss:.4f} acc={last.accuracy:.4f} "
+          f"(backend={jax.default_backend()})")
+    print(f"checkpoint: {ckpt}")
+    return ckpt
+
+
+def cmd_train(args) -> int:
+    ckpt = _train(
+        _warehouse(args.warehouse), epochs=args.epochs,
+        batch_size=args.batch_size, checkpoint_dir=args.checkpoint_dir,
+        seed=args.seed,
+    )
+    return 0 if ckpt else 2
+
+
+def cmd_backtest(args) -> int:
+    from fmda_tpu.config import ModelConfig
+    from fmda_tpu.serve import backtest_from_checkpoint, trading_summary
+    from fmda_tpu.train.checkpoint import latest_checkpoint
+
+    wh = getattr(args, "_wh", None)
+    if wh is None:
+        wh = _warehouse(args.warehouse)
+    ckpt = args.checkpoint or latest_checkpoint(args.checkpoint_dir)
+    if ckpt is None:
+        print("no checkpoint found", file=sys.stderr)
+        return 2
+    result = backtest_from_checkpoint(
+        wh, ckpt, ModelConfig(n_features=len(wh.x_fields)),
+        window=args.window, threshold=args.threshold)
+    m = result.metrics
+    print(f"backtest over {len(result.probabilities)} rows: "
+          f"accuracy={float(m.accuracy):.3f} hamming={float(m.hamming):.3f}")
+    print(f"{'label':>8} {'signals':>8} {'hits':>6} {'precision':>10} "
+          f"{'recall':>7} {'edge':>7}")
+    for label, s in trading_summary(result).items():
+        print(f"{label:>8} {s.signals:>8} {s.hits:>6} {s.precision:>10.3f} "
+              f"{s.recall:>7.3f} {s.edge:>+7.3f}")
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Tail-follow the warehouse file: another process (ingest) appends
+    rows to the same SQLite file; each new row is served through the
+    push-triggered predictor (signals synthesised locally — the shared
+    medium between processes is the warehouse, like the reference's
+    MariaDB between Spark and predict.py, minus the sleep-15 race)."""
+    import time
+
+    from fmda_tpu.config import DEFAULT_TOPICS, ModelConfig, TOPIC_PREDICT_TIMESTAMP
+    from fmda_tpu.stream import InProcessBus
+    from fmda_tpu.serve import Predictor
+    from fmda_tpu.train.checkpoint import latest_checkpoint
+
+    wh = _warehouse(args.warehouse)
+    ckpt = args.checkpoint or latest_checkpoint(args.checkpoint_dir)
+    if ckpt is None:
+        print("no checkpoint found", file=sys.stderr)
+        return 2
+    bus = InProcessBus(DEFAULT_TOPICS)
+    predictor = Predictor.from_checkpoint(
+        ckpt, bus, wh, ModelConfig(n_features=len(wh.x_fields)),
+        window=args.window, from_end=False, max_staleness_s=None)
+    served = 0
+    seen_rows = args.window - 1 if args.from_start else len(wh)
+    deadline = time.monotonic() + args.duration_s if args.duration_s else None
+    while True:
+        n = len(wh)
+        if n > seen_rows:
+            for ts in wh.timestamps_after(seen_rows):
+                bus.publish(TOPIC_PREDICT_TIMESTAMP, {"Timestamp": ts})
+            seen_rows = n
+            for p in predictor.poll():
+                served += 1
+                print(json.dumps({
+                    "timestamp": p.timestamp,
+                    "probabilities": [
+                        round(float(v), 4) for v in p.probabilities],
+                    "labels": list(p.labels),
+                }), flush=True)
+        if args.once or (deadline is not None
+                         and time.monotonic() >= deadline):
+            break
+        time.sleep(args.poll_interval_s)
+    print(f"served {served} predictions", file=sys.stderr)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="fmda_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("demo", help="synthetic end-to-end proof run")
+    p.add_argument("--days", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.set_defaults(fn=cmd_demo)
+
+    p = sub.add_parser("ingest", help="fill a warehouse file")
+    p.add_argument("--warehouse", required=True, help="sqlite file path")
+    p.add_argument("--synthetic-days", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--engine-checkpoint", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=1)
+    p.set_defaults(fn=cmd_ingest)
+
+    p = sub.add_parser("train", help="train over a warehouse file")
+    p.add_argument("--warehouse", required=True)
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--epochs", type=int, default=25)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("backtest", help="score a checkpoint over history")
+    p.add_argument("--warehouse", required=True)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--window", type=int, default=30)
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.set_defaults(fn=cmd_backtest)
+
+    p = sub.add_parser("serve", help="prediction daemon over a warehouse")
+    p.add_argument("--warehouse", required=True)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--window", type=int, default=30)
+    p.add_argument("--poll-interval-s", type=float, default=0.5)
+    p.add_argument("--duration-s", type=float, default=0.0)
+    p.add_argument("--once", action="store_true",
+                   help="one poll pass, then exit")
+    p.add_argument("--from-start", action="store_true",
+                   help="serve existing history too, not just new rows")
+    p.set_defaults(fn=cmd_serve)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -135,27 +135,29 @@ def trading_summary(
         signals = int(pred[:, i].sum())
         hits = int((pred[:, i] & target[:, i]).sum())
         pos = int(target[:, i].sum())
+        precision = hits / signals if signals else 0.0
+        base_rate = pos / len(target) if len(target) else 0.0
         out[label] = LabelStats(
             signals=signals,
             hits=hits,
-            precision=hits / signals if signals else 0.0,
+            precision=precision,
             recall=hits / pos if pos else 0.0,
-            base_rate=pos / len(target) if len(target) else 0.0,
-            edge=(hits / signals if signals else 0.0)
-            - (pos / len(target) if len(target) else 0.0),
+            base_rate=base_rate,
+            edge=precision - base_rate,
         )
         total_signals += signals
         total_hits += hits
         total_pos += pos
     n_cells = len(target) * len(labels)
+    precision = total_hits / total_signals if total_signals else 0.0
+    base_rate = total_pos / n_cells if n_cells else 0.0
     out["overall"] = LabelStats(
         signals=total_signals,
         hits=total_hits,
-        precision=total_hits / total_signals if total_signals else 0.0,
+        precision=precision,
         recall=total_hits / total_pos if total_pos else 0.0,
-        base_rate=total_pos / n_cells if n_cells else 0.0,
-        edge=(total_hits / total_signals if total_signals else 0.0)
-        - (total_pos / n_cells if n_cells else 0.0),
+        base_rate=base_rate,
+        edge=precision - base_rate,
     )
     return out
 

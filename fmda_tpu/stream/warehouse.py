@@ -132,6 +132,17 @@ class Warehouse:
             ).fetchall()
         return [r[0] for r in rows]
 
+    def timestamps_after(self, row_id: int) -> List[str]:
+        """Timestamps of rows with ID > ``row_id``, in ID order — the
+        tail-follow query (serving daemons polling a shared file)."""
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT Timestamp FROM {self.table} WHERE ID > ? "
+                "ORDER BY ID",
+                (int(row_id),),
+            ).fetchall()
+        return [r[0] for r in rows]
+
     def recent_timestamps(self, limit: int) -> List[str]:
         """Timestamps of the newest ``limit`` rows (newest-first) — the
         engine seeds its landed-tick dedupe set from this without loading

@@ -1,0 +1,60 @@
+"""CLI subcommands (python -m fmda_tpu ...) — in-process invocations over
+temp warehouse/checkpoint files, covering the reference's five hand-run
+scripts as one operable surface."""
+
+import json
+
+import pytest
+
+from fmda_tpu.cli import main
+
+
+@pytest.fixture
+def pipeline(tmp_path, capsys):
+    """ingest -> train over a small synthetic corpus; returns paths."""
+    wh_path = str(tmp_path / "wh.sqlite")
+    ckpt_dir = str(tmp_path / "ckpts")
+    assert main(["ingest", "--warehouse", wh_path,
+                 "--synthetic-days", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "234 rows" in out  # 3 days x 78 bars
+    assert main(["train", "--warehouse", wh_path,
+                 "--checkpoint-dir", ckpt_dir,
+                 "--epochs", "1", "--batch-size", "32"]) == 0
+    assert "checkpoint:" in capsys.readouterr().out
+    return wh_path, ckpt_dir
+
+
+def test_ingest_train_backtest(pipeline, capsys):
+    wh_path, ckpt_dir = pipeline
+    assert main(["backtest", "--warehouse", wh_path,
+                 "--checkpoint-dir", ckpt_dir]) == 0
+    out = capsys.readouterr().out
+    assert "accuracy=" in out
+    assert "up1" in out and "edge" in out
+
+
+def test_serve_tails_warehouse(pipeline, capsys):
+    wh_path, ckpt_dir = pipeline
+    assert main(["serve", "--warehouse", wh_path,
+                 "--checkpoint-dir", ckpt_dir,
+                 "--once", "--from-start"]) == 0
+    captured = capsys.readouterr()
+    lines = [l for l in captured.out.splitlines() if l.startswith("{")]
+    assert len(lines) == 234 - 29  # every row with a full 30-row window
+    first = json.loads(lines[0])
+    assert set(first) == {"timestamp", "probabilities", "labels"}
+    assert "served 205 predictions" in captured.err
+
+
+def test_train_on_empty_warehouse_fails_cleanly(tmp_path, capsys):
+    wh_path = str(tmp_path / "empty.sqlite")
+    assert main(["train", "--warehouse", wh_path,
+                 "--checkpoint-dir", str(tmp_path / "c")]) == 2
+    assert "empty" in capsys.readouterr().err
+
+
+def test_ingest_without_source_fails_cleanly(tmp_path, capsys):
+    assert main(["ingest", "--warehouse",
+                 str(tmp_path / "w.sqlite")]) == 2
+    assert "tokens" in capsys.readouterr().err
