@@ -44,10 +44,7 @@ def cmd_demo(args) -> int:
         return 2
     # score exactly the checkpoint this demo just trained, never whatever
     # happens to be newest in a shared checkpoint dir
-    return cmd_backtest(argparse.Namespace(
-        warehouse=None, _wh=wh, checkpoint=ckpt,
-        checkpoint_dir=args.checkpoint_dir, window=30, threshold=0.5,
-    ))
+    return _backtest(wh, ckpt, window=30, threshold=0.5)
 
 
 def cmd_ingest(args) -> int:
@@ -117,21 +114,13 @@ def cmd_train(args) -> int:
     return 0 if ckpt else 2
 
 
-def cmd_backtest(args) -> int:
+def _backtest(wh, ckpt: str, *, window: int, threshold: float) -> int:
     from fmda_tpu.config import ModelConfig
     from fmda_tpu.serve import backtest_from_checkpoint, trading_summary
-    from fmda_tpu.train.checkpoint import latest_checkpoint
 
-    wh = getattr(args, "_wh", None)
-    if wh is None:
-        wh = _warehouse(args.warehouse)
-    ckpt = args.checkpoint or latest_checkpoint(args.checkpoint_dir)
-    if ckpt is None:
-        print("no checkpoint found", file=sys.stderr)
-        return 2
     result = backtest_from_checkpoint(
         wh, ckpt, ModelConfig(n_features=len(wh.x_fields)),
-        window=args.window, threshold=args.threshold)
+        window=window, threshold=threshold)
     m = result.metrics
     print(f"backtest over {len(result.probabilities)} rows: "
           f"accuracy={float(m.accuracy):.3f} hamming={float(m.hamming):.3f}")
@@ -141,6 +130,19 @@ def cmd_backtest(args) -> int:
         print(f"{label:>8} {s.signals:>8} {s.hits:>6} {s.precision:>10.3f} "
               f"{s.recall:>7.3f} {s.edge:>+7.3f}")
     return 0
+
+
+def cmd_backtest(args) -> int:
+    from fmda_tpu.train.checkpoint import latest_checkpoint
+
+    ckpt = args.checkpoint or latest_checkpoint(args.checkpoint_dir)
+    if ckpt is None:
+        print("no checkpoint found", file=sys.stderr)
+        return 2
+    return _backtest(
+        _warehouse(args.warehouse), ckpt,
+        window=args.window, threshold=args.threshold,
+    )
 
 
 def cmd_serve(args) -> int:
@@ -164,16 +166,20 @@ def cmd_serve(args) -> int:
     bus = InProcessBus(DEFAULT_TOPICS)
     predictor = Predictor.from_checkpoint(
         ckpt, bus, wh, ModelConfig(n_features=len(wh.x_fields)),
-        window=args.window, from_end=False, max_staleness_s=None)
+        window=args.window, threshold=args.threshold,
+        from_end=False, max_staleness_s=None)
     served = 0
     seen_rows = args.window - 1 if args.from_start else len(wh)
     deadline = time.monotonic() + args.duration_s if args.duration_s else None
     while True:
-        n = len(wh)
-        if n > seen_rows:
-            for ts in wh.timestamps_after(seen_rows):
+        # the cursor advances by exactly the rows fetched — a concurrent
+        # ingest commit between reads can only appear in the NEXT poll,
+        # never twice (ids are append-only autoincrement)
+        new_ts = wh.timestamps_after(seen_rows)
+        if new_ts:
+            for ts in new_ts:
                 bus.publish(TOPIC_PREDICT_TIMESTAMP, {"Timestamp": ts})
-            seen_rows = n
+            seen_rows += len(new_ts)
             for p in predictor.poll():
                 served += 1
                 print(json.dumps({
@@ -232,6 +238,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--checkpoint-dir", default="checkpoints")
     p.add_argument("--window", type=int, default=30)
+    p.add_argument("--threshold", type=float, default=0.5,
+                   help="label decision threshold (match your backtest)")
     p.add_argument("--poll-interval-s", type=float, default=0.5)
     p.add_argument("--duration-s", type=float, default=0.0)
     p.add_argument("--once", action="store_true",

@@ -151,3 +151,48 @@ def test_bus_order_and_offsets_under_retention(ops, capacity):
         expect = published[topic][-len(recs):] if recs else []
         assert [(r.offset, r.value["v"]) for r in recs] == expect
         assert bus.end_offset(topic) == len(published[topic])
+
+
+# ------------------------------------------------------------- pallas kernel
+
+
+@given(
+    batch=st.integers(min_value=1, max_value=6),
+    seq=st.integers(min_value=1, max_value=10),
+    hidden=st.sampled_from([4, 8]),
+    reverse=st.booleans(),
+    seed=st.integers(min_value=0, max_value=2**31 - 1),
+)
+@settings(max_examples=15, deadline=None)
+def test_pallas_kernel_matches_scan_property(batch, seq, hidden, reverse, seed):
+    """Fused-kernel forward AND gradients == lax.scan for arbitrary small
+    shapes/directions (interpret mode) — the shape envelope the fixed
+    parametrized tests cannot sweep."""
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.ops.gru import gru_scan
+    from fmda_tpu.ops.pallas_gru import gru_scan_pallas
+
+    r = np.random.default_rng(seed)
+    xp = jnp.asarray(r.normal(size=(batch, seq, 3 * hidden)), jnp.float32)
+    h0 = jnp.asarray(r.normal(size=(batch, hidden)), jnp.float32)
+    w = jnp.asarray(r.normal(size=(3 * hidden, hidden)) * 0.3, jnp.float32)
+    b = jnp.asarray(r.normal(size=(3 * hidden,)) * 0.1, jnp.float32)
+
+    def loss(fn, *args):
+        h_last, hs = fn(*args)
+        return jnp.sum(h_last * 1.7) + jnp.sum(jnp.sin(hs))
+
+    v_pal, g_pal = jax.value_and_grad(
+        lambda *a: loss(
+            lambda *x: gru_scan_pallas(*x, reverse=reverse, interpret=True),
+            *a),
+        argnums=(0, 1, 2, 3))(xp, h0, w, b)
+    v_ref, g_ref = jax.value_and_grad(
+        lambda *a: loss(lambda *x: gru_scan(*x, reverse=reverse), *a),
+        argnums=(0, 1, 2, 3))(xp, h0, w, b)
+    np.testing.assert_allclose(float(v_pal), float(v_ref), rtol=1e-5, atol=1e-5)
+    for a, c in zip(g_pal, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=2e-4, atol=2e-5)
