@@ -23,40 +23,50 @@ import json
 import sys
 
 
-def _warehouse(path: str):
-    from fmda_tpu.config import FeatureConfig, WarehouseConfig
+def _config(args):
+    """FrameworkConfig from --config (JSON), or the defaults."""
+    from fmda_tpu.config import FrameworkConfig, load_config
+
+    path = getattr(args, "config", None)
+    return load_config(path) if path else FrameworkConfig()
+
+
+def _warehouse(path: str, cfg):
+    import dataclasses
+
     from fmda_tpu.stream import Warehouse
 
-    return Warehouse(FeatureConfig(), WarehouseConfig(path=path))
+    return Warehouse(
+        cfg.features, dataclasses.replace(cfg.warehouse, path=path))
 
 
 def cmd_demo(args) -> int:
-    from fmda_tpu.config import FeatureConfig
     from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
 
-    fc = FeatureConfig()
+    cfg = _config(args)
     wh, stats = build_corpus(
-        fc, SyntheticMarketConfig(seed=args.seed, n_days=args.days))
+        cfg.features, SyntheticMarketConfig(seed=args.seed, n_days=args.days))
     print(f"corpus: {len(wh)} rows ({stats})")
-    ckpt = _train(wh, epochs=args.epochs, batch_size=32,
+    ckpt = _train(wh, cfg, epochs=args.epochs, batch_size=args.batch_size,
                   checkpoint_dir=args.checkpoint_dir, seed=args.seed)
     if ckpt is None:
         return 2
     # score exactly the checkpoint this demo just trained, never whatever
     # happens to be newest in a shared checkpoint dir
-    return _backtest(wh, ckpt, window=30, threshold=0.5)
+    return _backtest(wh, cfg, ckpt, window=cfg.train.window, threshold=0.5)
 
 
 def cmd_ingest(args) -> int:
-    from fmda_tpu.config import DEFAULT_TOPICS, FeatureConfig
+    from fmda_tpu.app import default_bus
     from fmda_tpu.data.synthetic import (
         SyntheticMarketConfig, synthetic_session_messages,
     )
-    from fmda_tpu.stream import InProcessBus, StreamEngine
+    from fmda_tpu.stream import StreamEngine
 
-    fc = FeatureConfig()
-    wh = _warehouse(args.warehouse)
-    bus = InProcessBus(DEFAULT_TOPICS)
+    cfg = _config(args)
+    fc = cfg.features
+    wh = _warehouse(args.warehouse, cfg)
+    bus = default_bus(cfg)
     engine = StreamEngine(
         bus, wh, fc,
         checkpoint_path=args.engine_checkpoint,
@@ -76,21 +86,27 @@ def cmd_ingest(args) -> int:
     return 0
 
 
-def _train(wh, *, epochs, batch_size, checkpoint_dir, seed):
+def _train(wh, cfg, *, epochs, batch_size, checkpoint_dir, seed):
     """Shared by ``train`` and ``demo``; returns the checkpoint path, or
     None (after printing why) when training cannot run."""
+    import dataclasses
+
     import jax
 
-    from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
     from fmda_tpu.train import Trainer, save_checkpoint
     from fmda_tpu.train.trainer import imbalance_weights_from_source
 
     if len(wh) == 0:
         print("warehouse is empty — run ingest first", file=sys.stderr)
         return None
-    fc = FeatureConfig()
-    model_cfg = ModelConfig(n_features=len(wh.x_fields))
-    train_cfg = TrainConfig(batch_size=batch_size, epochs=epochs, seed=seed)
+    fc = cfg.features
+    model_cfg = dataclasses.replace(cfg.model, n_features=len(wh.x_fields))
+    # explicitly-passed CLI flags override the config file; absent flags
+    # (None) leave the config's values in force
+    overrides = {k: v for k, v in
+                 dict(batch_size=batch_size, epochs=epochs, seed=seed).items()
+                 if v is not None}
+    train_cfg = dataclasses.replace(cfg.train, **overrides)
     weight, pos_weight = imbalance_weights_from_source(wh)
     trainer = Trainer(model_cfg, train_cfg, weight=weight,
                       pos_weight=pos_weight)
@@ -106,20 +122,22 @@ def _train(wh, *, epochs, batch_size, checkpoint_dir, seed):
 
 
 def cmd_train(args) -> int:
+    cfg = _config(args)
     ckpt = _train(
-        _warehouse(args.warehouse), epochs=args.epochs,
+        _warehouse(args.warehouse, cfg), cfg, epochs=args.epochs,
         batch_size=args.batch_size, checkpoint_dir=args.checkpoint_dir,
         seed=args.seed,
     )
     return 0 if ckpt else 2
 
 
-def _backtest(wh, ckpt: str, *, window: int, threshold: float) -> int:
-    from fmda_tpu.config import ModelConfig
+def _backtest(wh, cfg, ckpt: str, *, window: int, threshold: float) -> int:
+    import dataclasses
+
     from fmda_tpu.serve import backtest_from_checkpoint, trading_summary
 
     result = backtest_from_checkpoint(
-        wh, ckpt, ModelConfig(n_features=len(wh.x_fields)),
+        wh, ckpt, dataclasses.replace(cfg.model, n_features=len(wh.x_fields)),
         window=window, threshold=threshold)
     m = result.metrics
     print(f"backtest over {len(result.probabilities)} rows: "
@@ -139,9 +157,13 @@ def cmd_backtest(args) -> int:
     if ckpt is None:
         print("no checkpoint found", file=sys.stderr)
         return 2
+    cfg = _config(args)
     return _backtest(
-        _warehouse(args.warehouse), ckpt,
-        window=args.window, threshold=args.threshold,
+        _warehouse(args.warehouse, cfg), cfg, ckpt,
+        window=(args.window if args.window is not None
+                else cfg.train.window),
+        threshold=(args.threshold if args.threshold is not None
+                   else cfg.train.prob_threshold),
     )
 
 
@@ -153,23 +175,30 @@ def cmd_serve(args) -> int:
     MariaDB between Spark and predict.py, minus the sleep-15 race)."""
     import time
 
-    from fmda_tpu.config import DEFAULT_TOPICS, ModelConfig, TOPIC_PREDICT_TIMESTAMP
-    from fmda_tpu.stream import InProcessBus
+    import dataclasses
+
+    from fmda_tpu.app import default_bus
+    from fmda_tpu.config import TOPIC_PREDICT_TIMESTAMP
     from fmda_tpu.serve import Predictor
     from fmda_tpu.train.checkpoint import latest_checkpoint
 
-    wh = _warehouse(args.warehouse)
+    cfg = _config(args)
+    window = args.window if args.window is not None else cfg.train.window
+    threshold = (args.threshold if args.threshold is not None
+                 else cfg.train.prob_threshold)
+    wh = _warehouse(args.warehouse, cfg)
     ckpt = args.checkpoint or latest_checkpoint(args.checkpoint_dir)
     if ckpt is None:
         print("no checkpoint found", file=sys.stderr)
         return 2
-    bus = InProcessBus(DEFAULT_TOPICS)
+    bus = default_bus(cfg)
     predictor = Predictor.from_checkpoint(
-        ckpt, bus, wh, ModelConfig(n_features=len(wh.x_fields)),
-        window=args.window, threshold=args.threshold,
+        ckpt, bus, wh,
+        dataclasses.replace(cfg.model, n_features=len(wh.x_fields)),
+        window=window, threshold=threshold,
         from_end=False, max_staleness_s=None)
     served = 0
-    seen_rows = args.window - 1 if args.from_start else len(wh)
+    seen_rows = window - 1 if args.from_start else len(wh)
     deadline = time.monotonic() + args.duration_s if args.duration_s else None
     while True:
         # the cursor advances by exactly the rows fetched — a concurrent
@@ -200,16 +229,25 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="fmda_tpu", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument(
+        "--config", default=None, metavar="JSON",
+        help="FrameworkConfig overrides as JSON "
+             "(fmda_tpu.config.save_config writes the full schema; "
+             "partial files override sections). The CLI honors features/"
+             "warehouse/bus/model/train; session and mesh apply to the "
+             "library Application/Trainer APIs")
     sub = parser.add_subparsers(dest="command", required=True)
 
-    p = sub.add_parser("demo", help="synthetic end-to-end proof run")
+    p = sub.add_parser("demo", parents=[common], help="synthetic end-to-end proof run")
     p.add_argument("--days", type=int, default=8)
     p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--checkpoint-dir", default="checkpoints")
     p.set_defaults(fn=cmd_demo)
 
-    p = sub.add_parser("ingest", help="fill a warehouse file")
+    p = sub.add_parser("ingest", parents=[common], help="fill a warehouse file")
     p.add_argument("--warehouse", required=True, help="sqlite file path")
     p.add_argument("--synthetic-days", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
@@ -217,28 +255,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=1)
     p.set_defaults(fn=cmd_ingest)
 
-    p = sub.add_parser("train", help="train over a warehouse file")
+    p = sub.add_parser("train", parents=[common], help="train over a warehouse file")
     p.add_argument("--warehouse", required=True)
     p.add_argument("--checkpoint-dir", default="checkpoints")
-    p.add_argument("--epochs", type=int, default=25)
-    p.add_argument("--batch-size", type=int, default=2)
-    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--epochs", type=int, default=None,
+                   help="override config train.epochs (default 25)")
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="override config train.batch_size (default 2)")
+    p.add_argument("--seed", type=int, default=None)
     p.set_defaults(fn=cmd_train)
 
-    p = sub.add_parser("backtest", help="score a checkpoint over history")
+    p = sub.add_parser("backtest", parents=[common], help="score a checkpoint over history")
     p.add_argument("--warehouse", required=True)
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--checkpoint-dir", default="checkpoints")
-    p.add_argument("--window", type=int, default=30)
-    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--window", type=int, default=None,
+                   help="override config train.window (default 30)")
+    p.add_argument("--threshold", type=float, default=None)
     p.set_defaults(fn=cmd_backtest)
 
-    p = sub.add_parser("serve", help="prediction daemon over a warehouse")
+    p = sub.add_parser("serve", parents=[common], help="prediction daemon over a warehouse")
     p.add_argument("--warehouse", required=True)
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--checkpoint-dir", default="checkpoints")
-    p.add_argument("--window", type=int, default=30)
-    p.add_argument("--threshold", type=float, default=0.5,
+    p.add_argument("--window", type=int, default=None,
+                   help="override config train.window (default 30)")
+    p.add_argument("--threshold", type=float, default=None,
                    help="label decision threshold (match your backtest)")
     p.add_argument("--poll-interval-s", type=float, default=0.5)
     p.add_argument("--duration-s", type=float, default=0.0)

@@ -413,3 +413,75 @@ class FrameworkConfig:
 
 def default_config() -> FrameworkConfig:
     return FrameworkConfig()
+
+
+# ---------------------------------------------------------------------------
+# Serialization: the whole config tree round-trips through JSON, so a
+# deployment is one reviewable file (the reference's "edit config.py and the
+# pipeline reshapes" property, config.py:31-65, without code edits).
+# ---------------------------------------------------------------------------
+
+_SECTIONS = {
+    "features": FeatureConfig,
+    "bus": BusConfig,
+    "warehouse": WarehouseConfig,
+    "model": ModelConfig,
+    "train": TrainConfig,
+    "mesh": MeshConfig,
+    "session": SessionConfig,
+}
+
+
+def config_to_dict(cfg: FrameworkConfig) -> dict:
+    """Nested plain-dict form (tuples become lists; JSON-ready).
+
+    ``model.n_features`` is written as null: it is state *derived* from
+    the feature schema (resolved by ``FrameworkConfig.__post_init__``),
+    and persisting the resolved value would freeze it while an edited
+    features section reshapes everything else."""
+    d = dataclasses.asdict(cfg)
+    d["model"]["n_features"] = None
+    return d
+
+
+def config_from_dict(data: dict) -> FrameworkConfig:
+    """Rebuild a FrameworkConfig from (possibly partial) nested dicts.
+
+    Unknown sections or keys raise — a typo'd config must fail loudly, not
+    silently fall back to defaults.  JSON lists are coerced back to the
+    tuples the frozen dataclasses expect.
+    """
+    sections = _SECTIONS
+    unknown = set(data) - set(sections)
+    if unknown:
+        raise ValueError(f"unknown config sections: {sorted(unknown)}")
+    kwargs = {}
+    for name, cls in sections.items():
+        if name not in data:
+            continue
+        section = data[name]
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        bad = set(section) - field_names
+        if bad:
+            raise ValueError(f"unknown keys in [{name}]: {sorted(bad)}")
+        coerced = {
+            k: tuple(v) if isinstance(v, list) else v
+            for k, v in section.items()
+        }
+        kwargs[name] = cls(**coerced)
+    return FrameworkConfig(**kwargs)
+
+
+def save_config(cfg: FrameworkConfig, path: str) -> str:
+    import json
+
+    with open(path, "w") as fh:
+        json.dump(config_to_dict(cfg), fh, indent=2)
+    return path
+
+
+def load_config(path: str) -> FrameworkConfig:
+    import json
+
+    with open(path) as fh:
+        return config_from_dict(json.load(fh))

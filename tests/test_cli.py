@@ -58,3 +58,57 @@ def test_ingest_without_source_fails_cleanly(tmp_path, capsys):
     assert main(["ingest", "--warehouse",
                  str(tmp_path / "w.sqlite")]) == 2
     assert "tokens" in capsys.readouterr().err
+
+
+def test_cli_config_file_reshapes_pipeline(tmp_path, capsys):
+    """--config with a narrowed feature schema flows through ingest and
+    train — the reference's edit-config.py-and-everything-reshapes
+    property, as a reviewable JSON file."""
+    import json as _json
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(_json.dumps({
+        "features": {"bid_levels": 2, "ask_levels": 2,
+                     "event_list": ["Core CPI"]},
+    }))
+    wh_path = str(tmp_path / "wh.sqlite")
+    assert main(["ingest", "--config", str(cfg_path),
+                 "--warehouse", wh_path, "--synthetic-days", "2"]) == 0
+    capsys.readouterr()
+    assert main(["train", "--config", str(cfg_path),
+                 "--warehouse", wh_path,
+                 "--checkpoint-dir", str(tmp_path / "c"),
+                 "--epochs", "1", "--batch-size", "16"]) == 0
+    out = capsys.readouterr().out
+    assert "checkpoint:" in out
+
+    # the narrowed schema must actually narrow the warehouse
+    from fmda_tpu.config import load_config
+    from fmda_tpu.stream import Warehouse
+    import dataclasses
+
+    cfg = load_config(str(cfg_path))
+    wh = Warehouse(cfg.features,
+                   dataclasses.replace(cfg.warehouse, path=wh_path))
+    assert len(wh.x_fields) < 108
+    assert "bid_2_size" not in wh.x_fields
+
+
+def test_cli_config_train_knobs_apply_without_flags(tmp_path, capsys):
+    """A config file's train section must govern when flags are absent
+    (flags only override when explicitly passed)."""
+    import json as _json
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(_json.dumps({
+        "train": {"epochs": 3, "batch_size": 16},
+    }))
+    wh_path = str(tmp_path / "wh.sqlite")
+    assert main(["ingest", "--warehouse", wh_path,
+                 "--synthetic-days", "2"]) == 0
+    capsys.readouterr()
+    assert main(["train", "--config", str(cfg_path),
+                 "--warehouse", wh_path,
+                 "--checkpoint-dir", str(tmp_path / "c")]) == 0
+    out = capsys.readouterr().out
+    assert "trained 3 epochs" in out  # from the config, not argparse default

@@ -80,3 +80,38 @@ def test_ind_message_template():
 def test_model_width_syncs_to_features():
     cfg = default_config()
     assert cfg.model.n_features == cfg.features.n_features
+
+
+def test_config_json_roundtrip(tmp_path):
+    """The full config tree serializes to JSON and reconstructs exactly
+    (tuples restored); typos fail loudly."""
+    import dataclasses
+
+    import pytest
+
+    from fmda_tpu.config import (
+        FeatureConfig, FrameworkConfig, TrainConfig,
+        config_from_dict, load_config, save_config,
+    )
+
+    cfg = FrameworkConfig(
+        features=FeatureConfig(bid_levels=3, ask_levels=3,
+                               event_list=("Core CPI", "Nonfarm Payrolls")),
+        train=TrainConfig(batch_size=16, epochs=3),
+    )
+    path = str(tmp_path / "cfg.json")
+    save_config(cfg, path)
+    restored = load_config(path)
+    assert restored == cfg
+    assert restored.features.event_list == ("Core CPI", "Nonfarm Payrolls")
+    assert restored.model.n_features == cfg.features.n_features
+
+    # partial files override only their sections
+    partial = config_from_dict({"train": {"epochs": 7}})
+    assert partial.train.epochs == 7
+    assert partial.features == FeatureConfig()
+
+    with pytest.raises(ValueError, match="unknown config sections"):
+        config_from_dict({"modle": {}})
+    with pytest.raises(ValueError, match=r"unknown keys in \[train\]"):
+        config_from_dict({"train": {"epoch": 7}})
