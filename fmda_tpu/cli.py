@@ -31,6 +31,12 @@ def _config(args):
     return load_config(path) if path else FrameworkConfig()
 
 
+def _ckpt_dir(args, cfg) -> str:
+    """--checkpoint-dir if passed, else the config's train.checkpoint_dir."""
+    return (args.checkpoint_dir if args.checkpoint_dir is not None
+            else cfg.train.checkpoint_dir)
+
+
 def _warehouse(path: str, cfg):
     import dataclasses
 
@@ -44,16 +50,24 @@ def cmd_demo(args) -> int:
     from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
 
     cfg = _config(args)
+    # absent flags fall back to the config file when one is given, else to
+    # quick demo defaults
+    epochs = args.epochs if args.epochs is not None else (
+        cfg.train.epochs if args.config else 2)
+    batch_size = args.batch_size if args.batch_size is not None else (
+        cfg.train.batch_size if args.config else 32)
+    seed = args.seed if args.seed is not None else cfg.train.seed
     wh, stats = build_corpus(
-        cfg.features, SyntheticMarketConfig(seed=args.seed, n_days=args.days))
+        cfg.features, SyntheticMarketConfig(seed=seed, n_days=args.days))
     print(f"corpus: {len(wh)} rows ({stats})")
-    ckpt = _train(wh, cfg, epochs=args.epochs, batch_size=args.batch_size,
-                  checkpoint_dir=args.checkpoint_dir, seed=args.seed)
+    ckpt = _train(wh, cfg, epochs=epochs, batch_size=batch_size,
+                  checkpoint_dir=_ckpt_dir(args, cfg), seed=seed)
     if ckpt is None:
         return 2
     # score exactly the checkpoint this demo just trained, never whatever
     # happens to be newest in a shared checkpoint dir
-    return _backtest(wh, cfg, ckpt, window=cfg.train.window, threshold=0.5)
+    return _backtest(wh, cfg, ckpt, window=cfg.train.window,
+                     threshold=cfg.train.prob_threshold)
 
 
 def cmd_ingest(args) -> int:
@@ -125,7 +139,7 @@ def cmd_train(args) -> int:
     cfg = _config(args)
     ckpt = _train(
         _warehouse(args.warehouse, cfg), cfg, epochs=args.epochs,
-        batch_size=args.batch_size, checkpoint_dir=args.checkpoint_dir,
+        batch_size=args.batch_size, checkpoint_dir=_ckpt_dir(args, cfg),
         seed=args.seed,
     )
     return 0 if ckpt else 2
@@ -153,11 +167,11 @@ def _backtest(wh, cfg, ckpt: str, *, window: int, threshold: float) -> int:
 def cmd_backtest(args) -> int:
     from fmda_tpu.train.checkpoint import latest_checkpoint
 
-    ckpt = args.checkpoint or latest_checkpoint(args.checkpoint_dir)
+    cfg = _config(args)
+    ckpt = args.checkpoint or latest_checkpoint(_ckpt_dir(args, cfg))
     if ckpt is None:
         print("no checkpoint found", file=sys.stderr)
         return 2
-    cfg = _config(args)
     return _backtest(
         _warehouse(args.warehouse, cfg), cfg, ckpt,
         window=(args.window if args.window is not None
@@ -187,7 +201,7 @@ def cmd_serve(args) -> int:
     threshold = (args.threshold if args.threshold is not None
                  else cfg.train.prob_threshold)
     wh = _warehouse(args.warehouse, cfg)
-    ckpt = args.checkpoint or latest_checkpoint(args.checkpoint_dir)
+    ckpt = args.checkpoint or latest_checkpoint(_ckpt_dir(args, cfg))
     if ckpt is None:
         print("no checkpoint found", file=sys.stderr)
         return 2
@@ -241,10 +255,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("demo", parents=[common], help="synthetic end-to-end proof run")
     p.add_argument("--days", type=int, default=8)
-    p.add_argument("--epochs", type=int, default=2)
-    p.add_argument("--batch-size", type=int, default=32)
-    p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--epochs", type=int, default=None,
+                   help="default: config's train.epochs, or 2 standalone")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--checkpoint-dir", default=None)
     p.set_defaults(fn=cmd_demo)
 
     p = sub.add_parser("ingest", parents=[common], help="fill a warehouse file")
@@ -257,7 +272,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("train", parents=[common], help="train over a warehouse file")
     p.add_argument("--warehouse", required=True)
-    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="override config train.checkpoint_dir")
     p.add_argument("--epochs", type=int, default=None,
                    help="override config train.epochs (default 25)")
     p.add_argument("--batch-size", type=int, default=None,
@@ -268,7 +284,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("backtest", parents=[common], help="score a checkpoint over history")
     p.add_argument("--warehouse", required=True)
     p.add_argument("--checkpoint", default=None)
-    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--window", type=int, default=None,
                    help="override config train.window (default 30)")
     p.add_argument("--threshold", type=float, default=None)
@@ -277,7 +293,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("serve", parents=[common], help="prediction daemon over a warehouse")
     p.add_argument("--warehouse", required=True)
     p.add_argument("--checkpoint", default=None)
-    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--window", type=int, default=None,
                    help="override config train.window (default 30)")
     p.add_argument("--threshold", type=float, default=None,

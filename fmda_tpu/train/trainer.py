@@ -96,6 +96,40 @@ class Trainer:
             state = jax.device_put(state, replicated_sharding(self.mesh))
         return state
 
+    def restore_state(self, checkpoint_path: str) -> TrainState:
+        """Exact-resume a checkpoint into this trainer's state structure.
+
+        The raw checkpoint tree stores the optimizer state as plain
+        containers; its leaves are grafted back onto the typed optax
+        structure a fresh ``init_state`` provides, so ``fit(...,
+        initial_state=restore_state(p))`` continues training bit-exactly
+        (step counter included — the dropout stream folds on it).
+        """
+        from fmda_tpu.train.checkpoint import restore_checkpoint
+
+        tree, norm = restore_checkpoint(checkpoint_path)
+        # remembered so a subsequent fit() can detect that the data source
+        # (and hence the recomputed normalization) changed since the save
+        self._restored_norm = norm
+        template = self.init_state(jax.random.PRNGKey(0))
+        params = jax.tree.map(
+            lambda t, r: jnp.asarray(r, t.dtype), template.params,
+            tree["params"],
+        )
+        opt_state = jax.tree.unflatten(
+            jax.tree.structure(template.opt_state),
+            [jnp.asarray(leaf) for leaf in jax.tree.leaves(tree["opt_state"])],
+        )
+        state = TrainState(
+            params=params, opt_state=opt_state,
+            step=jnp.asarray(int(tree["step"]), jnp.int32),
+        )
+        if self.mesh is not None:
+            from fmda_tpu.parallel.mesh import replicated_sharding
+
+            state = jax.device_put(state, replicated_sharding(self.mesh))
+        return state
+
     # -- compiled steps ------------------------------------------------------
 
     def _batch_sharding(self):
@@ -273,6 +307,25 @@ class Trainer:
         )
         return state, epoch, np.asarray(confusion_total, np.int64)
 
+    def _warn_if_norm_drifted(self, dataset: ChunkDataset) -> None:
+        """Resume runs recompute normalization from the *current* source;
+        if rows landed since the checkpoint was written, the serving stats
+        (last-chunk min/max) shift under the restored params — loud, not
+        silent."""
+        saved = getattr(self, "_restored_norm", None)
+        if saved is None:
+            return
+        now = dataset.final_norm_params
+        if not (
+            np.allclose(saved.x_min, now.x_min)
+            and np.allclose(saved.x_max, now.x_max)
+        ):
+            log.warning(
+                "resuming on a source whose normalization stats differ from "
+                "the checkpoint's (data changed since the save): inputs are "
+                "rescaled relative to what the restored params saw"
+            )
+
     def fit(
         self,
         source: FeatureSource,
@@ -281,8 +334,14 @@ class Trainer:
         epochs: Optional[int] = None,
         bid_levels: int = 0,
         ask_levels: int = 0,
+        initial_state: Optional[TrainState] = None,
     ) -> Tuple[TrainState, Dict[str, List[EpochMetrics]], ChunkDataset]:
-        """Train over a feature source; returns (state, history, dataset)."""
+        """Train over a feature source; returns (state, history, dataset).
+
+        ``initial_state`` (e.g. from :meth:`restore_state`) resumes
+        mid-training instead of initialising fresh; ``epochs`` then means
+        *additional* epochs to run.
+        """
         tc = self.train_cfg
         rng = jax.random.PRNGKey(tc.seed) if rng is None else rng
         init_rng, step_rng = jax.random.split(rng)
@@ -294,7 +353,12 @@ class Trainer:
             ask_levels=ask_levels,
         )
         train_chunks, val_chunks, _ = dataset.split(tc.val_size, tc.test_size)
-        state = self.init_state(init_rng)
+        state = (
+            initial_state if initial_state is not None
+            else self.init_state(init_rng)
+        )
+        if initial_state is not None:
+            self._warn_if_norm_drifted(dataset)
         history: Dict[str, List[EpochMetrics]] = {"train": [], "val": []}
         for epoch in range(epochs if epochs is not None else tc.epochs):
             state, train_metrics, _ = self._run_chunks(

@@ -114,3 +114,61 @@ def test_checkpoint_roundtrip(tmp_path):
     loaded = jax.tree.leaves(tree["params"])
     for a, b in zip(orig, loaded):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fit_resumes_exactly_from_checkpoint(tmp_path):
+    """save -> restore_state -> fit(initial_state=...) must continue
+    bit-exactly: 2 + 1 resumed epochs == 3 uninterrupted (the dropout
+    stream folds on the restored step counter)."""
+    import numpy as np
+
+    from fmda_tpu.train import save_checkpoint
+
+    src = _toy_source(n=120)
+    mk = lambda: Trainer(
+        ModelConfig(hidden_size=6, n_features=5, output_size=4,
+                    dropout=0.3, use_pallas=False),
+        TrainConfig(batch_size=8, window=5, chunk_size=40, epochs=3, seed=3),
+    )
+
+    straight = mk()
+    state3, hist3, _ = straight.fit(src, epochs=3)
+
+    first = mk()
+    state2, _, ds = first.fit(src, epochs=2)
+    ckpt = save_checkpoint(str(tmp_path / "ck"), state2, ds.final_norm_params)
+
+    resumed_trainer = mk()
+    restored = resumed_trainer.restore_state(ckpt)
+    assert int(restored.step) == int(state2.step)
+    state_r, hist_r, _ = resumed_trainer.fit(
+        src, epochs=1, initial_state=restored)
+
+    assert int(state_r.step) == int(state3.step)
+    for a, b in zip(jax.tree.leaves(state_r.params),
+                    jax.tree.leaves(state3.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+    assert hist_r["train"][-1].loss == pytest.approx(
+        hist3["train"][-1].loss, rel=1e-5)
+
+
+def test_resume_warns_when_source_normalization_changed(tmp_path, caplog):
+    """Resuming over a source that grew since the checkpoint must warn:
+    the recomputed norm stats rescale inputs under the restored params."""
+    from fmda_tpu.train import save_checkpoint
+
+    mk = lambda: Trainer(
+        ModelConfig(hidden_size=6, n_features=5, output_size=4,
+                    dropout=0.0, use_pallas=False),
+        TrainConfig(batch_size=8, window=5, chunk_size=40, epochs=1, seed=3),
+    )
+    t1 = mk()
+    state, _, ds = t1.fit(_toy_source(n=120), epochs=1)
+    ckpt = save_checkpoint(str(tmp_path / "ck"), state, ds.final_norm_params)
+
+    t2 = mk()
+    restored = t2.restore_state(ckpt)
+    with caplog.at_level("WARNING"):
+        t2.fit(_toy_source(n=200, seed=9), epochs=1, initial_state=restored)
+    assert any("normalization stats differ" in r.message for r in caplog.records)
