@@ -51,23 +51,45 @@ class UrllibTransport:
 
 
 class ReplayTransport:
-    """Serve responses from recorded (url-pattern -> body) fixtures."""
+    """Serve responses from recorded (url-pattern -> body) fixtures.
 
-    def __init__(self, fixtures: Dict[str, bytes]) -> None:
-        #: regex pattern -> body; exact strings work too (re.escape not
-        #: required for urls without regex metacharacters in the match).
-        self.fixtures = {
-            k: (v if isinstance(v, bytes) else str(v).encode()) for k, v in fixtures.items()
-        }
+    A fixture value may be one body, or a *sequence* of bodies replayed in
+    request order (a live session hits the same URL repeatedly with
+    evolving responses — the sequential form reproduces the whole day;
+    after the recorded responses run out, the last one repeats).
+    """
+
+    def __init__(self, fixtures: Dict[str, object]) -> None:
+        #: regex pattern -> body or list of bodies; exact strings work too
+        #: (re.escape not required for urls without regex metacharacters).
+        def coerce(v) -> List[bytes]:
+            if isinstance(v, (list, tuple)):
+                if not v:
+                    raise ValueError(
+                        "empty fixture sequence (a url with zero recorded "
+                        "bodies can never be served)"
+                    )
+                return [b if isinstance(b, bytes) else str(b).encode()
+                        for b in v]
+            return [v if isinstance(v, bytes) else str(v).encode()]
+
+        self.fixtures = {k: coerce(v) for k, v in fixtures.items()}
+        self._cursor: Dict[str, int] = {}
         self.requests: List[str] = []
+
+    def _serve(self, key: str) -> bytes:
+        bodies = self.fixtures[key]
+        i = self._cursor.get(key, 0)
+        self._cursor[key] = i + 1
+        return bodies[min(i, len(bodies) - 1)]
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         self.requests.append(url)
         if url in self.fixtures:
-            return self.fixtures[url]
-        for pattern, body in self.fixtures.items():
+            return self._serve(url)
+        for pattern in self.fixtures:
             if re.search(pattern, url):
-                return body
+                return self._serve(pattern)
         raise TransportError(f"no fixture for {url}")
 
 
@@ -112,20 +134,22 @@ class RetryTransport:
 class RecordingTransport:
     """Wrap a live transport and persist every response for later replay.
 
-    Bodies are stored base64-encoded so binary/gzip responses survive the
-    round-trip bit-exact (a lossy ``errors='replace'`` decode would make
-    replay diverge from the live response), and the fixture file is written
-    once on :meth:`flush`/``close``/context exit, not per request.
+    Every response is kept, *in request order per URL* — a live session
+    hits the same endpoints each tick with evolving bodies, and replaying
+    the full sequence through :class:`ReplayTransport` reproduces the
+    whole day.  Bodies are stored base64-encoded so binary/gzip responses
+    survive the round-trip bit-exact, and the fixture file is written once
+    on :meth:`flush`/``close``/context exit, not per request.
     """
 
     def __init__(self, inner: Transport, path: str) -> None:
         self.inner = inner
         self.path = path
-        self.recorded: Dict[str, bytes] = {}
+        self.recorded: Dict[str, List[bytes]] = {}
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         body = self.inner.get(url, headers)
-        self.recorded[url] = body
+        self.recorded.setdefault(url, []).append(body)
         return body
 
     def flush(self) -> None:
@@ -134,8 +158,8 @@ class RecordingTransport:
         with open(self.path, "w") as fh:
             json.dump(
                 {
-                    u: base64.b64encode(b).decode("ascii")
-                    for u, b in self.recorded.items()
+                    u: [base64.b64encode(b).decode("ascii") for b in bodies]
+                    for u, bodies in self.recorded.items()
                 },
                 fh,
             )
@@ -149,10 +173,21 @@ class RecordingTransport:
         self.flush()
 
     @staticmethod
-    def load_fixtures(path: str) -> Dict[str, bytes]:
-        """Read a recorded fixture file back into ReplayTransport form."""
+    def load_fixtures(path: str) -> Dict[str, List[bytes]]:
+        """Read a recorded fixture file back into ReplayTransport form.
+
+        Accepts both the sequential format this class writes and the
+        legacy one-body-per-url form.
+        """
         import base64
 
         with open(path) as fh:
             raw = json.load(fh)
-        return {u: base64.b64decode(s) for u, s in raw.items()}
+        return {
+            u: (
+                [base64.b64decode(x) for x in s]
+                if isinstance(s, list)
+                else [base64.b64decode(s)]
+            )
+            for u, s in raw.items()
+        }

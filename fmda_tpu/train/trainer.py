@@ -78,23 +78,29 @@ class Trainer:
 
     # -- state ---------------------------------------------------------------
 
-    def init_state(self, rng: jax.Array) -> TrainState:
+    def _init_state_local(self, rng: jax.Array) -> TrainState:
+        """Fresh state on the default device (no mesh placement)."""
         cfg = self.model_cfg
         dummy = jnp.zeros(
             (1, self.train_cfg.window, cfg.n_features), jnp.float32
         )
         variables = self.model.init({"params": rng}, dummy)
         opt_state = self.optimizer.init(variables["params"])
-        state = TrainState(
+        return TrainState(
             params=variables["params"],
             opt_state=opt_state,
             step=jnp.zeros((), jnp.int32),
         )
+
+    def _place_state(self, state: TrainState) -> TrainState:
         if self.mesh is not None:
             from fmda_tpu.parallel.mesh import replicated_sharding
 
             state = jax.device_put(state, replicated_sharding(self.mesh))
         return state
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        return self._place_state(self._init_state_local(rng))
 
     def restore_state(self, checkpoint_path: str) -> TrainState:
         """Exact-resume a checkpoint into this trainer's state structure.
@@ -111,7 +117,9 @@ class Trainer:
         # remembered so a subsequent fit() can detect that the data source
         # (and hence the recomputed normalization) changed since the save
         self._restored_norm = norm
-        template = self.init_state(jax.random.PRNGKey(0))
+        # structure/dtype template only — no mesh placement of throwaway
+        # arrays; the restored state is placed once below
+        template = self._init_state_local(jax.random.PRNGKey(0))
         params = jax.tree.map(
             lambda t, r: jnp.asarray(r, t.dtype), template.params,
             tree["params"],
@@ -120,15 +128,10 @@ class Trainer:
             jax.tree.structure(template.opt_state),
             [jnp.asarray(leaf) for leaf in jax.tree.leaves(tree["opt_state"])],
         )
-        state = TrainState(
+        return self._place_state(TrainState(
             params=params, opt_state=opt_state,
             step=jnp.asarray(int(tree["step"]), jnp.int32),
-        )
-        if self.mesh is not None:
-            from fmda_tpu.parallel.mesh import replicated_sharding
-
-            state = jax.device_put(state, replicated_sharding(self.mesh))
-        return state
+        ))
 
     # -- compiled steps ------------------------------------------------------
 

@@ -330,7 +330,26 @@ def test_recording_transport_binary_roundtrip(tmp_path):
         assert not path.exists()  # no per-request rewrite
         rec.get("https://x/text")
     fixtures = RecordingTransport.load_fixtures(str(path))
-    assert fixtures["https://x/binary"] == binary
+    assert fixtures["https://x/binary"] == [binary]
     replay = ReplayTransport(fixtures)
     assert replay.get("https://x/binary") == binary
     assert replay.get("https://x/text") == b'{"ok": 1}'
+
+
+def test_recording_transport_replays_session_sequence(tmp_path):
+    """A live session hits the same URL with evolving responses; the
+    recording keeps every body in order and the replay serves them back
+    in order (last repeats once exhausted)."""
+    from fmda_tpu.ingest import RecordingTransport
+
+    inner = ReplayTransport({r"quote": [b"tick1", b"tick2", b"tick3"]})
+    path = tmp_path / "session.json"
+    with RecordingTransport(inner, str(path)) as rec:
+        assert [rec.get("https://x/quote") for _ in range(3)] == [
+            b"tick1", b"tick2", b"tick3"]
+
+    replay = ReplayTransport(RecordingTransport.load_fixtures(str(path)))
+    assert replay.get("https://x/quote") == b"tick1"
+    assert replay.get("https://x/quote") == b"tick2"
+    assert replay.get("https://x/quote") == b"tick3"
+    assert replay.get("https://x/quote") == b"tick3"  # last repeats
