@@ -92,12 +92,66 @@ def cmd_ingest(args) -> int:
                                           n_days=args.synthetic_days)):
             bus.publish(topic, msg)
         engine.step()
+    elif args.replay:
+        ticks = _replay_session(args, cfg, bus)
+        print(f"replayed {ticks} session tick(s)", file=sys.stderr)
+        if ticks == 0:
+            print("0 ticks replayed — check --replay-start against the "
+                  "recording's market-calendar date", file=sys.stderr)
+            return 2
+        engine.step()
     else:
-        print("live ingestion needs API tokens; attach a SessionDriver via "
+        print("pass --synthetic-days or --replay (a RecordingTransport "
+              "fixture file); live ingestion attaches a SessionDriver via "
               "the Application API (docs/OPERATIONS.md §2)", file=sys.stderr)
         return 2
     print(f"warehouse {args.warehouse}: {len(wh)} rows; engine {engine.stats}")
     return 0
+
+
+def _replay_session(args, cfg, bus) -> int:
+    """Re-run a recorded session (RecordingTransport file) through the real
+    acquisition layer: same clients/scrapers, responses served back in
+    recorded order, clock simulated at the configured cadence."""
+    import datetime as dt
+
+    from fmda_tpu.ingest import (
+        AlphaVantageClient, COTScraper, EconomicCalendarScraper, IEXClient,
+        RecordingTransport, SessionDriver, SessionReplayTransport,
+        TradierCalendarClient, VIXScraper,
+    )
+
+    transport = SessionReplayTransport(
+        RecordingTransport.load_fixtures(args.replay))
+    clock = {"now": dt.datetime.strptime(
+        args.replay_start, "%Y-%m-%d %H:%M:%S")}
+
+    def now_fn():
+        return clock["now"]
+
+    def fast_sleep(s):
+        clock["now"] += dt.timedelta(seconds=s)
+
+    sc = cfg.session
+    driver = SessionDriver(
+        bus, sc,
+        iex=IEXClient("replay", transport),
+        alpha_vantage=AlphaVantageClient("replay", transport),
+        calendar=TradierCalendarClient("replay", transport),
+        indicator_scraper=EconomicCalendarScraper(
+            cfg.features, transport=transport),
+        vix_scraper=VIXScraper(transport),
+        cot_scraper=COTScraper(sc.cot_subject, transport),
+        now_fn=now_fn, sleep_fn=fast_sleep,
+    )
+    ticks = driver.run_session(max_ticks=args.ticks or None)
+    if transport.misses:
+        # the replay ran under a config whose feeds/cadence differ from
+        # the recording — the per-feed warnings above say which ticks,
+        # this says which endpoints
+        print("recording has no responses for: "
+              + ", ".join(sorted(set(transport.misses))), file=sys.stderr)
+    return ticks
 
 
 def _train(wh, cfg, *, epochs, batch_size, checkpoint_dir, seed):
@@ -265,6 +319,13 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("ingest", parents=[common], help="fill a warehouse file")
     p.add_argument("--warehouse", required=True, help="sqlite file path")
     p.add_argument("--synthetic-days", type=int, default=0)
+    p.add_argument("--replay", default=None, metavar="FIXTURES",
+                   help="re-run a recorded session (RecordingTransport "
+                        "file) through the real acquisition layer")
+    p.add_argument("--replay-start", default="2020-02-07 09:30:00",
+                   help="simulated clock start for --replay")
+    p.add_argument("--ticks", type=int, default=0,
+                   help="cap on --replay session ticks (0 = until close)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--engine-checkpoint", default=None)
     p.add_argument("--checkpoint-every", type=int, default=1)
@@ -310,7 +371,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # stdout consumer (head, a closed pager) went away mid-print —
+        # normal unix behavior, not an error
+        try:
+            sys.stdout.close()
+        except BrokenPipeError:
+            pass
+        return 0
 
 
 if __name__ == "__main__":

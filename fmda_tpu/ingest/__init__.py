@@ -1,5 +1,6 @@
 from fmda_tpu.ingest.transport import (
     RecordingTransport,
+    SessionReplayTransport,
     ReplayTransport,
     RetryTransport,
     Transport,
@@ -18,6 +19,7 @@ __all__ = [
     "UrllibTransport",
     "ReplayTransport",
     "RecordingTransport",
+    "SessionReplayTransport",
     "RetryTransport",
     "IEXClient",
     "AlphaVantageClient",

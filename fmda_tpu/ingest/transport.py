@@ -78,10 +78,7 @@ class ReplayTransport:
         self.requests: List[str] = []
 
     def _serve(self, key: str) -> bytes:
-        bodies = self.fixtures[key]
-        i = self._cursor.get(key, 0)
-        self._cursor[key] = i + 1
-        return bodies[min(i, len(bodies) - 1)]
+        return _serve_sequential(self.fixtures, self._cursor, key)
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         self.requests.append(url)
@@ -91,6 +88,51 @@ class ReplayTransport:
             if re.search(pattern, url):
                 return self._serve(pattern)
         raise TransportError(f"no fixture for {url}")
+
+
+def _serve_sequential(
+    bodies_map: Dict[str, List[bytes]], cursor: Dict[str, int], key: str
+) -> bytes:
+    """Shared sequential-replay semantics: bodies in recorded order, the
+    last one repeating once exhausted."""
+    bodies = bodies_map[key]
+    i = cursor.get(key, 0)
+    cursor[key] = i + 1
+    return bodies[min(i, len(bodies) - 1)]
+
+
+def _mask_credentials(url: str) -> str:
+    return re.sub(r"(token|apikey)=[^&]+", r"\1=*", url)
+
+
+class SessionReplayTransport:
+    """Replay a recorded session with credentials masked out of the URL
+    match, so fixtures recorded with real tokens serve clients constructed
+    with placeholders.  Exact (masked) URL matching — recorded keys are
+    literal URLs full of regex metacharacters, so the pattern matching of
+    :class:`ReplayTransport` does not apply.  Unmatched requests are
+    remembered in :attr:`misses` so a replay under a mismatched config
+    (different feeds/cadence than recorded) can be diagnosed."""
+
+    def __init__(self, fixtures: Dict[str, List[bytes]]) -> None:
+        self._bodies: Dict[str, List[bytes]] = {}
+        for url, bodies in fixtures.items():
+            if not bodies:
+                raise ValueError(f"empty fixture sequence for {url}")
+            self._bodies.setdefault(_mask_credentials(url), []).extend(
+                b if isinstance(b, bytes) else str(b).encode()
+                for b in (bodies if isinstance(bodies, (list, tuple))
+                          else [bodies])
+            )
+        self._cursor: Dict[str, int] = {}
+        self.misses: List[str] = []
+
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        key = _mask_credentials(url)
+        if key not in self._bodies:
+            self.misses.append(key)
+            raise TransportError(f"no recorded response for {url}")
+        return _serve_sequential(self._bodies, self._cursor, key)
 
 
 class RetryTransport:

@@ -57,7 +57,60 @@ def test_train_on_empty_warehouse_fails_cleanly(tmp_path, capsys):
 def test_ingest_without_source_fails_cleanly(tmp_path, capsys):
     assert main(["ingest", "--warehouse",
                  str(tmp_path / "w.sqlite")]) == 2
-    assert "tokens" in capsys.readouterr().err
+    assert "--synthetic-days or --replay" in capsys.readouterr().err
+
+
+def test_ingest_replays_recorded_session(tmp_path, capsys):
+    """A RecordingTransport fixture file re-runs through the real
+    acquisition layer end-to-end: clients, scrapers, session gating."""
+    import datetime as dt
+    import os
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples"))
+    from full_day_offline import SynthMarketTransport
+
+    from fmda_tpu.config import DEFAULT_TOPICS, FeatureConfig, SessionConfig
+    from fmda_tpu.ingest import (
+        AlphaVantageClient, COTScraper, EconomicCalendarScraper, IEXClient,
+        RecordingTransport, SessionDriver, TradierCalendarClient, VIXScraper,
+    )
+    from fmda_tpu.stream import InProcessBus
+
+    # record 3 ticks off the fake exchange
+    fc = FeatureConfig()
+    live = SynthMarketTransport(fc)
+    path = str(tmp_path / "day.json")
+    rec = RecordingTransport(live, path)
+    clock = {"now": dt.datetime(2020, 2, 7, 9, 30, 0)}
+
+    def now_fn():
+        live.now = clock["now"]
+        return clock["now"]
+
+    bus = InProcessBus(DEFAULT_TOPICS)
+    SessionDriver(
+        bus, SessionConfig(freq_s=300),
+        iex=IEXClient("tok", rec),
+        alpha_vantage=AlphaVantageClient("tok", rec),
+        calendar=TradierCalendarClient("tok", rec),
+        indicator_scraper=EconomicCalendarScraper(fc, transport=rec),
+        vix_scraper=VIXScraper(rec),
+        cot_scraper=COTScraper("S&P 500 STOCK INDEX", rec),
+        now_fn=now_fn,
+        sleep_fn=lambda s: clock.update(
+            now=clock["now"] + dt.timedelta(seconds=s)),
+    ).run_session(max_ticks=3)
+    rec.flush()
+
+    wh_path = str(tmp_path / "wh.sqlite")
+    assert main(["ingest", "--warehouse", wh_path, "--replay", path,
+                 "--ticks", "3"]) == 0
+    captured = capsys.readouterr()
+    assert "replayed 3 session tick(s)" in captured.err
+    assert "3 rows" in captured.out
 
 
 def test_cli_config_file_reshapes_pipeline(tmp_path, capsys):
