@@ -61,11 +61,17 @@ class Application:
             if warehouse is not None
             else Warehouse(self.config.features, self.config.warehouse)
         )
+        ec = self.config.engine
         self.engine = StreamEngine(
             self.bus,
             self.warehouse,
             self.config.features,
-            checkpoint_path=engine_checkpoint,
+            checkpoint_path=(
+                engine_checkpoint if engine_checkpoint is not None
+                else ec.checkpoint_path
+            ),
+            checkpoint_every=ec.checkpoint_every,
+            join_backend=ec.join_backend,
         )
         self.session = None
         self.predictors: List = []

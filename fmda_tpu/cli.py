@@ -71,21 +71,30 @@ def cmd_demo(args) -> int:
 
 
 def cmd_ingest(args) -> int:
-    from fmda_tpu.app import default_bus
+    import dataclasses
+
+    from fmda_tpu.app import Application
     from fmda_tpu.data.synthetic import (
         SyntheticMarketConfig, synthetic_session_messages,
     )
-    from fmda_tpu.stream import StreamEngine
 
     cfg = _config(args)
-    fc = cfg.features
-    wh = _warehouse(args.warehouse, cfg)
-    bus = default_bus(cfg)
-    engine = StreamEngine(
-        bus, wh, fc,
-        checkpoint_path=args.engine_checkpoint,
-        checkpoint_every=args.checkpoint_every,
+    # CLI overrides fold into the config; one composition root builds
+    # bus + warehouse + engine exactly as the library API would
+    engine_overrides = {
+        k: v for k, v in dict(
+            checkpoint_path=args.engine_checkpoint,
+            checkpoint_every=args.checkpoint_every,
+        ).items() if v is not None
+    }
+    cfg = dataclasses.replace(
+        cfg,
+        warehouse=dataclasses.replace(cfg.warehouse, path=args.warehouse),
+        engine=dataclasses.replace(cfg.engine, **engine_overrides),
     )
+    fc = cfg.features
+    app = Application(cfg)
+    wh, bus, engine = app.warehouse, app.bus, app.engine
     if args.synthetic_days:
         for topic, msg in synthetic_session_messages(
                 fc, SyntheticMarketConfig(seed=args.seed,
@@ -328,7 +337,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cap on --replay session ticks (0 = until close)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--engine-checkpoint", default=None)
-    p.add_argument("--checkpoint-every", type=int, default=1)
+    p.add_argument("--checkpoint-every", type=int, default=None)
     p.set_defaults(fn=cmd_ingest)
 
     p = sub.add_parser("train", parents=[common], help="train over a warehouse file")

@@ -379,6 +379,22 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class EngineConfig:
+    """Streaming-engine runtime knobs (the role Spark's runtime config
+    plays for the reference's consumer)."""
+
+    #: "python" or "native" — the C++ interval-join scheduler
+    #: (native/joincore.cpp); falls back to the (bit-identical) python
+    #: path with a warning if the toolchain is absent.
+    join_backend: str = "python"
+    #: Durable-state write cadence in steps (1 = every step; N amortises
+    #: over replay churn, idempotent re-landing covers the crash window).
+    checkpoint_every: int = 1
+    #: Engine state file (offsets + in-flight join state); None disables.
+    checkpoint_path: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class SessionConfig:
     """Ingestion-session driver knobs (ref: producer.py:257-263)."""
 
@@ -398,6 +414,7 @@ class FrameworkConfig:
     features: FeatureConfig = field(default_factory=FeatureConfig)
     bus: BusConfig = field(default_factory=BusConfig)
     warehouse: WarehouseConfig = field(default_factory=WarehouseConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -425,6 +442,7 @@ _SECTIONS = {
     "features": FeatureConfig,
     "bus": BusConfig,
     "warehouse": WarehouseConfig,
+    "engine": EngineConfig,
     "model": ModelConfig,
     "train": TrainConfig,
     "mesh": MeshConfig,

@@ -263,13 +263,24 @@ class StreamEngine:
         #: "native" backend is bit-identical to "python", test-locked
         self._core = None
         if join_backend == "native":
-            from fmda_tpu.stream.native_join import NativeJoinCore
-
-            self._stream_topics = list(self._side_streams)
-            self._core = NativeJoinCore(
-                features.floor_s, features.join_tolerance_s,
-                features.watermark_s, len(self._stream_topics),
+            from fmda_tpu.stream.native_join import (
+                NativeJoinCore, NativeJoinUnavailable,
             )
+
+            try:
+                self._stream_topics = list(self._side_streams)
+                self._core = NativeJoinCore(
+                    features.floor_s, features.join_tolerance_s,
+                    features.watermark_s, len(self._stream_topics),
+                )
+            except NativeJoinUnavailable as e:
+                # loud fallback, like default_bus for the ring bus: the
+                # python path is bit-identical, just not C++
+                log.warning(
+                    "native join scheduler unavailable (%s); using the "
+                    "python join path", e,
+                )
+                self._core = None
         elif join_backend != "python":
             raise ValueError(
                 f"join_backend {join_backend!r}; use 'python' or 'native'")

@@ -188,3 +188,33 @@ def test_application_defaults_build():
     app.bus.publish("deep", {"Timestamp": "2020-01-01 00:00:00"})
     with pytest.raises(KeyError):
         app.bus.publish("bogus", {})
+
+
+def test_application_engine_config_native_join():
+    """EngineConfig selects the C++ join scheduler through the composition
+    root; output identical to the default python backend."""
+    from fmda_tpu.config import EngineConfig, FrameworkConfig
+    from fmda_tpu.stream.native_join import native_join_available
+
+    if not native_join_available():
+        pytest.skip("native toolchain unavailable")
+
+    from fmda_tpu.app import Application
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, synthetic_session_messages
+
+    results = {}
+    for backend in ("python", "native"):
+        cfg = FrameworkConfig(engine=EngineConfig(join_backend=backend))
+        app = Application(cfg)
+        if backend == "python":
+            assert app.engine._core is None
+        else:
+            assert app.engine._core is not None
+        for topic, msg in synthetic_session_messages(
+                cfg.features, SyntheticMarketConfig(seed=4, n_days=1)):
+            app.bus.publish(topic, msg)
+        app.engine.step()
+        results[backend] = (dict(app.engine.stats),
+                            app.warehouse.timestamps())
+    assert results["python"] == results["native"]
+    assert results["python"][0]["emitted"] == 78
