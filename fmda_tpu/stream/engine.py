@@ -319,7 +319,9 @@ class StreamEngine:
                 raws.append(
                     _extract_deep_raw(rec.value, fc.bid_levels, fc.ask_levels)
                 )
-            except (KeyError, ValueError, TypeError) as e:
+            except (KeyError, ValueError, TypeError, AttributeError) as e:
+                # AttributeError: a nested level that should be a dict is a
+                # scalar — malformed producer output, not a crash
                 log.warning("bad deep message at offset %d: %s", rec.offset, e)
         for event in _parse_deep_batch(raws):
             bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
@@ -336,7 +338,7 @@ class StreamEngine:
                 polled_any = True
                 try:
                     event = parsers[topic](rec.value)
-                except (KeyError, ValueError, TypeError) as e:
+                except (KeyError, ValueError, TypeError, AttributeError) as e:
                     log.warning(
                         "bad %s message at offset %d: %s", topic, rec.offset, e
                     )

@@ -115,3 +115,20 @@ def test_config_json_roundtrip(tmp_path):
         config_from_dict({"modle": {}})
     with pytest.raises(ValueError, match=r"unknown keys in \[train\]"):
         config_from_dict({"train": {"epoch": 7}})
+
+
+def test_committed_example_config_is_current(tmp_path):
+    """examples/deployment.json is documented as the dumped default
+    schema; regenerating it must produce the same content (regenerate
+    with save_config + json.tool when config fields change)."""
+    import json
+    import os
+
+    from fmda_tpu.config import FrameworkConfig, save_config
+
+    committed = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "deployment.json")
+    regen = str(tmp_path / "regen.json")
+    save_config(FrameworkConfig(), regen)
+    assert json.load(open(committed)) == json.load(open(regen))
