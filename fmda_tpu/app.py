@@ -198,3 +198,9 @@ class Application:
     @property
     def stats(self) -> Dict[str, int]:
         return {**self.engine.stats, "warehouse_rows": len(self.warehouse)}
+
+    @property
+    def stage_timings(self) -> Dict[str, Dict[str, float]]:
+        """Host-side wall clock per engine stage (ingest/join/land/signal)
+        — the observability the reference never had (SURVEY.md §5)."""
+        return self.engine.timer.summary()

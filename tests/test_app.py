@@ -218,3 +218,13 @@ def test_application_engine_config_native_join():
                             app.warehouse.timestamps())
     assert results["python"] == results["native"]
     assert results["python"][0]["emitted"] == 78
+
+
+def test_application_stage_timings_exposed():
+    from fmda_tpu.app import Application
+
+    app = Application()
+    app.run_tick()
+    timings = app.stage_timings
+    assert {"ingest", "join"} <= set(timings)
+    assert all(t["count"] >= 1 for t in timings.values())
