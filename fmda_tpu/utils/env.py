@@ -67,6 +67,8 @@ def probe_backend(timeout_s: float = 120.0) -> Dict:
         )
     except subprocess.TimeoutExpired:
         return {"error": f"backend probe timed out after {timeout_s:.0f}s"}
+    except OSError as e:  # interpreter unspawnable — still never raise
+        return {"error": f"backend probe could not start: {e}"}
     if proc.returncode != 0:
         return {"error": proc.stderr.decode(errors="replace")[-300:]}
     try:

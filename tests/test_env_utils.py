@@ -1,0 +1,57 @@
+"""The backend-robustness helpers guarding the driver entry points
+(bench.py, __graft_entry__): a wedged TPU plugin must cost a bounded
+probe, never a hang."""
+
+import os
+import subprocess
+import sys
+
+from fmda_tpu.utils.env import cpu_forced_env, probe_backend
+
+
+def test_cpu_forced_env_scrubs_and_forces(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "test-sentinel")
+    env = cpu_forced_env(6, repo_dir="/some/repo")
+    assert "PALLAS_AXON_POOL_IPS" not in env
+    assert "TPU_WORKER_HOSTNAMES" not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=6" in env["XLA_FLAGS"]
+    assert env["PYTHONPATH"].startswith("/some/repo" + os.pathsep)
+    # replaces a prior device-count flag instead of stacking a second one
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=2 --xla_foo=1")
+    env = cpu_forced_env(8)
+    assert env["XLA_FLAGS"].count(
+        "--xla_force_host_platform_device_count") == 1
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert "--xla_foo=1" in env["XLA_FLAGS"]
+
+
+def test_probe_backend_reports_cpu_in_forced_env():
+    """Run the probe inside a CPU-forced child so the result is
+    deterministic regardless of the ambient accelerator's health."""
+    code = (
+        "from fmda_tpu.utils.env import probe_backend; import json; "
+        "print(json.dumps(probe_backend(timeout_s=120)))"
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=cpu_forced_env(2, repo_dir=repo),
+        capture_output=True, timeout=180,
+    )
+    assert out.returncode == 0, out.stderr.decode(errors="replace")[-500:]
+    import json
+
+    info = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    assert info == {"backend": "cpu", "n_devices": 2, "device_kind": "cpu"}
+
+
+def test_probe_backend_surfaces_broken_interpreter(monkeypatch):
+    """A probe that cannot even spawn its interpreter must return an error
+    dict, not raise or hang."""
+    import fmda_tpu.utils.env as env_mod
+
+    monkeypatch.setattr(env_mod.sys, "executable", "/nonexistent/python")
+    info = env_mod.probe_backend(timeout_s=10)
+    assert "error" in info
