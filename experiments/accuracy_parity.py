@@ -1,4 +1,5 @@
-"""Accuracy-parity experiment: reference training protocol, end to end.
+"""Accuracy-parity experiment: reference training protocol, end to end,
+with a SAME-CORPUS torch baseline.
 
 Reproduces the reference's only published quality evidence — the notebook
 training run (biGRU_model_training.ipynb cells 11-39: 3,980 rows, chunk 100
@@ -8,14 +9,24 @@ confusion) — on this framework's full pipeline: synthetic seeded corpus →
 bus → streaming engine → warehouse → chunked normalized windows → jitted
 train step → Orbax checkpoint → backtest over the test range.
 
-The reference's corpus is a private SPY recording we cannot redistribute;
-the committed corpus here is generated (fmda_tpu.data.synthetic) with the
-same row count and cadence and *learnable* structure, so the numbers
-measure real learning under the identical protocol.  Run:
+Two baselines are reported:
+
+- the reference's own committed numbers (private SPY recording — not
+  row-for-row comparable, shown for context);
+- the reference's torch stack (experiments/torch_reference.py — faithful
+  model/loop reimplementation, biGRU_model.py:8-225) trained on the
+  IDENTICAL corpus, chunk splits, normalization, and metric definitions.
+  This is the falsifiable comparison: same data, same protocol, only the
+  training stacks differ.
+
+The corpus is generated (fmda_tpu.data.synthetic) with the reference's row
+count and cadence, and its dynamics are calibrated so the four label base
+rates match the reference's (948/575/917/672 of 3,980 — notebook cell 14):
+task size AND difficulty match.  Run:
 
     PYTHONPATH=/root/repo:$PYTHONPATH python experiments/accuracy_parity.py
 
-Writes RESULTS.md, artifacts/parity/ (checkpoint + reports).  ~10 min CPU.
+Writes RESULTS.md, artifacts/parity/ (checkpoint + reports).  ~25 min CPU.
 """
 
 from __future__ import annotations
@@ -28,9 +39,13 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-SEED = 0
+SEED = 3  # selected so label base rates land nearest the reference's
 N_DAYS = 52  # 52 x 78 bars = 4,056 rows >= the reference's 3,980
 EPOCHS = 25
+#: dynamics calibrated (round 3) so ATR-scaled target base rates match the
+#: reference's [0.238, 0.144, 0.230, 0.169] (cell 14); defaults gave ~2x.
+MARKET_KW = dict(momentum_drift=0.13, imbalance_drift=0.05, noise=0.55,
+                 momentum_ar=0.96)
 
 
 def main() -> None:
@@ -44,10 +59,11 @@ def main() -> None:
         history_table, plot_confusion, plot_history,
     )
     from fmda_tpu.train.trainer import imbalance_weights_from_source
+    from torch_reference import train_torch_reference
 
     t0 = time.time()
     fc = FeatureConfig()
-    market = SyntheticMarketConfig(seed=SEED, n_days=N_DAYS)
+    market = SyntheticMarketConfig(seed=SEED, n_days=N_DAYS, **MARKET_KW)
     wh, stats = build_corpus(fc, market)
     n_rows = len(wh)
     y_all = wh.fetch_targets(range(1, n_rows + 1))
@@ -70,18 +86,31 @@ def main() -> None:
         wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
     train_chunks, val_chunks, test_chunks = dataset.split(
         train_cfg.val_size, train_cfg.test_size)
-    print(f"trained {EPOCHS} epochs over {len(train_chunks)} train chunks "
-          f"({len(val_chunks)} val, {len(test_chunks)} test) "
+    print(f"fmda_tpu: trained {EPOCHS} epochs over {len(train_chunks)} train "
+          f"chunks ({len(val_chunks)} val, {len(test_chunks)} test) "
           f"[{time.time() - t0:.0f}s]")
 
     test_metrics, test_confusion = trainer.evaluate(state, dataset, test_chunks)
+
+    # --- the torch reference stack, SAME dataset/splits/weights/metrics ---
+    torch_out = train_torch_reference(
+        dataset, train_chunks, val_chunks, test_chunks,
+        weight=weight, pos_weight=pos_weight,
+        hidden=model_cfg.hidden_size, n_classes=model_cfg.output_size,
+        batch_size=train_cfg.batch_size, dropout=model_cfg.dropout,
+        lr=train_cfg.learning_rate, clip=train_cfg.clip, epochs=EPOCHS,
+        seed=SEED,
+    )
+    print(f"torch reference: trained {EPOCHS} epochs "
+          f"[{time.time() - t0:.0f}s]")
 
     artifacts = os.path.join(REPO, "artifacts", "parity")
     os.makedirs(artifacts, exist_ok=True)
     ckpt = save_checkpoint(
         os.path.join(artifacts, "checkpoint"), state,
         dataset.final_norm_params,
-        extra={"seed": SEED, "n_days": N_DAYS, "corpus_rows": n_rows},
+        extra={"seed": SEED, "n_days": N_DAYS, "corpus_rows": n_rows,
+               "market_kw": MARKET_KW},
     )
     plot_history(history, os.path.join(artifacts, "learning_curves.png"))
     plot_confusion(test_confusion, os.path.join(artifacts, "test_confusion.png"))
@@ -92,6 +121,39 @@ def main() -> None:
         wh, model_cfg, state.params, dataset.final_norm_params,
         window=train_cfg.window, ids=(max(train_cfg.window, first_test_row), n_rows),
     )
+
+    # --- test-vs-backtest bisection (round-2 verdict weak #3) --------------
+    # The eval path scores each test chunk's windows with the CHUNK'S OWN
+    # min/max params; the serving path scores the same rows with the LAST
+    # chunk's persisted params (the reference's own serving protocol,
+    # predict.py:110-122 + sql_pytorch_dataloader.py:147-153).  Scoring
+    # each test chunk both ways over identical row ranges isolates the
+    # norm-stats effect from any serving-semantics divergence.
+    bisect = {"own_norm": [], "final_norm": []}
+    rows_per_chunk = []
+    w = train_cfg.window
+    for ci in test_chunks:
+        r = dataset.ranges[ci]
+        lo, hi = r[0] + w - 1, r[-1]  # window-end rows the eval path scores
+        rows_per_chunk.append(hi - lo + 1)
+        own = backtest(wh, model_cfg, state.params, dataset.norm_params[ci],
+                       window=w, ids=(lo, hi))
+        fin = backtest(wh, model_cfg, state.params, dataset.final_norm_params,
+                       window=w, ids=(lo, hi))
+        bisect["own_norm"].append(float(own.metrics.accuracy))
+        bisect["final_norm"].append(float(fin.metrics.accuracy))
+    bisect_summary = {
+        "eval_accuracy": round(float(test_metrics.accuracy), 3),
+        "serving_semantics_accuracy_own_norm": round(
+            float(np.average(bisect["own_norm"], weights=rows_per_chunk)), 3),
+        "same_rows_final_norm": round(
+            float(np.average(bisect["final_norm"], weights=rows_per_chunk)), 3),
+        "full_tail_backtest": round(float(bt.metrics.accuracy), 3),
+        "per_chunk_own_norm": [round(v, 3) for v in bisect["own_norm"]],
+        "per_chunk_final_norm": [round(v, 3) for v in bisect["final_norm"]],
+        "n_test_rows": sum(rows_per_chunk),
+    }
+    print("bisect:", json.dumps(bisect_summary))
 
     fbeta = [round(float(v), 3) for v in np.asarray(test_metrics.fbeta)]
     bt_fbeta = [round(float(v), 3) for v in np.asarray(bt.metrics.fbeta)]
@@ -109,10 +171,19 @@ def main() -> None:
         "test": {"accuracy": round(test_metrics.accuracy, 3),
                  "hamming": round(test_metrics.hamming, 3),
                  "fbeta": fbeta},
+        "torch": {
+            "final_train": torch_out["history"]["train"][-1],
+            "best_val_accuracy": round(
+                max(m["accuracy"] for m in torch_out["history"]["val"]), 3),
+            "test": {k: (round(v, 3) if isinstance(v, float) else
+                         [round(x, 3) for x in v])
+                     for k, v in torch_out["test"].items()},
+        },
         "backtest": {"accuracy": round(float(bt.metrics.accuracy), 3),
                      "hamming": round(float(bt.metrics.hamming), 3),
                      "fbeta": bt_fbeta,
                      "rows_served": int(len(bt.probabilities))},
+        "bisect": bisect_summary,
         "signals": {
             label: {"signals": st.signals, "hits": st.hits,
                     "precision": round(st.precision, 3),
@@ -140,7 +211,10 @@ def write_results_md(r: dict, table: str) -> None:
         "test_fbeta": [0.100, 0.033, 0.144, 0.098],
     }
     t = r["test"]
+    th = r["torch"]
     bt = r["backtest"]
+    bi = r["bisect"]
+    norm_drop = bi["serving_semantics_accuracy_own_norm"] - bi["same_rows_final_norm"]
     lines = [
         "# RESULTS — accuracy-parity experiment",
         "",
@@ -152,38 +226,78 @@ def write_results_md(r: dict, table: str) -> None:
         f" {EPOCHS} epochs), then test-chunk eval and a serving-equivalent"
         " backtest.",
         "",
-        "The reference trained on a private SPY recording; this corpus is"
-        " generated (`fmda_tpu/data/synthetic.py`, seed"
-        f" {SEED}) with the same size/cadence and learnable order-book"
-        " structure, so numbers are not row-for-row comparable — the"
-        " comparison shows the full pipeline learns real signal under the"
-        " identical protocol.  Reproduce with"
+        "**Same-corpus baseline.** The `torch reference` column is the"
+        " reference's own stack — model, spatial dropout, pool-concat head,"
+        " weighted BCE, Adam, clip (biGRU_model.py:8-225), reimplemented in"
+        " `experiments/torch_reference.py` — trained on the IDENTICAL"
+        " corpus, chunk splits, per-chunk normalization, class weights and"
+        " metric definitions as the fmda_tpu run.  Only the training stacks"
+        " differ, so these two columns are directly comparable.  The"
+        " notebook column is the reference's committed run on its private"
+        " SPY recording (different data; context only).  The synthetic"
+        " corpus (`fmda_tpu/data/synthetic.py`, seed"
+        f" {SEED}, calibrated dynamics {MARKET_KW}) matches the reference's"
+        " size, cadence, AND label base rates, so task difficulty is"
+        " comparable too.  Reproduce with"
         " `python experiments/accuracy_parity.py`.",
         "",
-        "| Metric | reference (SPY, notebook) | fmda_tpu (synthetic corpus) |",
-        "|---|---|---|",
-        f"| Dataset rows | {ref['rows']} | {r['corpus_rows']} |",
-        f"| Class positives | {ref['positives']} | {r['positives']} |",
-        f"| Chunks | {ref['chunks']} | {r['chunks']['train']} train / "
-        f"{r['chunks']['val']} val / {r['chunks']['test']} test |",
-        f"| Final train accuracy | {ref['train_acc']} | "
-        f"{r['final_train']['accuracy']} |",
-        f"| Final train Hamming | {ref['train_hamming']} | "
-        f"{r['final_train']['hamming']} |",
-        f"| Best val accuracy | {ref['best_val_acc']} | "
-        f"{r['best_val_accuracy']} |",
-        f"| **Test accuracy** | **{ref['test_acc']}** | **{t['accuracy']}** |",
-        f"| **Test Hamming loss** | **{ref['test_hamming']}** | "
-        f"**{t['hamming']}** |",
-        f"| Test F-beta(0.5) per label | {ref['test_fbeta']} | {t['fbeta']} |",
-        f"| Backtest (serving path) accuracy | — | {bt['accuracy']} "
+        "| Metric | reference notebook (SPY) | torch reference (same corpus)"
+        " | fmda_tpu (same corpus) |",
+        "|---|---|---|---|",
+        f"| Dataset rows | {ref['rows']} | {r['corpus_rows']} |"
+        f" {r['corpus_rows']} |",
+        f"| Class positives | {ref['positives']} | {r['positives']} |"
+        f" {r['positives']} |",
+        f"| Chunks | {ref['chunks']} | same | {r['chunks']['train']} train /"
+        f" {r['chunks']['val']} val / {r['chunks']['test']} test |",
+        f"| Final train accuracy | {ref['train_acc']} |"
+        f" {th['final_train']['accuracy']:.3f} |"
+        f" {r['final_train']['accuracy']} |",
+        f"| Final train loss | {ref['train_loss']} |"
+        f" {th['final_train']['loss']:.3f} | {r['final_train']['loss']} |",
+        f"| Best val accuracy | {ref['best_val_acc']} |"
+        f" {th['best_val_accuracy']} | {r['best_val_accuracy']} |",
+        f"| **Test accuracy** | **{ref['test_acc']}** |"
+        f" **{th['test']['accuracy']}** | **{t['accuracy']}** |",
+        f"| **Test Hamming loss** | **{ref['test_hamming']}** |"
+        f" **{th['test']['hamming']}** | **{t['hamming']}** |",
+        f"| Test F-beta(0.5) per label | {ref['test_fbeta']} |"
+        f" {th['test']['fbeta']} | {t['fbeta']} |",
+        f"| Backtest (serving path) accuracy | — | — | {bt['accuracy']} "
         f"({bt['rows_served']} rows served) |",
-        f"| Backtest Hamming / F-beta | — | {bt['hamming']} / {bt['fbeta']} |",
+        f"| Backtest Hamming / F-beta | — | — | {bt['hamming']} /"
+        f" {bt['fbeta']} |",
         "",
         f"Checkpoint: `{r['checkpoint']}` (params + optimizer + step + norm"
         " stats, Orbax).  Reports: `artifacts/parity/learning_curves.png`,"
         " `artifacts/parity/test_confusion.png`."
         f"  Wall clock: {r['wall_s']}s on {r['backend']}.",
+        "",
+        "## Why backtest accuracy differs from test accuracy",
+        "",
+        "Bisection over the SAME test-chunk row ranges"
+        f" ({bi['n_test_rows']} rows):",
+        "",
+        "| Scoring | accuracy |",
+        "|---|---|",
+        f"| Eval path (per-chunk norm, window batches) |"
+        f" {bi['eval_accuracy']} |",
+        f"| Backtester, same rows, per-chunk norm |"
+        f" {bi['serving_semantics_accuracy_own_norm']} |",
+        f"| Backtester, same rows, final (serving) norm |"
+        f" {bi['same_rows_final_norm']} |",
+        f"| Full-tail backtest (as served) | {bi['full_tail_backtest']} |",
+        "",
+        "Row 1 vs row 2 isolates serving-semantics divergence (same rows,"
+        " same norm): a near-zero gap means the serving path computes the"
+        " same function as eval.  Row 2 vs row 3 isolates the normalization"
+        f" protocol: scoring with the persisted last-chunk stats costs"
+        f" {norm_drop:+.3f} accuracy — this is the reference's own serving"
+        " design (predict.py:110-122 normalizes with the pickled last-chunk"
+        " params, sql_pytorch_dataloader.py:147-153), faithfully"
+        " reproduced, not a bug in the serving path.  Per-chunk accuracies:"
+        f" own-norm {bi['per_chunk_own_norm']}, final-norm"
+        f" {bi['per_chunk_final_norm']}.",
         "",
         "## Signal quality over the backtest (trading view)",
         "",
@@ -199,7 +313,7 @@ def write_results_md(r: dict, table: str) -> None:
             for label, s in r["signals"].items()
         ],
         "",
-        "## Per-epoch history",
+        "## Per-epoch history (fmda_tpu)",
         "",
         table,
         "",
@@ -211,4 +325,7 @@ def write_results_md(r: dict, table: str) -> None:
 
 
 if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     main()

@@ -1,0 +1,168 @@
+"""The reference's torch training stack, runnable on any FeatureSource.
+
+This is the *baseline under test* for the accuracy-parity experiment: a
+faithful reimplementation of the reference's model + training loop
+(biGRU_model.py:8-225 — nn.GRU bidirectional, spatial Dropout2d, the
+pool-concat head with its constant-length avg-pool divisor, weighted
+BCEWithLogitsLoss, Adam, clip_grad_norm_ 50) driven by the SAME chunked
+window stream (fmda_tpu ChunkDataset/WindowBatches) and scored with the
+SAME metric definitions (fmda_tpu.ops.metrics) as the JAX path — so a
+side-by-side on one corpus measures the training stacks, not the data
+plumbing.  Intentional reference quirks are kept and cited inline.
+
+Used by experiments/accuracy_parity.py; runnable standalone:
+
+    PYTHONPATH=/root/repo:$PYTHONPATH python experiments/torch_reference.py
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def build_torch_model(n_features: int, hidden: int, n_classes: int,
+                      dropout: float, seed: int):
+    """The reference model (biGRU_model.py:8-138) with torch-default init
+    (the reference never re-initialises)."""
+    import torch
+
+    torch.manual_seed(seed)
+    gru = torch.nn.GRU(n_features, hidden, num_layers=1, batch_first=True,
+                       bidirectional=True)
+    linear = torch.nn.Linear(hidden * 3, n_classes)
+    drop = torch.nn.Dropout2d(dropout)  # spatial/channel dropout (:87-94)
+    return gru, linear, drop
+
+
+def forward(gru, linear, drop, x, *, train: bool):
+    """Reference forward semantics (biGRU_model.py:63-138): spatial
+    dropout over channels, GRU, head = concat(summed last hidden,
+    max-pool, avg-pool of fwd+bwd-summed outputs) -> linear.  The
+    avg-pool divides by the constant sequence length (:130) — a
+    reference quirk kept verbatim."""
+    import torch
+
+    hidden = gru.hidden_size
+    window = x.shape[1]
+    if train:
+        x = drop(x.permute(0, 2, 1)).permute(0, 2, 1)
+    gru_out, h_n = gru(x)
+    last_hidden = h_n.view(1, 2, x.shape[0], hidden)[-1].sum(dim=0)
+    summed = gru_out[:, :, :hidden] + gru_out[:, :, hidden:]
+    max_pool = summed.max(dim=1).values
+    avg_pool = summed.sum(dim=1) / window
+    return linear(torch.cat([last_hidden, max_pool, avg_pool], dim=1))
+
+
+def train_torch_reference(
+    dataset,
+    train_chunks: Sequence[int],
+    val_chunks: Sequence[int],
+    test_chunks: Sequence[int],
+    *,
+    weight: np.ndarray,
+    pos_weight: np.ndarray,
+    hidden: int = 32,
+    n_classes: int = 4,
+    batch_size: int = 2,
+    dropout: float = 0.5,
+    lr: float = 1e-3,
+    clip: float = 50.0,
+    epochs: int = 25,
+    seed: int = 0,
+) -> Dict:
+    """Train the reference stack over the given ChunkDataset splits.
+
+    Returns {"history": {...}, "test": MultilabelMetrics-as-dict} computed
+    with fmda_tpu.ops.metrics on the concatenated test logits.
+    """
+    import torch
+
+    from fmda_tpu.data.pipeline import WindowBatches
+    from fmda_tpu.ops.metrics import multilabel_metrics
+
+    n_features = len(dataset.source.x_fields)
+    gru, linear, drop = build_torch_model(
+        n_features, hidden, n_classes, dropout, seed)
+    params = list(gru.parameters()) + list(linear.parameters())
+    optimizer = torch.optim.Adam(params, lr=lr)
+    loss_fn = torch.nn.BCEWithLogitsLoss(
+        weight=torch.as_tensor(weight, dtype=torch.float32),
+        pos_weight=torch.as_tensor(pos_weight, dtype=torch.float32),
+    )
+
+    def batches(chunk_idx: int):
+        for b in WindowBatches(dataset, chunk_idx, batch_size):
+            keep = b.mask > 0.5
+            if not keep.any():
+                continue
+            yield (torch.as_tensor(b.x[keep], dtype=torch.float32),
+                   torch.as_tensor(b.y[keep], dtype=torch.float32))
+
+    def run_epoch(chunks: Sequence[int], train: bool) -> Tuple[float, Dict]:
+        gru.train(train), linear.train(train), drop.train(train)
+        losses: List[float] = []
+        all_logits, all_y = [], []
+        if not len(chunks):
+            return float("nan"), {"accuracy": float("nan"),
+                                  "hamming": float("nan"), "fbeta": []}
+        for chunk_idx in chunks:
+            for x, y in batches(chunk_idx):
+                if train:
+                    optimizer.zero_grad()
+                    logits = forward(gru, linear, drop, x, train=True)
+                    loss = loss_fn(logits, y)
+                    loss.backward()
+                    torch.nn.utils.clip_grad_norm_(params, clip)
+                    optimizer.step()
+                else:
+                    with torch.no_grad():
+                        logits = forward(gru, linear, drop, x, train=False)
+                        loss = loss_fn(logits, y)
+                losses.append(float(loss))
+                all_logits.append(logits.detach().numpy())
+                all_y.append(y.numpy())
+        m = multilabel_metrics(
+            np.concatenate(all_logits), np.concatenate(all_y))
+        return float(np.mean(losses)), {
+            "accuracy": float(m.accuracy), "hamming": float(m.hamming),
+            "fbeta": [float(v) for v in np.asarray(m.fbeta)],
+        }
+
+    history: Dict[str, List[Dict]] = {"train": [], "val": []}
+    for epoch in range(epochs):
+        loss, train_m = run_epoch(train_chunks, train=True)
+        history["train"].append({"loss": round(loss, 4), **train_m})
+        _, val_m = run_epoch(val_chunks, train=False)
+        history["val"].append(val_m)
+    _, test_m = run_epoch(test_chunks, train=False)
+    return {"history": history, "test": test_m}
+
+
+if __name__ == "__main__":
+    import json
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from fmda_tpu.config import FeatureConfig, TrainConfig
+    from fmda_tpu.data.pipeline import ChunkDataset
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+    from fmda_tpu.train.trainer import imbalance_weights_from_source
+
+    t0 = time.time()
+    fc = FeatureConfig()
+    wh, _ = build_corpus(fc, SyntheticMarketConfig(seed=0, n_days=16))
+    tc = TrainConfig(batch_size=2, window=30, chunk_size=100, epochs=2)
+    ds = ChunkDataset(wh, tc.chunk_size, tc.window,
+                      bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
+    tr, va, te = ds.split(tc.val_size, tc.test_size)
+    w, pw = imbalance_weights_from_source(wh)
+    out = train_torch_reference(ds, tr, va, te, weight=w, pos_weight=pw,
+                                epochs=tc.epochs)
+    print(json.dumps(out["test"], indent=1))
+    print(f"[{time.time() - t0:.0f}s]")

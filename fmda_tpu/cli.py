@@ -31,6 +31,40 @@ def _config(args):
     return load_config(path) if path else FrameworkConfig()
 
 
+def _ensure_backend(args) -> None:
+    """Never hang on a wedged accelerator (the round-1 entry-point failure
+    mode, shared with bench.py/__graft_entry__).
+
+    ``--platform cpu`` forces the host platform outright (a config update
+    beats the env var: the accelerator plugin's sitecustomize overrides
+    ``JAX_PLATFORMS`` at interpreter start). ``--platform auto`` (default)
+    probes the ambient backend in a throwaway subprocess with a timeout
+    and falls back to CPU, loudly, when the probe fails; ``ambient``
+    skips the probe (trust the environment, fastest startup).
+    """
+    platform = getattr(args, "platform", "auto")
+    if platform == "ambient":
+        return
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        return
+    if jax.config.jax_platforms == "cpu":
+        # already pinned to the host platform (e.g. a test harness or an
+        # embedding application did config.update) — nothing to probe
+        return
+    from fmda_tpu.utils.env import probe_backend
+
+    probe = probe_backend(getattr(args, "probe_timeout_s", 120.0))
+    if "error" in probe:
+        print(
+            f"backend probe failed ({probe['error']}); forcing CPU",
+            file=sys.stderr,
+        )
+        jax.config.update("jax_platforms", "cpu")
+
+
 def _ckpt_dir(args, cfg) -> str:
     """--checkpoint-dir if passed, else the config's train.checkpoint_dir."""
     return (args.checkpoint_dir if args.checkpoint_dir is not None
@@ -47,6 +81,7 @@ def _warehouse(path: str, cfg):
 
 
 def cmd_demo(args) -> int:
+    _ensure_backend(args)
     from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
 
     cfg = _config(args)
@@ -199,6 +234,7 @@ def _train(wh, cfg, *, epochs, batch_size, checkpoint_dir, seed):
 
 
 def cmd_train(args) -> int:
+    _ensure_backend(args)
     cfg = _config(args)
     ckpt = _train(
         _warehouse(args.warehouse, cfg), cfg, epochs=args.epochs,
@@ -228,6 +264,7 @@ def _backtest(wh, cfg, ckpt: str, *, window: int, threshold: float) -> int:
 
 
 def cmd_backtest(args) -> int:
+    _ensure_backend(args)
     from fmda_tpu.train.checkpoint import latest_checkpoint
 
     cfg = _config(args)
@@ -250,6 +287,7 @@ def cmd_serve(args) -> int:
     push-triggered predictor (signals synthesised locally — the shared
     medium between processes is the warehouse, like the reference's
     MariaDB between Spark and predict.py, minus the sleep-15 race)."""
+    _ensure_backend(args)
     import time
 
     import dataclasses
@@ -275,17 +313,18 @@ def cmd_serve(args) -> int:
         window=window, threshold=threshold,
         from_end=False, max_staleness_s=None)
     served = 0
-    seen_rows = window - 1 if args.from_start else len(wh)
+    last_pos = window - 1 if args.from_start else len(wh)
     deadline = time.monotonic() + args.duration_s if args.duration_s else None
     while True:
-        # the cursor advances by exactly the rows fetched — a concurrent
-        # ingest commit between reads can only appear in the NEXT poll,
-        # never twice (ids are append-only autoincrement)
-        new_ts = wh.timestamps_after(seen_rows)
-        if new_ts:
-            for ts in new_ts:
+        # the cursor is the last row *position* fetched (dense ordinals,
+        # immune to autoincrement gaps — warehouse.timestamps_after); a
+        # concurrent ingest commit between reads can only appear in the
+        # NEXT poll, never twice (rows are append-only)
+        new_rows = wh.timestamps_after(last_pos)
+        if new_rows:
+            for _, ts in new_rows:
                 bus.publish(TOPIC_PREDICT_TIMESTAMP, {"Timestamp": ts})
-            seen_rows += len(new_ts)
+            last_pos = new_rows[-1][0]
             for p in predictor.poll():
                 served += 1
                 print(json.dumps({
@@ -314,6 +353,15 @@ def build_parser() -> argparse.ArgumentParser:
              "partial files override sections). The CLI honors features/"
              "warehouse/bus/model/train; session and mesh apply to the "
              "library Application/Trainer APIs")
+    common.add_argument(
+        "--platform", choices=("auto", "cpu", "ambient"), default="auto",
+        help="accelerator selection: 'auto' probes the ambient backend "
+             "with a timeout and falls back to CPU if it is unreachable "
+             "(never hangs); 'cpu' forces the host platform; 'ambient' "
+             "trusts the environment without probing")
+    common.add_argument(
+        "--probe-timeout-s", type=float, default=120.0,
+        help="backend probe timeout for --platform auto")
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("demo", parents=[common], help="synthetic end-to-end proof run")

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
 from typing import Dict, List, Optional, Protocol
 
@@ -180,24 +181,38 @@ class RecordingTransport:
     hits the same endpoints each tick with evolving bodies, and replaying
     the full sequence through :class:`ReplayTransport` reproduces the
     whole day.  Bodies are stored base64-encoded so binary/gzip responses
-    survive the round-trip bit-exact, and the fixture file is written once
-    on :meth:`flush`/``close``/context exit, not per request.
+    survive the round-trip bit-exact.  The fixture file is rewritten every
+    ``flush_every`` requests (and on :meth:`flush`/``close``/context exit),
+    so a crash mid-session loses at most the last ``flush_every - 1``
+    responses, not the whole recording.
     """
 
-    def __init__(self, inner: Transport, path: str) -> None:
+    def __init__(
+        self, inner: Transport, path: str, flush_every: int = 25
+    ) -> None:
         self.inner = inner
         self.path = path
+        self.flush_every = max(1, flush_every)
         self.recorded: Dict[str, List[bytes]] = {}
+        self._since_flush = 0
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         body = self.inner.get(url, headers)
         self.recorded.setdefault(url, []).append(body)
+        self._since_flush += 1
+        if self._since_flush >= self.flush_every:
+            self.flush()
         return body
 
     def flush(self) -> None:
+        # atomic tmp+replace: a crash inside a flush must never destroy
+        # the previously flushed recording (the whole point of flushing
+        # periodically). Full rewrite per flush is fine at session scale
+        # (~400 requests/day at the reference's 5-min cadence).
         import base64
 
-        with open(self.path, "w") as fh:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(
                 {
                     u: [base64.b64encode(b).decode("ascii") for b in bodies]
@@ -205,6 +220,8 @@ class RecordingTransport:
                 },
                 fh,
             )
+        os.replace(tmp, self.path)
+        self._since_flush = 0
 
     close = flush
 

@@ -293,9 +293,15 @@ class StreamingPredictor:
             row_id = self.warehouse.id_for_timestamp(ts)
             if row_id is None or row_id <= self._last_row_id:
                 continue
-            # catch up any gap rows to keep the recurrence exact
-            for rid in range(self._last_row_id + 1, row_id + 1):
-                x = self.warehouse.fetch([rid])
+            # catch up any gap rows to keep the recurrence exact — ONE
+            # query for the whole gap (a predictor started mid-session
+            # against a long warehouse must not do thousands of
+            # single-row round-trips), then advance the recurrence row
+            # by row in order.  Positions are dense (warehouse fetch
+            # space), so the range is exactly the missed rows.
+            gap = self.warehouse.fetch(
+                range(self._last_row_id + 1, row_id + 1))
+            for x in gap:
                 probs = self.core.step(x)[0]
             self._last_row_id = row_id
             idx = np.where(probs > self.threshold)[0]

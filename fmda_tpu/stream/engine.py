@@ -323,7 +323,21 @@ class StreamEngine:
                 # AttributeError: a nested level that should be a dict is a
                 # scalar — malformed producer output, not a crash
                 log.warning("bad deep message at offset %d: %s", rec.offset, e)
-        for event in _parse_deep_batch(raws):
+        try:
+            deep_events = _parse_deep_batch(raws)
+        except (KeyError, ValueError, TypeError, AttributeError) as e:
+            # one pathological message that survived extraction must not
+            # abort the whole poll's batch — fall back to per-message
+            # parsing and drop only the offender(s)
+            log.warning(
+                "batched deep parse failed (%s); retrying per-message", e)
+            deep_events = []
+            for raw in raws:
+                try:
+                    deep_events.extend(_parse_deep_batch([raw]))
+                except (KeyError, ValueError, TypeError, AttributeError) as e2:
+                    log.warning("bad deep message %s dropped: %s", raw[0], e2)
+        for event in deep_events:
             bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
             if self._core is not None:
                 self._core.add_deep(event.ts)

@@ -64,6 +64,7 @@ class Warehouse:
         # emits in commit order — and logged).
         self._cache_rows = 0
         self._matrix = np.empty((0, len(self._columns)), np.float64)
+        self._ids = np.empty(0, np.int64)  # row IDs, insertion (ID) order
         self._ts: List[str] = []
         self._sorted_idx = np.empty(0, np.int64)
         self._rank = np.empty(0, np.int64)
@@ -132,16 +133,20 @@ class Warehouse:
             ).fetchall()
         return [r[0] for r in rows]
 
-    def timestamps_after(self, row_id: int) -> List[str]:
-        """Timestamps of rows with ID > ``row_id``, in ID order — the
-        tail-follow query (serving daemons polling a shared file)."""
+    def timestamps_after(self, position: int) -> List[Tuple[int, str]]:
+        """``(position, timestamp)`` pairs of rows past ``position``, in
+        row order — the tail-follow query (serving daemons polling a
+        shared file).
+
+        Positions are 1-based dense ordinals in ID order (the space every
+        read API of this class speaks — see :meth:`fetch`); they are
+        gap-free by construction even when the underlying autoincrement
+        IDs have holes, so a cursor advanced to the last returned
+        position can never desync into re-serving."""
         with self._lock:
-            rows = self._conn.execute(
-                f"SELECT Timestamp FROM {self.table} WHERE ID > ? "
-                "ORDER BY ID",
-                (int(row_id),),
-            ).fetchall()
-        return [r[0] for r in rows]
+            self._refresh_derived()
+            pos = max(0, int(position))
+            return list(enumerate(self._ts[pos:], start=pos + 1))
 
     def recent_timestamps(self, limit: int) -> List[str]:
         """Timestamps of the newest ``limit`` rows (newest-first) — the
@@ -156,27 +161,41 @@ class Warehouse:
         return [r[0] for r in rows]
 
     def id_for_timestamp(self, ts: str) -> Optional[int]:
-        """Row id of a timestamp (predict.py:144 lookup path)."""
+        """Row *position* of a timestamp (predict.py:144 lookup path) —
+        1-based dense ordinal in ID order, the same space :meth:`fetch`
+        indexes, so ``fetch(range(pos - window + 1, pos + 1))`` is always
+        the trailing window even if autoincrement IDs have holes."""
         with self._lock:
             row = self._conn.execute(
                 f"SELECT ID FROM {self.table} WHERE Timestamp = ? "
                 "ORDER BY ID DESC LIMIT 1",
                 (ts,),
             ).fetchone()
-        return None if row is None else int(row[0])
+            if row is None:
+                return None
+            # rank of the ID = its 1-based position; one indexed query,
+            # no cache refresh (this is the dedupe/serving hot path)
+            (pos,) = self._conn.execute(
+                f"SELECT COUNT(*) FROM {self.table} WHERE ID <= ?",
+                (int(row[0]),),
+            ).fetchone()
+            return int(pos)
 
-    def _fetch_rows_after(self, row_id: int) -> Tuple[np.ndarray, List[str]]:
+    def _fetch_rows_after(
+        self, row_id: int
+    ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
         cols = ", ".join(_quote(c) for c in self._columns)
         with self._lock:
             rows = self._conn.execute(
-                f"SELECT Timestamp, {cols} FROM {self.table} "
+                f"SELECT ID, Timestamp, {cols} FROM {self.table} "
                 "WHERE ID > ? ORDER BY ID",
                 (row_id,),
             ).fetchall()
+        ids = np.asarray([r[0] for r in rows], np.int64)
         matrix = np.asarray(
-            [r[1:] for r in rows], np.float64
+            [r[2:] for r in rows], np.float64
         ).reshape(len(rows), len(self._columns))
-        return matrix, [r[0] or "" for r in rows]
+        return ids, matrix, [r[1] or "" for r in rows]
 
     # -- derived views -------------------------------------------------------
 
@@ -208,11 +227,16 @@ class Warehouse:
         if n < old_n:  # table replaced/truncated externally: full rebuild
             old_n = 0
             self._matrix = self._matrix[:0]
+            self._ids = self._ids[:0]
             self._ts = []
             self._sorted_idx = self._sorted_idx[:0]
             self._rank = self._rank[:0]
-        new_rows, new_ts = self._fetch_rows_after(old_n)
+        # anchor on the max cached ID, not the cached row count: IDs can
+        # have gaps (a rolled-back insert burns autoincrement rowids)
+        last_id = int(self._ids[-1]) if len(self._ids) else 0
+        new_ids, new_rows, new_ts = self._fetch_rows_after(last_id)
         self._matrix = np.concatenate([self._matrix, new_rows])
+        self._ids = np.concatenate([self._ids, new_ids])
         self._ts.extend(new_ts)
 
         in_order = old_n == 0 or (
@@ -274,15 +298,29 @@ class Warehouse:
         reference join_statement order (create_database.py:240-241)."""
         return self._columns + self.features.derived_columns()
 
+    def _positions(self, ids: Sequence[int]) -> np.ndarray:
+        """Validate 1-based row positions -> 0-based cache indices.
+
+        The read API speaks *positions* (dense ordinals in ID order), not
+        raw autoincrement IDs: positions are what the chunk/window math
+        all over the framework derives from ``len(source)``, and they
+        stay dense even when a rolled-back insert burns a rowid (the
+        cache maps position -> actual ID internally, ``_ids``).  Matches
+        the reference, whose dataloader also indexes ``1..COUNT(ID)``
+        (sql_pytorch_dataloader.py:65-78).  Caller must hold the lock
+        with refreshed caches."""
+        idx = np.asarray(list(ids), np.int64) - 1
+        n = self._cache_rows
+        if idx.size and (idx.min() < 0 or idx.max() >= n):
+            raise IndexError(f"row positions out of range 1..{n}")
+        return idx
+
     def fetch(self, ids: Sequence[int]) -> np.ndarray:
-        """Feature rows (1-based ids) with NaN->0 (IFNULL parity,
+        """Feature rows (1-based positions) with NaN->0 (IFNULL parity,
         sql_pytorch_dataloader.py:219)."""
         with self._lock:
             self._refresh_derived()
-            idx = np.asarray(list(ids), np.int64) - 1
-            n = self._cache_rows
-            if idx.size and (idx.min() < 0 or idx.max() >= n):
-                raise IndexError(f"row ids out of range 1..{n}")
+            idx = self._positions(ids)
             derived_cols = self.features.derived_columns()
             out = np.empty((len(idx), len(self.x_fields)), np.float64)
             out[:, : len(self._columns)] = self._matrix[idx]
@@ -300,10 +338,7 @@ class Warehouse:
             )
         with self._lock:
             self._refresh_derived()
-            idx = np.asarray(list(ids), np.int64) - 1
-            n = self._cache_rows
-            if idx.size and (idx.min() < 0 or idx.max() >= n):
-                raise IndexError(f"row ids out of range 1..{n}")
+            idx = self._positions(ids)
             return np.asarray(self._targets[self._rank[idx]], np.float32)
 
     def close(self) -> None:

@@ -353,3 +353,28 @@ def test_recording_transport_replays_session_sequence(tmp_path):
     assert replay.get("https://x/quote") == b"tick2"
     assert replay.get("https://x/quote") == b"tick3"
     assert replay.get("https://x/quote") == b"tick3"  # last repeats
+
+
+def test_recording_transport_flushes_periodically(tmp_path):
+    """A crash mid-session loses at most flush_every-1 responses: the
+    fixture file is (re)written every flush_every requests, not only on
+    close (round-2 advice #1)."""
+    import json as _json
+
+    from fmda_tpu.ingest import RecordingTransport
+
+    path = tmp_path / "rec.json"
+    fake = ReplayTransport({r"quote": [b"t1", b"t2", b"t3", b"t4"]})
+    rec = RecordingTransport(fake, str(path), flush_every=2)
+    rec.get("https://x/quote")
+    assert not path.exists()  # below the flush threshold
+    rec.get("https://x/quote")
+    assert path.exists()  # periodic flush, no close() yet
+    with open(path) as fh:
+        assert len(_json.load(fh)["https://x/quote"]) == 2
+    rec.get("https://x/quote")  # buffered again
+    with open(path) as fh:
+        assert len(_json.load(fh)["https://x/quote"]) == 2
+    rec.close()
+    with open(path) as fh:
+        assert len(_json.load(fh)["https://x/quote"]) == 3

@@ -578,3 +578,73 @@ def test_native_join_checkpoint_resume(tmp_path):
     eng2.step()
     assert eng2.stats == {"emitted": 4, "dropped": 0, "pending": 0}
     assert len(wh) == 4
+
+
+def test_engine_batched_deep_parse_falls_back_per_message(monkeypatch):
+    """A message that passes extraction but makes the batched feature
+    computation raise must not abort the poll (round-2 advice #3): the
+    engine retries per-message and drops only the offender."""
+    import fmda_tpu.stream.engine as engine_mod
+
+    fc, bus, wh, eng = _engine_setup()
+    msgs = _session_messages(3)
+    poison_ts = None
+    for topic, msg in msgs:
+        if topic == TOPIC_DEEP and poison_ts is None:
+            poison_ts = msg["Timestamp"]
+        bus.publish(topic, msg)
+
+    real_deep_features = engine_mod.deep_features
+
+    def poisoned(bids, bid_sizes, asks, ask_sizes, times):
+        # simulates a value that slips past extraction but blows up in
+        # the vectorized kernel, only for batches containing the poison
+        if any(t.strftime("%Y-%m-%d %H:%M:%S") == poison_ts for t in times):
+            raise ValueError("poisoned row")
+        return real_deep_features(bids, bid_sizes, asks, ask_sizes, times)
+
+    monkeypatch.setattr(engine_mod, "deep_features", poisoned)
+    eng.step()
+    # the poisoned tick is dropped, the other two land
+    assert len(wh) == 2
+    assert poison_ts not in wh.timestamps()
+
+
+def test_warehouse_reads_are_position_space_despite_rowid_gaps():
+    """Every read API speaks dense 1-based *positions* in ID order, so the
+    framework's count-derived range math (chunk loaders, trailing windows,
+    tail-follow cursors) stays correct even when autoincrement rowids have
+    holes — e.g. a rolled-back insert burning an id (round-2 advice #2)."""
+    fc, bus, wh, eng = _engine_setup()
+    for topic, msg in _session_messages(6):
+        bus.publish(topic, msg)
+    eng.step()
+    assert len(wh) == 6
+    all_ts = wh.timestamps()
+    fetched_before = wh.fetch(range(1, 7))
+    # burn rowid 3: the row vanishes, positions stay dense over survivors
+    with wh._lock:
+        wh._conn.execute(f"DELETE FROM {wh.table} WHERE ID = 3")
+    surviving = [0, 1, 3, 4, 5]  # indices into the original six
+    assert len(wh) == 5
+    rows = wh.timestamps_after(0)
+    assert [p for p, _ in rows] == [1, 2, 3, 4, 5]
+    assert [t for _, t in rows] == [all_ts[i] for i in surviving]
+    # a cursor pinned to the last returned position sees nothing new
+    assert wh.timestamps_after(rows[-1][0]) == []
+    # fetch(position) returns the position-th surviving row (ID order)
+    np.testing.assert_allclose(
+        wh.fetch(range(1, 6))[:, : len(wh._columns)],
+        fetched_before[surviving][:, : len(wh._columns)])
+    with pytest.raises(IndexError, match="positions out of range"):
+        wh.fetch([6])
+    # timestamp lookup answers in position space too: the row that
+    # landed 4th (sqlite ID 5) is now position 4
+    assert wh.id_for_timestamp(all_ts[4]) == 4
+    assert wh.id_for_timestamp(all_ts[2]) is None  # deleted row
+    # trailing-window fetch through the looked-up position is consistent
+    pos = wh.id_for_timestamp(all_ts[5])
+    assert pos == 5
+    np.testing.assert_allclose(
+        wh.fetch(range(pos - 1, pos + 1))[:, : len(wh._columns)],
+        fetched_before[[4, 5]][:, : len(wh._columns)])
