@@ -41,6 +41,8 @@ _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 if _REPO_DIR not in sys.path:
     sys.path.insert(0, _REPO_DIR)
 
+from fmda_tpu.utils.env import cpu_forced_env  # noqa: E402
+
 BATCH = 256
 WINDOW = 30
 FEATURES = 108
@@ -201,11 +203,79 @@ def phase_longctx() -> dict:
 
 
 def phase_multiticker() -> dict:
-    """North-star 50-ticker batched config: 50 tickers x 16 windows/step."""
-    return _bench_train_step(
-        batch=50 * 16, window=WINDOW, features=FEATURES,
-        use_pallas=True, warmup=2, steps=10,
+    """North-star 50-ticker config at the REAL composition: mixed batches
+    of 16 windows from each of 50 tickers (800 rows/step) composed by
+    MultiTickerDataset.mixed_batches, per-ticker normalization included —
+    not a synthetic monolithic batch."""
+    import jax
+
+    from fmda_tpu.config import ModelConfig, TrainConfig
+    from fmda_tpu.data import ArraySource
+    from fmda_tpu.train.multiticker import MultiTickerDataset
+    from fmda_tpu.train.trainer import Trainer
+
+    n_tickers, per_ticker = 50, 16
+    rows_per_ticker = 260
+    r = np.random.default_rng(0)
+    fields = tuple(f"f{i}" for i in range(FEATURES))
+    sources = {
+        f"T{i:02d}": ArraySource(
+            r.normal(size=(rows_per_ticker, FEATURES)).astype(np.float32),
+            (r.uniform(size=(rows_per_ticker, CLASSES)) > 0.7).astype(
+                np.float32),
+            fields,
+        )
+        for i in range(n_tickers)
+    }
+    mtd = MultiTickerDataset(sources, chunk_size=100, window=WINDOW)
+    train_chunks, _, _ = mtd.splits(0.1, 0.1)
+    round0 = mtd.rounds(train_chunks)[0]
+
+    model_cfg = ModelConfig(
+        hidden_size=HIDDEN, n_features=FEATURES, output_size=CLASSES,
+        dropout=0.5, spatial_dropout=True, use_pallas=True,
     )
+    batch = n_tickers * per_ticker
+    trainer = Trainer(model_cfg, TrainConfig(batch_size=batch, window=WINDOW))
+    state = trainer.init_state(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+
+    # host-side composition cost, measured separately from the step
+    t0 = time.perf_counter()
+    staged = list(mtd.mixed_batches(round0, per_ticker))
+    compose_s = time.perf_counter() - t0
+
+    for b in staged[:2]:
+        state, loss, _ = trainer._train_step(state, b, rng)
+    jax.block_until_ready(loss)
+    steps = 0
+    t0 = time.perf_counter()
+    for _ in range(3):
+        for b in staged:
+            state, loss, _ = trainer._train_step(state, b, rng)
+            steps += 1
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    dev = jax.devices()[0]
+    step_s = elapsed / steps
+    flops = model_flops_per_step(batch, WINDOW, FEATURES, HIDDEN)
+    mfu_est, mfu_peak = _mfu(flops, step_s, dev.device_kind,
+                             jax.default_backend())
+    return {
+        "seq_s": round(batch * steps / elapsed, 1),
+        "step_ms": round(step_s * 1e3, 3),
+        "compose_ms_per_batch": round(compose_s / len(staged) * 1e3, 3),
+        "backend": jax.default_backend(),
+        "device_kind": dev.device_kind,
+        "composition": f"{n_tickers} tickers x {per_ticker} windows, "
+                       "per-ticker norm (MultiTickerDataset.mixed_batches)",
+        "dtype": "float32",
+        "tflops_per_step": round(flops / 1e12, 4),
+        "mfu_est": mfu_est,
+        "mfu_peak": mfu_peak,
+        "shape": {"B": batch, "T": WINDOW, "F": FEATURES, "H": HIDDEN},
+    }
 
 
 def phase_serving() -> dict:
@@ -301,6 +371,87 @@ def phase_torch() -> dict:
         "step_ms": round(elapsed / steps * 1e3, 3),
         "backend": "torch-cpu",
     }
+
+
+def phase_longctx_sp() -> dict:
+    """The long-context config ACTUALLY sequence-sharded (round-2 verdict
+    next #5): full train step at seq=1024 over a (dp=2, sp=4) mesh, remat
+    on, plus the pipelined scan's bubble-filling at M in {1, 2, 4}.
+
+    Runs on the virtual CPU mesh (the phase env forces 8 host devices);
+    under SPMD every device executes every stage, so wall-clock tracks
+    total executed work and the measured M-speedups should match the
+    ``sp*M/(sp+M-1)`` useful-work model within noise.
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    from fmda_tpu.config import FeatureConfig, MeshConfig, ModelConfig
+    from fmda_tpu.models.bigru import BiGRU
+    from fmda_tpu.parallel import build_mesh
+    from fmda_tpu.parallel.sp_train import (
+        make_sp_train_step, shard_train_inputs)
+
+    # batch sized so the M=4 microbatch (batch/dp/M = 8 sequences) stays
+    # compute-bound — the useful-work model assumes scan time ∝ batch,
+    # which breaks when microbatches hit per-step launch overhead
+    dp, sp, seq, batch = 2, 4, 1024, 64
+    features = len(FeatureConfig(bid_levels=10, ask_levels=10).x_fields())
+    devices = jax.devices()
+    if len(devices) < dp * sp:
+        return {"error": f"need {dp * sp} devices, have {len(devices)} "
+                         f"({jax.default_backend()})"}
+    mesh = build_mesh(MeshConfig(dp=dp, sp=sp), devices[: dp * sp])
+    cfg = ModelConfig(
+        hidden_size=HIDDEN, n_features=features, output_size=CLASSES,
+        dropout=0.0, use_pallas=False, remat=True,
+    )
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(0)
+    x_host = r.normal(size=(batch, seq, features)).astype(np.float32)
+    y_host = (r.uniform(size=(batch, CLASSES)) > 0.7).astype(np.float32)
+    model = BiGRU(cfg)
+    params0 = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.asarray(x_host[:1]))["params"]
+    optimizer = optax.chain(optax.clip_by_global_norm(50.0), optax.adam(1e-3))
+
+    out: dict = {
+        "mesh": f"dp={dp} sp={sp}", "remat": True,
+        "shape": {"B": batch, "T": seq, "F": features, "H": HIDDEN},
+    }
+    steps, warmup = 4, 1
+    t_m1 = None
+    for m in (1, 2, 4):
+        step = make_sp_train_step(
+            mesh, cfg, seq, optimizer, n_microbatches=m)
+        opt_state = optimizer.init(params0)
+        x, y, params, opt_state = shard_train_inputs(
+            mesh, x_host, y_host, params0, opt_state)
+        for _ in range(warmup):
+            params_w, opt_w, loss = step(params, opt_state, x, y)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        p, o = params, opt_state
+        for _ in range(steps):
+            p, o, loss = step(p, o, x, y)
+        jax.block_until_ready(loss)
+        step_s = (time.perf_counter() - t0) / steps
+        if m == 1:
+            t_m1 = step_s
+        out[f"M{m}"] = {
+            "step_ms": round(step_s * 1e3, 1),
+            "seq_s": round(batch / step_s, 1),
+            "speedup_vs_M1": round(t_m1 / step_s, 3),
+            # plain (M=1) runs sp full-batch scan stages; pipelined runs
+            # (sp+M-1) stages at batch/M each -> predicted speedup
+            # sp*M/(sp+M-1) over M=1 (the scan only; the projection and
+            # backward dilute it in the full-step number)
+            "model_speedup": round(sp * m / (sp + m - 1), 3),
+            "loss": round(float(loss), 4),
+        }
+    return out
 
 
 def phase_tpu_export() -> dict:
@@ -410,6 +561,7 @@ _PHASES = {
     "torch": phase_torch,
     "tpu_export": phase_tpu_export,
     "replay": phase_replay,
+    "longctx_sp": phase_longctx_sp,
 }
 
 
@@ -419,8 +571,6 @@ _PHASES = {
 
 
 def _cpu_forced_env() -> dict:
-    from fmda_tpu.utils.env import cpu_forced_env
-
     return cpu_forced_env(repo_dir=_REPO_DIR)
 
 
@@ -582,17 +732,25 @@ def main() -> None:
         ("tpu_export", 180.0),
         ("replay", 300.0),
         ("longctx", 600.0),
+        ("longctx_sp", 600.0),
         ("multiticker", 420.0),
         ("serving", 300.0),
         ("flagship_bf16", 300.0),
     ]
+    # phases that ignore the probed backend: torch is the CPU baseline by
+    # definition; longctx_sp runs on the 8-device virtual CPU mesh (the
+    # environment exposes at most one real chip)
+    special_envs = {
+        "torch": _cpu_forced_env,
+        "longctx_sp": lambda: cpu_forced_env(n_devices=8, repo_dir=_REPO_DIR),
+    }
     phases: dict = {}
     for name, budget in plan:
         remaining = deadline - time.monotonic()
         if remaining < 60.0:
             phases[name] = {"error": "skipped (global budget exhausted)"}
             continue
-        phase_env = _cpu_forced_env() if name == "torch" else env
+        phase_env = special_envs[name]() if name in special_envs else env
         t0 = time.monotonic()
         phases[name] = _run_phase_subprocess(
             name, phase_env, min(budget, remaining))

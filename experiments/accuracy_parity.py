@@ -327,5 +327,10 @@ def write_results_md(r: dict, table: str) -> None:
 if __name__ == "__main__":
     import sys
 
+    import jax
+
+    # CPU protocol experiment; config update (not env) so a wedged
+    # accelerator plugin can never hang the run
+    jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     main()

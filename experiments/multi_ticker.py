@@ -1,15 +1,20 @@
-"""Multi-ticker shared-encoder experiment (north-star config 2).
+"""Multi-ticker shared-encoder experiment at north-star scale (config 2).
 
-Four synthetic instruments with *different* dynamics (drift strengths,
-volatility regimes — standing in for SPY/QQQ/GLD/EURUSD) trained through
-one shared BiGRU encoder via ``Trainer.fit_multi``, then each ticker
-backtested with its own normalization stats.  Shows the capability the
-reference never had: one model, batches interleaved across instruments,
-per-ticker chunk normalization (BASELINE.json configs[1]).
+Fifty synthetic instruments with *different* dynamics (drift strengths,
+volatility regimes, price scales — four named personalities standing in
+for SPY/QQQ/GLD/EURUSD plus 46 drawn from seeded ranges) trained through
+ONE shared BiGRU encoder via ``Trainer.fit_multi`` in the mixed
+composition: every step's batch concatenates 16 windows from every ticker
+(50 x 16 = 800 rows/step), each ticker normalized with its own chunk
+stats.  Each ticker is then backtested with its own serving norm stats.
+
+The reference trains one model on one hard-coded ticker (producer.py:262)
+and publishes nothing comparable; the capability target is BASELINE.json
+configs[1] (50 tickers through a shared encoder, batch = tickers).
 
     PYTHONPATH=/root/repo:$PYTHONPATH python experiments/multi_ticker.py
 
-Writes RESULTS_MULTITICKER.md + artifacts/multiticker/.  ~1 min CPU.
+Writes RESULTS_MULTITICKER.md + artifacts/multiticker/.  ~6 min CPU.
 """
 
 from __future__ import annotations
@@ -24,10 +29,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SEED = 0
 N_DAYS = 16
-EPOCHS = 15
+EPOCHS = 8
+N_TICKERS = 50
+PER_TICKER_BATCH = 16  # 50 x 16 = 800 rows/step, the north-star step shape
 
-#: per-ticker market personalities
-TICKERS = {
+#: four named market personalities; the remaining tickers draw theirs
+#: from the seeded ranges below
+NAMED = {
     "SPY": dict(imbalance_drift=0.22, momentum_drift=0.55, noise=0.35,
                 start_price=330.0),
     "QQQ": dict(imbalance_drift=0.30, momentum_drift=0.75, noise=0.55,
@@ -37,6 +45,21 @@ TICKERS = {
     "EURUSD": dict(imbalance_drift=0.05, momentum_drift=0.18, noise=0.12,
                    start_price=110.0),
 }
+
+
+def ticker_universe(n: int, seed: int):
+    """The named personalities plus seeded random draws, n total."""
+    r = np.random.default_rng(seed)
+    universe = dict(NAMED)
+    for i in range(len(NAMED), n):
+        universe[f"T{i:02d}"] = dict(
+            imbalance_drift=round(float(r.uniform(0.05, 0.30)), 3),
+            momentum_drift=round(float(r.uniform(0.15, 0.75)), 3),
+            noise=round(float(r.uniform(0.12, 0.60)), 3),
+            momentum_ar=round(float(r.uniform(0.94, 0.98)), 3),
+            start_price=round(float(r.uniform(20.0, 400.0)), 1),
+        )
+    return universe
 
 
 def main() -> None:
@@ -50,17 +73,21 @@ def main() -> None:
 
     t0 = time.time()
     fc = FeatureConfig()
+    universe = ticker_universe(N_TICKERS, SEED)
     sources = {}
-    for i, (ticker, knobs) in enumerate(TICKERS.items()):
+    for i, (ticker, knobs) in enumerate(universe.items()):
         cfg = SyntheticMarketConfig(seed=SEED + i, n_days=N_DAYS, **knobs)
         wh, _ = build_corpus(fc, cfg)
         sources[ticker] = wh
-        print(f"{ticker}: {len(wh)} rows [{time.time() - t0:.0f}s]")
+    print(f"built {len(sources)} ticker corpora "
+          f"({sum(len(w) for w in sources.values())} rows) "
+          f"[{time.time() - t0:.0f}s]")
 
     n_features = len(next(iter(sources.values())).x_fields)
     model_cfg = ModelConfig(hidden_size=32, n_features=n_features,
                             output_size=4, dropout=0.5, spatial_dropout=True)
-    train_cfg = TrainConfig(batch_size=32, window=30, chunk_size=100,
+    train_cfg = TrainConfig(batch_size=N_TICKERS * PER_TICKER_BATCH,
+                            window=30, chunk_size=100,
                             epochs=EPOCHS, seed=SEED)
     # class weights over the union of all tickers' targets
     y_all = np.concatenate([
@@ -70,19 +97,42 @@ def main() -> None:
     trainer = Trainer(model_cfg, train_cfg, weight=weight,
                       pos_weight=pos_weight)
     state, history, mtd = trainer.fit_multi(
-        sources, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
-    print(f"trained shared encoder {EPOCHS} epochs "
-          f"[{time.time() - t0:.0f}s]")
+        sources, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels,
+        mixed_batch_per_ticker=PER_TICKER_BATCH)
+    train_wall = time.time() - t0
+    print(f"trained shared encoder {EPOCHS} epochs (mixed "
+          f"{N_TICKERS}x{PER_TICKER_BATCH}/step) [{train_wall:.0f}s]")
+
+    # step-time at the real composition: time the jitted step over one
+    # round's pre-composed mixed batches (device work only)
+    train_chunks, _, _ = mtd.splits(train_cfg.val_size, train_cfg.test_size)
+    round0 = mtd.rounds(train_chunks)[0]
+    staged = list(mtd.mixed_batches(round0, PER_TICKER_BATCH))
+    import jax as _jax
+    import jax.numpy as _jnp
+    rng = _jax.random.PRNGKey(0)
+    # the train step donates its state buffers; time over a COPY so the
+    # trained state stays alive for the checkpoint and backtests below
+    st = _jax.tree.map(_jnp.copy, state)
+    for b in staged[:2]:  # warmup (compiled already, but page everything in)
+        st, loss, _ = trainer._train_step(st, b, rng)
+    _jax.block_until_ready(loss)
+    t_step = time.perf_counter()
+    for b in staged:
+        st, loss, _ = trainer._train_step(st, b, rng)
+    _jax.block_until_ready(loss)
+    step_ms = (time.perf_counter() - t_step) / len(staged) * 1e3
+    seq_s = train_cfg.batch_size / (step_ms / 1e3)
+    print(f"fit_multi step: {step_ms:.1f} ms at B={train_cfg.batch_size} "
+          f"({seq_s:.0f} seq/s)")
 
     artifacts = os.path.join(REPO, "artifacts", "multiticker")
     os.makedirs(artifacts, exist_ok=True)
-    # one checkpoint carrying every ticker's serving norm stats, so the
-    # published artifact is servable without re-running this script
     norms = mtd.final_norm_params()
     ckpt = save_checkpoint(
         os.path.join(artifacts, "checkpoint"), state,
         extra={
-            "tickers": list(TICKERS), "n_days": N_DAYS, "seed": SEED,
+            "tickers": list(universe), "n_days": N_DAYS, "seed": SEED,
             "norm_per_ticker": {
                 t: {"x_min": np.asarray(n.x_min),
                     "x_max": np.asarray(n.x_max)}
@@ -99,13 +149,20 @@ def main() -> None:
         per_ticker[ticker] = {
             "rows_served": int(len(bt.probabilities)),
             "accuracy": round(float(bt.metrics.accuracy), 3),
-            "hamming": round(float(bt.metrics.hamming), 3),
             "signals": s.signals, "hits": s.hits,
             "precision": round(s.precision, 3),
             "base_rate": round(s.base_rate, 3),
             "edge": round(s.edge, 3),
         }
+    edges = np.array([s["edge"] for s in per_ticker.values()])
     results = {
+        "n_tickers": len(per_ticker),
+        "edge_median": round(float(np.median(edges)), 3),
+        "edge_mean": round(float(edges.mean()), 3),
+        "edge_positive_count": int((edges > 0).sum()),
+        "step_ms": round(step_ms, 1),
+        "seq_s": round(seq_s, 1),
+        "batch": train_cfg.batch_size,
         "per_ticker": per_ticker,
         "final_train": {"loss": round(history["train"][-1].loss, 3),
                         "accuracy": round(history["train"][-1].accuracy, 3)},
@@ -113,38 +170,62 @@ def main() -> None:
         "wall_s": round(time.time() - t0, 1),
         "backend": jax.default_backend(),
     }
-    print(json.dumps(results, indent=2))
+    print(json.dumps({k: v for k, v in results.items() if k != "per_ticker"},
+                     indent=2))
     write_md(results)
 
 
 def write_md(r: dict) -> None:
+    pt = r["per_ticker"]
+    named = {t: s for t, s in pt.items() if t in NAMED}
     lines = [
-        "# RESULTS — multi-ticker shared encoder (north-star config 2)",
+        "# RESULTS — multi-ticker shared encoder at 50 instruments"
+        " (north-star config 2)",
         "",
         f"One BiGRU encoder trained with `Trainer.fit_multi` over"
-        f" {len(TICKERS)} synthetic instruments with different dynamics"
-        " (drift/vol personalities standing in for SPY/QQQ/GLD/EURUSD),"
-        " batches interleaved across instruments, per-ticker chunk"
-        " normalization; each ticker then backtested with its own norm"
-        " stats through the serving path.  The reference trains one model"
-        " per instrument and publishes nothing comparable.  Reproduce:"
+        f" {r['n_tickers']} synthetic instruments with different dynamics"
+        " (four named personalities standing in for SPY/QQQ/GLD/EURUSD"
+        " plus 46 seeded draws), in the MIXED composition: every step's"
+        f" batch concatenates {PER_TICKER_BATCH} windows from every ticker"
+        f" ({r['batch']} rows/step), per-ticker chunk normalization;"
+        " each ticker then backtested with its own serving norm stats."
+        "  The reference trains one model on one hard-coded ticker and"
+        " publishes nothing comparable.  Reproduce:"
         " `python experiments/multi_ticker.py`.",
         "",
-        "| ticker | rows served | accuracy | Hamming | signals | overall"
-        " precision | base rate | edge |",
-        "|---|---|---|---|---|---|---|---|",
-    ]
-    for ticker, s in r["per_ticker"].items():
-        lines.append(
-            f"| {ticker} | {s['rows_served']} | {s['accuracy']} |"
-            f" {s['hamming']} | {s['signals']} | {s['precision']} |"
-            f" {s['base_rate']} | {s['edge']:+} |")
-    lines += [
+        f"- **Median per-ticker edge: {r['edge_median']:+.3f}** (mean"
+        f" {r['edge_mean']:+.3f}; {r['edge_positive_count']}/"
+        f"{r['n_tickers']} tickers positive).  `edge` = precision of"
+        " fired signals minus the label base rate (what always-firing"
+        " would score).",
+        f"- **fit_multi step time: {r['step_ms']} ms** at batch"
+        f" {r['batch']} ({r['seq_s']} seq/s) on {r['backend']}.",
+        f"- Final train loss/accuracy: {r['final_train']['loss']} /"
+        f" {r['final_train']['accuracy']}.",
+        f"- Checkpoint (all 50 tickers' serving norm stats in `extra`):"
+        f" `{r['checkpoint']}`.  Wall clock: {r['wall_s']}s.",
         "",
-        f"Final train loss/accuracy: {r['final_train']['loss']} /"
-        f" {r['final_train']['accuracy']}.  Checkpoint:"
-        f" `{r['checkpoint']}`.  Wall clock: {r['wall_s']}s on"
-        f" {r['backend']}.",
+        "## Named personalities",
+        "",
+        "| ticker | rows served | accuracy | signals | precision |"
+        " base rate | edge |",
+        "|---|---|---|---|---|---|---|",
+        *[
+            f"| {t} | {s['rows_served']} | {s['accuracy']} |"
+            f" {s['signals']} | {s['precision']} | {s['base_rate']} |"
+            f" {s['edge']:+} |"
+            for t, s in named.items()
+        ],
+        "",
+        "## Full universe (sorted by edge)",
+        "",
+        "| ticker | accuracy | signals | precision | base rate | edge |",
+        "|---|---|---|---|---|---|",
+        *[
+            f"| {t} | {s['accuracy']} | {s['signals']} | {s['precision']} |"
+            f" {s['base_rate']} | {s['edge']:+} |"
+            for t, s in sorted(pt.items(), key=lambda kv: -kv[1]["edge"])
+        ],
         "",
     ]
     path = os.path.join(REPO, "RESULTS_MULTITICKER.md")
@@ -154,4 +235,10 @@ def write_md(r: dict) -> None:
 
 
 if __name__ == "__main__":
+    # the experiment protocol is CPU (it measures learning under the
+    # reference's protocol, not device speed); forcing the host platform
+    # post-import also never hangs on a wedged accelerator plugin
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     main()

@@ -41,6 +41,11 @@ def make_sp_train_step(
         mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
         n_microbatches=n_microbatches,
     )
+    if model_cfg.remat:
+        # long-context windows: recompute the forward in the backward pass
+        # instead of keeping every per-step hidden alive (HBM is the
+        # constraint at seq_len=1024-class windows, SURVEY §5)
+        forward = jax.checkpoint(forward)
 
     @jax.jit
     def step(params, opt_state, x, y):

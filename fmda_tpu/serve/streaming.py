@@ -257,6 +257,10 @@ class StreamingPredictor:
     """Bus-facing wrapper: consume predict-timestamp signals, feed only the
     newest landed row through the carried-state core, publish predictions."""
 
+    #: catch-up fetch granularity: one query per this many missed rows
+    #: (bounds both query count and peak memory of a long catch-up)
+    CATCHUP_CHUNK = 10_000
+
     def __init__(
         self,
         bus,
@@ -293,16 +297,18 @@ class StreamingPredictor:
             row_id = self.warehouse.id_for_timestamp(ts)
             if row_id is None or row_id <= self._last_row_id:
                 continue
-            # catch up any gap rows to keep the recurrence exact — ONE
-            # query for the whole gap (a predictor started mid-session
-            # against a long warehouse must not do thousands of
-            # single-row round-trips), then advance the recurrence row
-            # by row in order.  Positions are dense (warehouse fetch
-            # space), so the range is exactly the missed rows.
-            gap = self.warehouse.fetch(
-                range(self._last_row_id + 1, row_id + 1))
-            for x in gap:
-                probs = self.core.step(x)[0]
+            # catch up any gap rows to keep the recurrence exact —
+            # batched queries (a predictor started mid-session against a
+            # long warehouse must not do thousands of single-row
+            # round-trips), chunked so an arbitrarily long gap never
+            # materialises as one unbounded matrix.  Positions are dense
+            # (warehouse fetch space), so ranges are exactly the missed
+            # rows, in order.
+            for lo in range(self._last_row_id + 1, row_id + 1,
+                            self.CATCHUP_CHUNK):
+                hi = min(lo + self.CATCHUP_CHUNK - 1, row_id)
+                for x in self.warehouse.fetch(range(lo, hi + 1)):
+                    probs = self.core.step(x)[0]
             self._last_row_id = row_id
             idx = np.where(probs > self.threshold)[0]
             labels = tuple(self.y_fields[i] for i in idx)

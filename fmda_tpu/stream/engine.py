@@ -428,7 +428,7 @@ class StreamEngine:
                 if (
                     self._landed_seed_floor is not None
                     and ts < self._landed_seed_floor
-                    and self.warehouse.id_for_timestamp(ts) is not None
+                    and self._warehouse_has(ts)
                 ):
                     continue
                 seen_now.add(ts)
@@ -539,6 +539,15 @@ class StreamEngine:
         }
 
     # -- checkpoint / resume -------------------------------------------------
+
+    def _warehouse_has(self, ts: str) -> bool:
+        """Indexed membership probe for the deep-replay dedupe: prefer the
+        warehouse's point ``has_timestamp`` (O(log n)); fall back to the
+        positional lookup for sources that only expose that."""
+        has = getattr(self.warehouse, "has_timestamp", None)
+        if has is not None:
+            return bool(has(ts))
+        return self.warehouse.id_for_timestamp(ts) is not None
 
     def checkpoint(self) -> None:
         """Persist the engine's durable state: consumer offsets *plus* all

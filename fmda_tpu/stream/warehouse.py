@@ -142,11 +142,17 @@ class Warehouse:
         read API of this class speaks — see :meth:`fetch`); they are
         gap-free by construction even when the underlying autoincrement
         IDs have holes, so a cursor advanced to the last returned
-        position can never desync into re-serving."""
+        position can never desync into re-serving.  Pure SQL — always
+        fresh, independent of the derived caches (tail-followers poll a
+        file another process is writing)."""
         with self._lock:
-            self._refresh_derived()
-            pos = max(0, int(position))
-            return list(enumerate(self._ts[pos:], start=pos + 1))
+            rows = self._conn.execute(
+                "SELECT pos, Timestamp FROM (SELECT ROW_NUMBER() OVER "
+                f"(ORDER BY ID) AS pos, Timestamp FROM {self.table}) "
+                "WHERE pos > ? ORDER BY pos",
+                (max(0, int(position)),),
+            ).fetchall()
+        return [(int(r[0]), r[1]) for r in rows]
 
     def recent_timestamps(self, limit: int) -> List[str]:
         """Timestamps of the newest ``limit`` rows (newest-first) — the
@@ -159,6 +165,18 @@ class Warehouse:
                 (int(limit),),
             ).fetchall()
         return [r[0] for r in rows]
+
+    def has_timestamp(self, ts: str) -> bool:
+        """Point-indexed existence check — the engine's dedupe fallback
+        wants only membership, not the position (the positional COUNT in
+        :meth:`id_for_timestamp` walks an index range, too heavy to run
+        once per replayed row)."""
+        with self._lock:
+            row = self._conn.execute(
+                f"SELECT 1 FROM {self.table} WHERE Timestamp = ? LIMIT 1",
+                (ts,),
+            ).fetchone()
+        return row is not None
 
     def id_for_timestamp(self, ts: str) -> Optional[int]:
         """Row *position* of a timestamp (predict.py:144 lookup path) —

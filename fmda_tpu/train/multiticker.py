@@ -10,10 +10,12 @@ just makes the batch dimension bigger, which is exactly what the MXU wants.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, Iterator, List, Tuple
+
+import numpy as np
 
 from fmda_tpu.data.normalize import NormParams
-from fmda_tpu.data.pipeline import ChunkDataset, WindowBatches
+from fmda_tpu.data.pipeline import Batch, ChunkDataset, WindowBatches
 from fmda_tpu.data.source import FeatureSource
 
 
@@ -76,6 +78,70 @@ class MultiTickerDataset:
         self, ticker: str, chunk_idx: int, batch_size: int
     ) -> WindowBatches:
         return WindowBatches(self.datasets[ticker], chunk_idx, batch_size)
+
+    def rounds(
+        self, chunks: List[Tuple[str, int]]
+    ) -> List[Dict[str, int]]:
+        """Regroup an interleaved ``(ticker, chunk)`` list (as produced by
+        :meth:`splits`) into *rounds*: round ``r`` holds the r-th listed
+        chunk of every ticker that still has one.  Rounds are the unit of
+        mixed-composition training — see :meth:`mixed_batches`."""
+        seen: Dict[str, int] = {t: 0 for t in self.tickers}
+        rounds: List[Dict[str, int]] = []
+        for ticker, chunk_idx in chunks:
+            r = seen[ticker]
+            seen[ticker] = r + 1
+            while len(rounds) <= r:
+                rounds.append({})
+            rounds[r][ticker] = chunk_idx
+        return rounds
+
+    def mixed_batches(
+        self, round_chunks: Dict[str, int], per_ticker: int
+    ) -> Iterator[Batch]:
+        """Fixed-shape batches mixing every ticker in one step — the
+        north-star composition (50 tickers x 16 windows/step): each batch
+        concatenates ``per_ticker`` windows from every ticker's chunk of
+        this round, each ticker normalized with its own chunk stats.
+        Every batch has shape ``(len(tickers) * per_ticker, ...)``
+        regardless of which tickers are present or exhausted (absent
+        slots are zero-filled with mask 0), so one jitted step serves the
+        whole run.  On TPU the mixed batch is simply a bigger batch
+        dimension — exactly what the MXU wants."""
+        iters: Dict[str, Iterator[Batch]] = {
+            t: iter(WindowBatches(self.datasets[t], c, per_ticker))
+            for t, c in round_chunks.items()
+        }
+        # shape donors from any participating dataset
+        any_ds = self.datasets[next(iter(round_chunks))]
+        window = any_ds.window
+        n_feat = len(any_ds.source.x_fields)
+        n_cls = any_ds.source.fetch_targets([any_ds.window]).shape[-1]
+        zero = Batch(
+            x=np.zeros((per_ticker, window, n_feat), np.float32),
+            y=np.zeros((per_ticker, n_cls), np.float32),
+            mask=np.zeros(per_ticker, np.float32),
+        )
+        while iters:
+            parts: List[Batch] = []
+            alive = False
+            for t in self.tickers:
+                it = iters.get(t)
+                part = zero
+                if it is not None:
+                    try:
+                        part = next(it)
+                        alive = True
+                    except StopIteration:
+                        iters.pop(t)
+                parts.append(part)
+            if not alive:
+                return
+            yield Batch(
+                x=np.concatenate([p.x for p in parts]),
+                y=np.concatenate([p.y for p in parts]),
+                mask=np.concatenate([p.mask for p in parts]),
+            )
 
     def final_norm_params(self) -> Dict[str, NormParams]:
         """Per-ticker serving norm stats (each instrument has its own

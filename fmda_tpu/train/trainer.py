@@ -392,10 +392,17 @@ class Trainer:
         epochs: Optional[int] = None,
         bid_levels: int = 0,
         ask_levels: int = 0,
+        mixed_batch_per_ticker: Optional[int] = None,
     ):
         """Multi-ticker shared-encoder training (north-star config 2):
         one model, batches interleaved across instruments, per-ticker
         chunk normalization.  Returns (state, history, MultiTickerDataset).
+
+        ``mixed_batch_per_ticker=k`` switches from chunk-interleaved
+        single-ticker batches to the north-star *mixed* composition: every
+        step's batch concatenates ``k`` windows from EVERY ticker
+        (``len(sources) * k`` rows/step — e.g. 50 x 16 = 800), so each
+        gradient mixes all instruments and the device sees one big batch.
         """
         from fmda_tpu.train.multiticker import MultiTickerDataset
 
@@ -407,27 +414,29 @@ class Trainer:
             bid_levels=bid_levels, ask_levels=ask_levels,
         )
         train_chunks, val_chunks, _ = mtd.splits(tc.val_size, tc.test_size)
+        if mixed_batch_per_ticker:
+            k = mixed_batch_per_ticker
+
+            def iters(chunks):
+                return (
+                    self._place_batches(mtd.mixed_batches(rc, k))
+                    for rc in mtd.rounds(chunks)
+                )
+        else:
+            def iters(chunks):
+                return (
+                    self._place_batches(mtd.batches(t, c, tc.batch_size))
+                    for t, c in chunks
+                )
         state = self.init_state(init_rng)
         history: Dict[str, List[EpochMetrics]] = {"train": [], "val": []}
         for epoch in range(epochs if epochs is not None else tc.epochs):
             state, train_metrics, _ = self._run_batches(
-                state,
-                (
-                    self._place_batches(mtd.batches(t, c, tc.batch_size))
-                    for t, c in train_chunks
-                ),
-                step_rng,
-                train=True,
+                state, iters(train_chunks), step_rng, train=True,
             )
             history["train"].append(train_metrics)
             _, val_metrics, _ = self._run_batches(
-                state,
-                (
-                    self._place_batches(mtd.batches(t, c, tc.batch_size))
-                    for t, c in val_chunks
-                ),
-                None,
-                train=False,
+                state, iters(val_chunks), None, train=False,
             )
             history["val"].append(val_metrics)
             log.info(

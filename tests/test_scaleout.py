@@ -108,3 +108,81 @@ def test_long_context_sp_training_step():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_multiticker_mixed_batches_fixed_shape_and_coverage():
+    """The north-star mixed composition: every batch concatenates
+    per_ticker windows from EVERY ticker (absent/exhausted tickers
+    zero-masked), constant shape across rounds, and the union of valid
+    rows covers each ticker's windows exactly once."""
+    sources = {t: _ticker_source(i, n=120 + 20 * i)
+               for i, t in enumerate(("SPY", "QQQ", "GLD"))}
+    mtd = MultiTickerDataset(sources, chunk_size=40, window=4)
+    train, _, _ = mtd.splits(0.1, 0.1)
+    rounds = mtd.rounds(train)
+    assert sum(len(rc) for rc in rounds) == len(train)
+    per_ticker = 8
+    total_valid = 0
+    n_batches = 0
+    for rc in rounds:
+        for b in mtd.mixed_batches(rc, per_ticker):
+            assert b.x.shape == (3 * per_ticker, 4, 5)
+            assert b.y.shape == (3 * per_ticker, 4)
+            assert b.mask.shape == (3 * per_ticker,)
+            # slot t holds ticker t's rows: zero rows only where mask==0
+            total_valid += int(b.mask.sum())
+            n_batches += 1
+    expected = sum(
+        len(mtd.batches(t, c, per_ticker).x_windows)
+        for rc in rounds for t, c in rc.items())
+    assert total_valid == expected
+    assert n_batches >= max(len(rc) for rc in rounds)
+
+
+def test_multiticker_mixed_training_learns():
+    sources = {
+        "SPY": _ticker_source(0),
+        "QQQ": _ticker_source(1),
+        "EURUSD": _ticker_source(2),
+    }
+    model_cfg = ModelConfig(hidden_size=8, n_features=5, output_size=4,
+                            dropout=0.0, spatial_dropout=False,
+                            use_pallas=False)
+    train_cfg = TrainConfig(batch_size=16, window=4, chunk_size=40,
+                            learning_rate=5e-3, epochs=4, seed=2)
+    trainer = Trainer(model_cfg, train_cfg)
+    state, history, mtd = trainer.fit_multi(
+        sources, mixed_batch_per_ticker=8)
+    assert history["train"][-1].loss < history["train"][0].loss
+    assert history["train"][-1].accuracy > history["train"][0].accuracy
+
+
+def test_sp_train_step_remat_matches_plain():
+    """remat=True (recompute the forward in the backward pass) must be a
+    pure memory/compute trade: same loss trajectory as the plain step."""
+    mesh = build_mesh(MeshConfig(dp=2, sp=2))
+    seq, batch = 64, 4
+    from fmda_tpu.models.bigru import BiGRU
+
+    r = np.random.default_rng(0)
+    x_host = r.normal(size=(batch, seq, 6)).astype(np.float32)
+    y_host = (x_host[:, -1, :4] > 0).astype(np.float32)
+
+    losses = {}
+    for remat in (False, True):
+        cfg = ModelConfig(hidden_size=8, n_features=6, output_size=4,
+                          dropout=0.0, use_pallas=False, remat=remat)
+        params = BiGRU(cfg).init(
+            {"params": jax.random.PRNGKey(0)},
+            jnp.asarray(x_host[:, :8]))["params"]
+        optimizer = optax.chain(
+            optax.clip_by_global_norm(50.0), optax.adam(1e-2))
+        opt_state = optimizer.init(params)
+        step = make_sp_train_step(mesh, cfg, seq, optimizer)
+        x, y, p, o = shard_train_inputs(mesh, x_host, y_host, params, opt_state)
+        traj = []
+        for _ in range(3):
+            p, o, loss = step(p, o, x, y)
+            traj.append(float(loss))
+        losses[remat] = traj
+    np.testing.assert_allclose(losses[False], losses[True], rtol=1e-5)
