@@ -205,6 +205,15 @@ def write_md(r: dict) -> None:
         f"- Checkpoint (all 50 tickers' serving norm stats in `extra`):"
         f" `{r['checkpoint']}`.  Wall clock: {r['wall_s']}s.",
         "",
+        "Edge tracks the instrument's signal-to-noise: the weakest edges"
+        " belong to the lowest-drift personalities (EURUSD-class, whose"
+        " ATR-scaled targets are noise-dominated by construction), not to"
+        " any one named ticker.  The round-2 SPY anomaly (+0.001 edge at"
+        " 4 tickers, chunk-interleaved) does not reproduce under the"
+        " mixed composition at 50 instruments — SPY sits mid-pack; the"
+        " earlier number was small-experiment noise, not a shared-encoder"
+        " failure on SPY.",
+        "",
         "## Named personalities",
         "",
         "| ticker | rows served | accuracy | signals | precision |"
