@@ -114,7 +114,18 @@ class _StreamBuffer:
         return [e for fl in sorted(self.buckets) for e in self.buckets[fl]]
 
 
-def _extract_deep_raw(value: dict, bid_levels: int, ask_levels: int):
+def _deep_key_table(bid_levels: int, ask_levels: int):
+    """Precomputed per-level message keys — built once per engine, not
+    per message (the f-strings were measurable in the replay profile)."""
+    return (
+        tuple((f"bids_{i}", f"bid_{i}", f"bid_{i}_size")
+              for i in range(bid_levels)),
+        tuple((f"asks_{i}", f"ask_{i}", f"ask_{i}_size")
+              for i in range(ask_levels)),
+    )
+
+
+def _extract_deep_raw(value: dict, key_table) -> tuple:
     """Pull the raw book ladder out of one DEEP message (producer reshape,
     getMarketData.py:117-127; Spark schema spark_consumer.py:281-308).
     Missing levels -> 0.  Returns (ts_str, bids, bid_sizes, asks, ask_sizes)
@@ -122,16 +133,18 @@ def _extract_deep_raw(value: dict, bid_levels: int, ask_levels: int):
     :func:`_parse_deep_batch`."""
     ts_str = value["Timestamp"]
     to_epoch(ts_str)  # validate the timestamp before accepting the message
-    bids, bid_sizes = [0.0] * bid_levels, [0.0] * bid_levels
-    asks, ask_sizes = [0.0] * ask_levels, [0.0] * ask_levels
-    for i in range(bid_levels):
-        lvl = value.get(f"bids_{i}") or {}
-        bids[i] = float(lvl.get(f"bid_{i}") or 0.0)
-        bid_sizes[i] = float(lvl.get(f"bid_{i}_size") or 0.0)
-    for i in range(ask_levels):
-        lvl = value.get(f"asks_{i}") or {}
-        asks[i] = float(lvl.get(f"ask_{i}") or 0.0)
-        ask_sizes[i] = float(lvl.get(f"ask_{i}_size") or 0.0)
+    bid_keys, ask_keys = key_table
+    bids, bid_sizes = [], []
+    asks, ask_sizes = [], []
+    get = value.get
+    for level_key, px_key, size_key in bid_keys:
+        lvl = get(level_key) or {}
+        bids.append(float(lvl.get(px_key) or 0.0))
+        bid_sizes.append(float(lvl.get(size_key) or 0.0))
+    for level_key, px_key, size_key in ask_keys:
+        lvl = get(level_key) or {}
+        asks.append(float(lvl.get(px_key) or 0.0))
+        ask_sizes.append(float(lvl.get(size_key) or 0.0))
     return ts_str, bids, bid_sizes, asks, ask_sizes
 
 
@@ -284,6 +297,16 @@ class StreamEngine:
         elif join_backend != "python":
             raise ValueError(
                 f"join_backend {join_backend!r}; use 'python' or 'native'")
+        self._deep_keys = _deep_key_table(
+            features.bid_levels, features.ask_levels)
+        self._side_parsers = {
+            TOPIC_VIX: _parse_vix,
+            TOPIC_VOLUME: _parse_volume,
+            TOPIC_COT: _parse_cot,
+            TOPIC_IND: (
+                lambda v, _repl=features.event_list_repl: _parse_ind(v, _repl)
+            ),
+        }
         #: timestamps of landed ticks — the "exactly one output row per
         #: book tick" dropDuplicates semantics (spark_consumer.py:477),
         #: which also makes crash-replay idempotent.  Seeded bounded from
@@ -317,7 +340,7 @@ class StreamEngine:
             polled_any = True
             try:
                 raws.append(
-                    _extract_deep_raw(rec.value, fc.bid_levels, fc.ask_levels)
+                    _extract_deep_raw(rec.value, self._deep_keys)
                 )
             except (KeyError, ValueError, TypeError, AttributeError) as e:
                 # AttributeError: a nested level that should be a dict is a
@@ -341,12 +364,7 @@ class StreamEngine:
             bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
             if self._core is not None:
                 self._core.add_deep(event.ts)
-        parsers = {
-            TOPIC_VIX: _parse_vix,
-            TOPIC_VOLUME: _parse_volume,
-            TOPIC_COT: _parse_cot,
-            TOPIC_IND: lambda v: _parse_ind(v, fc.event_list_repl),
-        }
+        parsers = self._side_parsers
         for idx, (topic, buf) in enumerate(self._side_streams.items()):
             for rec in self._consumers[topic].poll():
                 polled_any = True

@@ -98,16 +98,21 @@ class Warehouse:
         Each row dict must carry 'Timestamp'."""
         if not rows:
             return 0
-        placeholders = ", ".join(["?"] * (1 + len(self._columns)))
-        col_list = "Timestamp, " + ", ".join(_quote(c) for c in self._columns)
+        cols = self._columns
+        placeholders = ", ".join(["?"] * (1 + len(cols)))
+        col_list = "Timestamp, " + ", ".join(_quote(c) for c in cols)
+        known = frozenset(cols) | {"Timestamp"}
         values = []
         for row in rows:
-            unknown = set(row) - set(self._columns) - {"Timestamp"}
-            if unknown:
-                raise KeyError(f"unknown feature columns: {sorted(unknown)}")
+            # issuperset over the dict view: per-key hash probes, no
+            # per-row set construction (this is the landing hot path)
+            if not known.issuperset(row.keys()):
+                unknown = sorted(set(row) - known)
+                raise KeyError(f"unknown feature columns: {unknown}")
+            get = row.get
             values.append(
-                [row.get("Timestamp")]
-                + [float(row.get(c, 0.0) or 0.0) for c in self._columns]
+                [get("Timestamp")]
+                + [float(get(c) or 0.0) for c in cols]
             )
         with self._lock:
             self._conn.executemany(

@@ -33,12 +33,43 @@ TS_FORMAT = "%Y-%m-%d %H:%M:%S"
 
 
 def parse_ts(ts: str) -> _dt.datetime:
-    """Parse a bus-message timestamp string (naive, exchange-local)."""
+    """Parse a bus-message timestamp string (naive, exchange-local).
+
+    Manual field slicing on the fixed ``YYYY-MM-DD HH:MM:SS`` layout —
+    ~10x faster than ``strptime``, which dominates the engine's replay
+    profile (one parse per message per feed).  Anything that doesn't
+    match the layout falls back to strptime for the exact same error
+    behavior on malformed input.
+    """
+    try:
+        if (
+            len(ts) == 19
+            and ts[4] == "-" and ts[7] == "-" and ts[10] == " "
+            and ts[13] == ":" and ts[16] == ":"
+            # isdigit rejects the signs/spaces bare int() would accept,
+            # so the fast path admits exactly what strptime admits
+            and ts[0:4].isdigit() and ts[5:7].isdigit()
+            and ts[8:10].isdigit() and ts[11:13].isdigit()
+            and ts[14:16].isdigit() and ts[17:19].isdigit()
+        ):
+            return _dt.datetime(
+                int(ts[0:4]), int(ts[5:7]), int(ts[8:10]),
+                int(ts[11:13]), int(ts[14:16]), int(ts[17:19]),
+            )
+    except ValueError:
+        pass
     return _dt.datetime.strptime(ts, TS_FORMAT)
 
 
 def format_ts(dt: _dt.datetime) -> str:
     return dt.strftime(TS_FORMAT)
+
+
+#: memo for :func:`to_epoch` — the same tick timestamp is converted once
+#: per feed plus once per join probe; bounded so a years-long daemon
+#: cannot grow it unboundedly
+_EPOCH_CACHE: Dict[str, int] = {}
+_EPOCH_CACHE_MAX = 65536
 
 
 def to_epoch(ts: str) -> int:
@@ -47,7 +78,14 @@ def to_epoch(ts: str) -> int:
     The streaming engine only needs a consistent total order plus arithmetic,
     matching Spark's ``unix_timestamp`` use (spark_consumer.py:315).
     """
-    return int(parse_ts(ts).replace(tzinfo=_dt.timezone.utc).timestamp())
+    hit = _EPOCH_CACHE.get(ts)
+    if hit is not None:
+        return hit
+    epoch = int(parse_ts(ts).replace(tzinfo=_dt.timezone.utc).timestamp())
+    if len(_EPOCH_CACHE) >= _EPOCH_CACHE_MAX:
+        _EPOCH_CACHE.clear()
+    _EPOCH_CACHE[ts] = epoch
+    return epoch
 
 
 def floor_epoch(epoch_s: int, floor_s: int) -> int:

@@ -261,3 +261,31 @@ def test_engine_survives_malformed_messages(messages):
         x = wh.fetch(range(1, len(wh) + 1))
         assert x.shape == (len(wh), len(wh.x_fields))
         assert np.isfinite(x).all()  # fillna(0): nothing malformed lands
+
+
+def test_parse_ts_fast_path_matches_strptime_semantics():
+    """The sliced fast path must admit exactly what strptime admits —
+    malformed separators or signed/padded fields (which bare int() would
+    swallow) still raise, and valid timestamps round-trip identically."""
+    import datetime as dt
+
+    import pytest
+
+    from fmda_tpu.utils.timeutils import parse_ts, to_epoch
+
+    assert parse_ts("2026-07-29 12:34:56") == dt.datetime(
+        2026, 7, 29, 12, 34, 56)
+    for bad in (
+        "2026-07x29 12:34:56",   # wrong separator at an unchecked position
+        "2026-07-29 12:34:+5",   # int() would accept '+5'
+        "2026-07-29 12:34: 6",   # int() would accept ' 6'
+        "2026-07-29T12:34:56",   # ISO separator
+        "2026-13-29 12:34:56",   # month out of range
+        "garbage",
+    ):
+        with pytest.raises(ValueError):
+            parse_ts(bad)
+        with pytest.raises(ValueError):
+            to_epoch(bad + "x")  # unique string: the memo must not mask
+    # memo returns the same value on repeat lookups
+    assert to_epoch("2026-07-29 12:34:56") == to_epoch("2026-07-29 12:34:56")
