@@ -1,0 +1,133 @@
+"""bf16-compute training parity: the MXU-native dtype vs float32.
+
+On TPU the MXU's native operand dtype is bfloat16; the framework's model
+computes in ``ModelConfig.dtype`` with float32 parameters and optimizer
+state (mixed precision).  This experiment trains the flagship model twice
+on the same calibrated corpus, seed, and protocol — once in f32, once in
+bf16 compute — and publishes the side-by-side learning curves and test
+metrics, demonstrating the bf16 path is a drop-in for training quality,
+not just a kernel-lowering claim.
+
+On CPU, bf16 is emulated (slower, not faster — the speed claim belongs to
+the TPU bench phases); what this measures is *quality* parity.
+
+    PYTHONPATH=/root/repo:$PYTHONPATH python experiments/bf16_training.py
+
+Writes RESULTS_BF16.md.  ~10 min CPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SEED = 3
+N_DAYS = 20
+EPOCHS = 6
+MARKET_KW = dict(momentum_drift=0.13, imbalance_drift=0.05, noise=0.55,
+                 momentum_ar=0.96)
+
+
+def main() -> None:
+    import jax
+
+    from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+    from fmda_tpu.train import Trainer
+    from fmda_tpu.train.trainer import imbalance_weights_from_source
+
+    t0 = time.time()
+    fc = FeatureConfig()
+    wh, _ = build_corpus(
+        fc, SyntheticMarketConfig(seed=SEED, n_days=N_DAYS, **MARKET_KW))
+    print(f"corpus: {len(wh)} rows [{time.time() - t0:.0f}s]")
+    weight, pos_weight = imbalance_weights_from_source(wh)
+
+    out = {}
+    for dtype in ("float32", "bfloat16"):
+        model_cfg = ModelConfig(
+            hidden_size=32, n_features=len(wh.x_fields), output_size=4,
+            dropout=0.5, spatial_dropout=True, dtype=dtype,
+        )
+        train_cfg = TrainConfig(
+            batch_size=32, window=30, chunk_size=100, learning_rate=1e-3,
+            epochs=EPOCHS, clip=50.0, seed=SEED,
+        )
+        trainer = Trainer(model_cfg, train_cfg, weight=weight,
+                          pos_weight=pos_weight)
+        state, history, dataset = trainer.fit(
+            wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
+        _, _, test_chunks = dataset.split(
+            train_cfg.val_size, train_cfg.test_size)
+        m, _ = trainer.evaluate(state, dataset, test_chunks)
+        out[dtype] = {
+            "train": [
+                {"loss": round(e.loss, 4), "accuracy": round(e.accuracy, 3)}
+                for e in history["train"]
+            ],
+            "val_accuracy": [round(e.accuracy, 3) for e in history["val"]],
+            "test": {"accuracy": round(float(m.accuracy), 3),
+                     "hamming": round(float(m.hamming), 3)},
+        }
+        print(f"{dtype}: test={out[dtype]['test']} "
+              f"[{time.time() - t0:.0f}s]")
+
+    out["wall_s"] = round(time.time() - t0, 1)
+    print(json.dumps(out, indent=1))
+    write_md(out)
+
+
+def write_md(r: dict) -> None:
+    f32, bf16 = r["float32"], r["bfloat16"]
+    lines = [
+        "# RESULTS — bf16-compute training parity",
+        "",
+        "The flagship BiGRU trained twice on the same calibrated corpus"
+        f" (seed {SEED}, {N_DAYS} days), seed, and protocol — f32 compute"
+        " vs bf16 compute with f32 params/optimizer (the MXU-native mixed"
+        " precision).  Quality parity on CPU emulation; the bf16 *speed*"
+        " story is the TPU bench's `flagship_bf16` phase.  Reproduce:"
+        " `python experiments/bf16_training.py`.",
+        "",
+        "| metric | float32 | bfloat16 |",
+        "|---|---|---|",
+        f"| Test accuracy | {f32['test']['accuracy']} |"
+        f" {bf16['test']['accuracy']} |",
+        f"| Test Hamming | {f32['test']['hamming']} |"
+        f" {bf16['test']['hamming']} |",
+        f"| Final train loss | {f32['train'][-1]['loss']} |"
+        f" {bf16['train'][-1]['loss']} |",
+        f"| Final train accuracy | {f32['train'][-1]['accuracy']} |"
+        f" {bf16['train'][-1]['accuracy']} |",
+        "",
+        "Per-epoch train loss (f32 vs bf16): "
+        + "; ".join(
+            f"{a['loss']}/{b['loss']}"
+            for a, b in zip(f32["train"], bf16["train"])
+        ),
+        "",
+        "Per-epoch val accuracy (f32 vs bf16): "
+        + "; ".join(
+            f"{a}/{b}"
+            for a, b in zip(f32["val_accuracy"], bf16["val_accuracy"])
+        ),
+        "",
+        f"Wall clock: {r['wall_s']}s (CPU; bf16 is emulated here).",
+        "",
+    ]
+    path = os.path.join(REPO, "RESULTS_BF16.md")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    main()

@@ -301,7 +301,15 @@ class MySQLWarehouse:
         """Feature rows in the *requested id order* (multi-join row order is
         otherwise unspecified — silently scrambled training windows on a
         real server; ADVICE r1).  Raises on ids the warehouse doesn't have,
-        like the embedded Warehouse."""
+        like the embedded Warehouse.
+
+        Index-space note: the embedded Warehouse speaks dense 1-based
+        *positions* mapped to IDs internally; this adapter queries raw
+        MariaDB autoincrement IDs, which equal positions under the
+        deployment's append-only, no-rollback writer (the reference's own
+        dataloader makes the same assumption, indexing 1..COUNT(ID) —
+        sql_pytorch_dataloader.py:65-78).  A burned rowid on a live server
+        surfaces as the raise above, never as a silently shifted window."""
         import numpy as np
 
         ids = [int(i) for i in ids]
