@@ -25,17 +25,21 @@ import time
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys_path_dir = os.path.dirname(os.path.abspath(__file__))
 
-SEED = 3
+import sys  # noqa: E402
+
+if sys_path_dir not in sys.path:
+    sys.path.insert(0, sys_path_dir)
+
+# single source of truth for the calibrated-corpus protocol constants
+from accuracy_parity import MARKET_KW, SEED  # noqa: E402
+
 N_DAYS = 20
 EPOCHS = 6
-MARKET_KW = dict(momentum_drift=0.13, imbalance_drift=0.05, noise=0.55,
-                 momentum_ar=0.96)
 
 
 def main() -> None:
-    import jax
-
     from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
     from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
     from fmda_tpu.train import Trainer

@@ -244,3 +244,32 @@ def test_midsession_catchup_is_one_query():
     assert len(preds) == 1
     assert core.ticks_seen == n_rows
     assert calls == [n_rows]
+
+
+def test_batched_multiticker_serving_matches_per_ticker_cores():
+    """North-star serving composition: ONE carried-state core serves many
+    tickers per tick (batch dimension = tickers), with per-ticker norm
+    stats stacked as (B, F) arrays.  Each row's probabilities must equal
+    a dedicated single-ticker core fed the same stream."""
+    n_tickers, feats, window, ticks = 3, 6, 4, 7
+    cfg, params, _ = _uni_setup(feats=feats)
+    rng = np.random.default_rng(0)
+    # per-ticker normalization stats (different price scales)
+    mins = rng.normal(size=(n_tickers, feats)).astype(np.float32)
+    maxs = mins + rng.uniform(1.0, 5.0, size=(n_tickers, feats)).astype(
+        np.float32)
+    batched_norm = NormParams(mins, maxs)
+    batched = StreamingBiGRU(
+        cfg, params, batched_norm, window=window, batch=n_tickers)
+
+    singles = [
+        StreamingBiGRU(
+            cfg, params, NormParams(mins[t], maxs[t]), window=window)
+        for t in range(n_tickers)
+    ]
+    rows = rng.normal(size=(ticks, n_tickers, feats)).astype(np.float32)
+    for k in range(ticks):
+        probs_b = batched.step(rows[k])          # (n_tickers, 4)
+        for t in range(n_tickers):
+            probs_s = singles[t].step(rows[k, t])[0]
+            np.testing.assert_allclose(probs_b[t], probs_s, atol=1e-6)
