@@ -318,7 +318,18 @@ def write_results_md(r: dict, table: str) -> None:
         table,
         "",
     ]
+    from results_md import extract_section
+
     path = os.path.join(REPO, "RESULTS.md")
+    # carry over the seed-robustness section (parity_seeds.py maintains
+    # it; a single-run rewrite must not clobber multi-seed evidence)
+    try:
+        with open(path) as fh:
+            seed_section = extract_section(fh.read())
+        if seed_section:
+            lines += [seed_section, ""]
+    except FileNotFoundError:
+        pass
     with open(path, "w") as fh:
         fh.write("\n".join(lines))
     print(f"wrote {path}")

@@ -116,10 +116,19 @@ def append_md(rows, summary) -> None:
         + f".  (`experiments/parity_seeds.py`, {summary['wall_s']}s.)",
         "",
     ]
+    from results_md import replace_section
+
     path = os.path.join(REPO, "RESULTS.md")
-    with open(path, "a") as fh:
-        fh.write("\n".join(lines))
-    print(f"appended seed table to {path}")
+    # replace any existing seed section in place (re-runs must not
+    # accumulate stale conflicting tables, nor clobber sections after it)
+    try:
+        with open(path) as fh:
+            old = fh.read()
+    except FileNotFoundError:
+        old = ""
+    with open(path, "w") as fh:
+        fh.write(replace_section(old, "\n".join(lines).lstrip("\n")))
+    print(f"wrote seed table to {path}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,43 @@
+"""Shared RESULTS.md section splicing for the parity experiments.
+
+accuracy_parity.py rewrites the whole file from scratch and carries over
+ONLY the seed-robustness section parity_seeds.py maintains (any other
+hand-added section is rebuilt away — add new content to the generating
+scripts, not the file).  parity_seeds.py replaces just its own section in
+place, bounded at the NEXT "## " heading, so everything else survives
+its re-runs.
+"""
+
+from __future__ import annotations
+
+SEED_MARKER = "## Seed robustness"
+
+
+def _section_bounds(text: str, marker: str):
+    """(start, end) of the section opened by ``marker``, ending at the
+    next "## " heading (or EOF); None if absent."""
+    start = text.find(marker)
+    if start < 0:
+        return None
+    # end excludes the "\n" before the next heading so a replacement
+    # keeps the blank-line separator intact
+    nxt = text.find("\n## ", start + len(marker))
+    return start, len(text) if nxt < 0 else nxt
+
+
+def extract_section(text: str, marker: str = SEED_MARKER) -> str:
+    """The marker's section text ("" if absent), heading included."""
+    bounds = _section_bounds(text, marker)
+    if bounds is None:
+        return ""
+    return text[bounds[0]:bounds[1]].rstrip() + "\n"
+
+
+def replace_section(text: str, section: str, marker: str = SEED_MARKER) -> str:
+    """Return ``text`` with the marker's section replaced by ``section``
+    (appended at EOF if absent).  ``section`` must start with ``marker``."""
+    bounds = _section_bounds(text, marker)
+    if bounds is None:
+        return text.rstrip() + "\n\n" + section.rstrip() + "\n"
+    start, end = bounds
+    return text[:start] + section.rstrip() + "\n" + text[end:]

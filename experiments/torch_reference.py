@@ -75,8 +75,10 @@ def train_torch_reference(
 ) -> Dict:
     """Train the reference stack over the given ChunkDataset splits.
 
-    Returns {"history": {...}, "test": MultilabelMetrics-as-dict} computed
-    with fmda_tpu.ops.metrics on the concatenated test logits.
+    Returns {"history": {...}, "test": MultilabelMetrics-as-dict}:
+    fmda_tpu.ops.metrics computed per batch and averaged over the pass —
+    the reference's own protocol (biGRU_model.py:215-225, 273-286) and
+    the same accumulation the fmda_tpu trainer uses.
     """
     import torch
 
@@ -104,7 +106,13 @@ def train_torch_reference(
     def run_epoch(chunks: Sequence[int], train: bool) -> Tuple[float, Dict]:
         gru.train(train), linear.train(train), drop.train(train)
         losses: List[float] = []
-        all_logits, all_y = [], []
+        # Per-batch metrics averaged over the pass — the reference's own
+        # protocol (biGRU_model.py:215-225, 273-286 append sklearn scores
+        # per batch and np.mean them), and exactly how the fmda_tpu
+        # trainer accumulates (train/trainer.py _run_batches).  Pooling
+        # all logits first would inflate F-beta vs both (batch=2 makes
+        # many batches score 0/0 -> 0 per class).
+        accs, hams, fbetas = [], [], []
         if not len(chunks):
             return float("nan"), {"accuracy": float("nan"),
                                   "hamming": float("nan"), "fbeta": []}
@@ -122,13 +130,14 @@ def train_torch_reference(
                         logits = forward(gru, linear, drop, x, train=False)
                         loss = loss_fn(logits, y)
                 losses.append(float(loss))
-                all_logits.append(logits.detach().numpy())
-                all_y.append(y.numpy())
-        m = multilabel_metrics(
-            np.concatenate(all_logits), np.concatenate(all_y))
+                m = multilabel_metrics(logits.detach().numpy(), y.numpy())
+                accs.append(float(m.accuracy))
+                hams.append(float(m.hamming))
+                fbetas.append(np.asarray(m.fbeta))
         return float(np.mean(losses)), {
-            "accuracy": float(m.accuracy), "hamming": float(m.hamming),
-            "fbeta": [float(v) for v in np.asarray(m.fbeta)],
+            "accuracy": float(np.mean(accs)),
+            "hamming": float(np.mean(hams)),
+            "fbeta": [float(v) for v in np.mean(fbetas, axis=0)],
         }
 
     history: Dict[str, List[Dict]] = {"train": [], "val": []}

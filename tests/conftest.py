@@ -7,20 +7,30 @@ jax initialises a backend, hence module-level env mutation in conftest.
 
 import os
 
-# Tests always run on CPU (overriding any ambient accelerator platform) so
+# Tests normally run on CPU (overriding any ambient accelerator platform) so
 # the 8-device virtual mesh is available and numerics are deterministic.
 # jax may already be imported by the environment's sitecustomize, so set the
 # platform via jax.config (env vars alone would be read too late).
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# FMDA_TESTS_KEEP_PLATFORM=1 leaves the ambient backend alone so the
+# TPU-gated tests (test_pallas_gru.py::test_pallas_kernel_on_tpu_device)
+# can actually reach hardware — without it they skip unconditionally.
+# Strictly "1": only for running the TPU-gated tests in isolation (e.g.
+# test_pallas_gru.py::test_pallas_kernel_on_tpu_device); a full-suite run
+# with this set would hard-fail the 8-device mesh tests on a 1-chip backend.
+_KEEP_PLATFORM = os.environ.get("FMDA_TESTS_KEEP_PLATFORM", "") == "1"
+
+if not _KEEP_PLATFORM:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _KEEP_PLATFORM:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
