@@ -306,6 +306,125 @@ def phase_multiticker() -> dict:
     }
 
 
+def phase_train_e2e() -> dict:
+    """Compact end-to-end training on the ambient backend: synthetic
+    session replayed through bus -> engine -> warehouse, then the
+    reference protocol's chunked/normalized windows through the jitted
+    trainer (fit + test eval).  This is the 'trained on device' artifact
+    — the pipeline the accuracy-parity experiment runs for 25 epochs,
+    here at a bench-sized corpus/epoch count with throughput reported."""
+    import jax
+
+    from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+    from fmda_tpu.train.trainer import Trainer, imbalance_weights_from_source
+
+    fc = FeatureConfig()
+    t0 = time.perf_counter()
+    wh, _ = build_corpus(fc, SyntheticMarketConfig(seed=0, n_days=10))
+    corpus_s = time.perf_counter() - t0
+
+    model_cfg = ModelConfig(
+        hidden_size=HIDDEN, n_features=len(wh.x_fields), output_size=CLASSES,
+        dropout=0.5, spatial_dropout=True, use_pallas=True,
+    )
+    train_cfg = TrainConfig(
+        batch_size=32, window=WINDOW, chunk_size=100, learning_rate=1e-3,
+        epochs=4, clip=50.0, val_size=0.1, test_size=0.1, seed=0,
+    )
+    weight, pos_weight = imbalance_weights_from_source(wh)
+    trainer = Trainer(model_cfg, train_cfg, weight=weight,
+                      pos_weight=pos_weight)
+    t0 = time.perf_counter()
+    state, history, dataset = trainer.fit(
+        wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
+    fit_s = time.perf_counter() - t0
+    _, _, test_chunks = dataset.split(train_cfg.val_size, train_cfg.test_size)
+    test_m, _ = trainer.evaluate(state, dataset, test_chunks)
+
+    dev = jax.devices()[0]
+    tr = history["train"]
+    return {
+        "backend": jax.default_backend(),
+        "device_kind": dev.device_kind,
+        "corpus_rows": len(wh),
+        "corpus_build_s": round(corpus_s, 1),
+        "fit_wall_s": round(fit_s, 1),
+        "epochs": train_cfg.epochs,
+        "train_loss_first_last": [round(tr[0].loss, 4),
+                                  round(tr[-1].loss, 4)],
+        "final_train_accuracy": round(tr[-1].accuracy, 4),
+        "test_accuracy": round(float(test_m.accuracy), 4),
+        "test_hamming": round(float(test_m.hamming), 4),
+    }
+
+
+def phase_kernel_sweep() -> dict:
+    """Fused Pallas GRU kernel vs lax.scan across shapes, fwd+bwd through
+    jax.grad, best-of-3 windows — where does the kernel win and by how
+    much.  Only meaningful where the Mosaic kernel actually runs, so
+    skipped on CPU backends."""
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.ops.gru import gru_scan, pallas_scan_available
+    from fmda_tpu.ops.pallas_gru import gru_scan_pallas
+
+    if not pallas_scan_available():
+        return {"error": "skipped (Mosaic kernel unavailable on backend "
+                         f"'{jax.default_backend()}')"}
+
+    shapes = [(256, 30, 32), (256, 128, 64), (64, 256, 128), (16, 1024, 128)]
+    out: dict = {"backend": jax.default_backend(),
+                 "device_kind": jax.devices()[0].device_kind, "shapes": {}}
+
+    def timed(fn, args, iters=10):
+        fn(*args)[0].block_until_ready()  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = fn(*args)
+            jax.block_until_ready(r)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best
+
+    for batch, seq, hidden in shapes:
+        r = np.random.default_rng(0)
+        xp = jnp.asarray(
+            r.normal(size=(batch, seq, 3 * hidden)).astype(np.float32))
+        h0 = jnp.zeros((batch, hidden), jnp.float32)
+        w_hh = jnp.asarray(
+            r.normal(size=(3 * hidden, hidden)).astype(np.float32) * 0.1)
+        b_hh = jnp.zeros((3 * hidden,), jnp.float32)
+
+        def make(fn):
+            def loss(xp_, h0_, w, b):
+                h_last, hs = fn(xp_, h0_, w, b)
+                return jnp.sum(h_last**2) + jnp.sum(hs**2)
+
+            return jax.jit(jax.grad(loss, argnums=(0, 2)))
+
+        key = f"B{batch}_T{seq}_H{hidden}"
+        entry: dict = {}
+        # scan baseline first and in its own try: a kernel failure for a
+        # shape must not cost us that shape's reference number
+        try:
+            t_scan = timed(make(gru_scan), (xp, h0, w_hh, b_hh))
+            entry["scan_ms"] = round(t_scan * 1e3, 3)
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            entry["scan_error"] = str(e)[:300]
+        try:
+            t_pal = timed(make(gru_scan_pallas), (xp, h0, w_hh, b_hh))
+            entry["pallas_ms"] = round(t_pal * 1e3, 3)
+            if "scan_ms" in entry:
+                entry["speedup"] = round(t_scan / t_pal, 3)
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            entry["pallas_error"] = str(e)[:300]
+        out["shapes"][key] = entry
+    return out
+
+
 def phase_serving() -> dict:
     """Tick latency of the carried-state streaming cores on the flagship
     bidirectional model (north-star config 5: jit state-carry p50 tick
@@ -584,6 +703,8 @@ _PHASES = {
     # own phase (the headline stays the reference-matching f32 protocol)
     "flagship_bf16": lambda: phase_flagship(use_pallas=True, dtype="bfloat16"),
     "flagship_wide": phase_flagship_wide,
+    "train_e2e": phase_train_e2e,
+    "kernel_sweep": phase_kernel_sweep,
     "longctx": phase_longctx,
     "multiticker": phase_multiticker,
     "serving": phase_serving,
@@ -731,8 +852,10 @@ def _capture_tpu_evidence(probe: dict) -> int:
     for name, budget in [
         ("flagship_pallas", 600.0),
         ("flagship_scan", 600.0),
+        ("kernel_sweep", 900.0),
         ("flagship_bf16", 600.0),
         ("flagship_wide", 600.0),
+        ("train_e2e", 900.0),
         ("longctx", 900.0),
         ("multiticker", 600.0),
         ("serving", 600.0),
@@ -778,6 +901,8 @@ def main() -> None:
         ("serving", 300.0),
         ("flagship_bf16", 300.0),
         ("flagship_wide", 300.0),
+        ("train_e2e", 600.0),
+        ("kernel_sweep", 600.0),
     ]
     # phases that ignore the probed backend: torch is the CPU baseline by
     # definition; longctx_sp runs on the 8-device virtual CPU mesh (the
