@@ -860,8 +860,16 @@ def _capture_tpu_evidence(probe: dict) -> int:
         ("multiticker", 600.0),
         ("serving", 600.0),
     ]:
+        phase_env = env
+        if name == "flagship_pallas":
+            # an on-device XProf trace rides along with the first phase
+            # (utils.tracing.device_trace via FMDA_PROFILE_DIR)
+            phase_env = dict(env)
+            phase_env["FMDA_PROFILE_DIR"] = os.path.join(
+                _REPO_DIR, "artifacts", "profile_tpu")
         t0 = time.monotonic()
-        results["phases"][name] = _run_phase_subprocess(name, env, budget)
+        results["phases"][name] = _run_phase_subprocess(
+            name, phase_env, budget)
         results["phases"][name]["wall_s"] = round(time.monotonic() - t0, 1)
         _flush()
         print(f"phase {name}: {results['phases'][name]}", file=sys.stderr)
@@ -914,9 +922,9 @@ def main() -> None:
     phases: dict = {}
     on_cpu = probe_failed or probe.get("backend") == "cpu"
     for name, budget in plan:
-        if name == "flagship_wide" and on_cpu:
-            # MXU-ceiling probe only means something on an accelerator;
-            # on CPU the H=1024 step would just burn its whole timeout
+        if name in ("flagship_wide", "kernel_sweep") and on_cpu:
+            # accelerator-only probes (the phases self-skip too, but the
+            # inline guard saves the subprocess spawn + jax import)
             phases[name] = {"error": "skipped (no accelerator backend)"}
             continue
         remaining = deadline - time.monotonic()

@@ -28,7 +28,19 @@ from accuracy_parity import EPOCHS, MARKET_KW, N_DAYS, SEED  # noqa: E402
 MODEL_SEEDS = (0, 1, 2)
 
 
-def main() -> None:
+def _seeds_from_argv() -> tuple:
+    """--seeds 0,1,2,3,4 (default MODEL_SEEDS)."""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--seeds", default=MODEL_SEEDS,
+        type=lambda s: tuple(int(v) for v in s.split(",")),
+        help="comma-separated model seeds (default %(default)s)")
+    return tuple(parser.parse_args().seeds)
+
+
+def main(seeds=MODEL_SEEDS) -> None:
     import jax
 
     from fmda_tpu.config import FeatureConfig, ModelConfig, TrainConfig
@@ -50,7 +62,7 @@ def main() -> None:
     weight, pos_weight = imbalance_weights_from_source(wh)
 
     rows = []
-    for seed in MODEL_SEEDS:
+    for seed in seeds:
         train_cfg = TrainConfig(
             batch_size=2, window=30, chunk_size=100, learning_rate=1e-3,
             epochs=EPOCHS, clip=50.0, val_size=0.1, test_size=0.1, seed=seed,
@@ -80,7 +92,7 @@ def main() -> None:
     f_ham = np.array([r["fmda"]["hamming"] for r in rows])
     t_ham = np.array([r["torch"]["hamming"] for r in rows])
     summary = {
-        "seeds": list(MODEL_SEEDS),
+        "seeds": list(seeds),
         "fmda_accuracy": f"{f_acc.mean():.3f} ± {f_acc.std():.3f}",
         "torch_accuracy": f"{t_acc.mean():.3f} ± {t_acc.std():.3f}",
         "fmda_hamming": f"{f_ham.mean():.3f} ± {f_ham.std():.3f}",
@@ -135,4 +147,4 @@ if __name__ == "__main__":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    main()
+    main(_seeds_from_argv())
