@@ -329,6 +329,10 @@ class ModelConfig:
     dropout: float = 0.5
     spatial_dropout: bool = True
     bidirectional: bool = True
+    #: Recurrent cell family: "gru" (the reference's model) or "lstm"
+    #: (same head/protocol over fmda_tpu.ops.lstm — the torch user's
+    #: one-line nn.GRU -> nn.LSTM swap, kept one config knob here).
+    cell: str = "gru"
     #: Compute dtype for the GRU/head; params are kept in float32.
     dtype: str = "float32"
     #: Use the fused Pallas scan cell on TPU (falls back to lax.scan

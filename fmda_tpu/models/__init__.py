@@ -1,3 +1,19 @@
 from fmda_tpu.models.bigru import BiGRU, BiGRUState
+from fmda_tpu.models.bilstm import BiLSTM, BiLSTMState
 
-__all__ = ["BiGRU", "BiGRUState"]
+
+def build_model(cfg):
+    """The ``ModelConfig.cell`` -> module factory used by the Trainer,
+    the window-re-scan Predictor, and the backtester.  (The streaming
+    serving cores and the flagship entry points are GRU-specific and
+    construct :class:`BiGRU` directly.)"""
+    cells = {"gru": BiGRU, "lstm": BiLSTM}
+    if cfg.cell not in cells:
+        raise ValueError(
+            f"unknown ModelConfig.cell {cfg.cell!r}; expected one of "
+            f"{sorted(cells)}"
+        )
+    return cells[cfg.cell](cfg)
+
+
+__all__ = ["BiGRU", "BiGRUState", "BiLSTM", "BiLSTMState", "build_model"]

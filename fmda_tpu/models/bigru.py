@@ -30,6 +30,11 @@ import jax
 import jax.numpy as jnp
 
 from fmda_tpu.config import ModelConfig
+from fmda_tpu.models.common import (
+    _torch_uniform_init,
+    input_dropout,
+    pool_concat_logits,
+)
 from fmda_tpu.ops.gru import GRUWeights, gru_layer
 
 
@@ -37,13 +42,6 @@ class BiGRUState(NamedTuple):
     """Carried hidden state: (n_layers, n_directions, B, H)."""
 
     hidden: jax.Array
-
-
-def _torch_uniform_init(scale: float):
-    def init(key, shape, dtype=jnp.float32):
-        return jax.random.uniform(key, shape, dtype, minval=-scale, maxval=scale)
-
-    return init
 
 
 class BiGRU(nn.Module):
@@ -96,18 +94,11 @@ class BiGRU(nn.Module):
                 "carried BiGRUState requires bidirectional=False; "
                 "re-scan the full window for bidirectional models"
             )
-        batch, seq_len = x.shape[0], x.shape[1]
+        seq_len = x.shape[1]
         compute_dtype = jnp.dtype(cfg.dtype)
         x = x.astype(compute_dtype)
 
-        # Input dropout (biGRU_model.py:87-94): spatial variant zeroes whole
-        # feature channels across time (torch Dropout2d on (B, F, T)).
-        if cfg.spatial_dropout:
-            x = nn.Dropout(cfg.dropout, broadcast_dims=(1,))(
-                x, deterministic=deterministic
-            )
-        else:
-            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        x = input_dropout(cfg, x, deterministic=deterministic)
 
         layer_input = x
         final_hiddens = []  # (n_layers, n_dirs) of (B, H)
@@ -154,30 +145,13 @@ class BiGRU(nn.Module):
                 )
             layer_input = layer_output
 
-        # Head (biGRU_model.py:108-137).
+        # Head (biGRU_model.py:108-137), shared across cell families.
         last_hidden = jnp.sum(final_hiddens[-1], axis=0)  # sum directions (B, H)
         gru_out = fwd_out + bwd_out if n_dirs == 2 else fwd_out  # (B, T, H)
-
-        if mask is None:
-            max_pool = jnp.max(gru_out, axis=1)
-            avg_pool = jnp.sum(gru_out, axis=1) / jnp.asarray(
-                seq_len, dtype=compute_dtype
-            )
-        else:
-            m = mask[..., None].astype(compute_dtype)
-            neg = jnp.asarray(jnp.finfo(compute_dtype).min, compute_dtype)
-            max_pool = jnp.max(jnp.where(m > 0, gru_out, neg), axis=1)
-            denom = jnp.maximum(jnp.sum(m, axis=1), 1.0)
-            avg_pool = jnp.sum(gru_out * m, axis=1) / denom
-
-        concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)
-        logits = nn.Dense(
-            cfg.output_size,
-            name="linear",
-            kernel_init=_torch_uniform_init(1.0 / jnp.sqrt(3 * cfg.hidden_size)),
-            bias_init=_torch_uniform_init(1.0 / jnp.sqrt(3 * cfg.hidden_size)),
-        )(concat)
-        logits = logits.astype(jnp.float32)
+        logits = pool_concat_logits(
+            cfg, last_hidden, gru_out,
+            mask=mask, seq_len=seq_len, compute_dtype=compute_dtype,
+        )
 
         if return_state:
             return logits, BiGRUState(hidden=jnp.stack(final_hiddens))

@@ -1,0 +1,147 @@
+"""Bidirectional LSTM price-movement classifier (Flax).
+
+The second cell family behind ``ModelConfig(cell="lstm")``: identical
+architecture to :class:`fmda_tpu.models.bigru.BiGRU` — spatial input
+dropout, stacked optionally-bidirectional recurrence, the reference's
+pool-concat head (biGRU_model.py:108-137) — with the GRU scan swapped for
+:mod:`fmda_tpu.ops.lstm`.  The reference itself is GRU-only; this exists
+because the torch workflow it replaces is a one-argument ``nn.GRU`` ->
+``nn.LSTM`` swap, verified weight-for-weight against ``torch.nn.LSTM``
+in ``tests/test_lstm.py``.
+
+Parameter names mirror torch's ``nn.LSTM`` convention (``weight_ih_l0``,
+``bias_hh_l0_reverse``, ...) so checkpoints cross-load in tests.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.config import ModelConfig
+from fmda_tpu.models.common import (
+    _torch_uniform_init,
+    input_dropout,
+    pool_concat_logits,
+)
+from fmda_tpu.ops.lstm import LSTMWeights, lstm_layer
+
+
+class BiLSTMState(NamedTuple):
+    """Carried state: hidden and cell, each (n_layers, n_dirs, B, H)."""
+
+    hidden: jax.Array
+    cell: jax.Array
+
+
+class BiLSTM(nn.Module):
+    """See module docstring. ``cfg.n_features`` must be resolved."""
+
+    cfg: ModelConfig
+
+    def _direction_weights(
+        self, layer: int, reverse: bool, in_dim: int
+    ) -> LSTMWeights:
+        h = self.cfg.hidden_size
+        suffix = f"l{layer}" + ("_reverse" if reverse else "")
+        scale = 1.0 / jnp.sqrt(h)
+        return LSTMWeights(
+            w_ih=self.param(
+                f"weight_ih_{suffix}", _torch_uniform_init(scale), (4 * h, in_dim)
+            ),
+            w_hh=self.param(
+                f"weight_hh_{suffix}", _torch_uniform_init(scale), (4 * h, h)
+            ),
+            b_ih=self.param(
+                f"bias_ih_{suffix}", _torch_uniform_init(scale), (4 * h,)
+            ),
+            b_hh=self.param(
+                f"bias_hh_{suffix}", _torch_uniform_init(scale), (4 * h,)
+            ),
+        )
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        state: Optional[BiLSTMState] = None,
+        *,
+        deterministic: bool = True,
+        mask: Optional[jax.Array] = None,
+        return_state: bool = False,
+    ):
+        """Forward pass; same contract as :meth:`BiGRU.__call__`."""
+        cfg = self.cfg
+        assert cfg.n_features is not None, "ModelConfig.n_features unresolved"
+        n_dirs = 2 if cfg.bidirectional else 1
+        if state is not None and cfg.bidirectional:
+            raise ValueError(
+                "carried BiLSTMState requires bidirectional=False; "
+                "re-scan the full window for bidirectional models"
+            )
+        seq_len = x.shape[1]
+        compute_dtype = jnp.dtype(cfg.dtype)
+        x = x.astype(compute_dtype)
+
+        x = input_dropout(cfg, x, deterministic=deterministic)
+
+        layer_input = x
+        final_h = []  # (n_layers) of (n_dirs, B, H)
+        final_c = []
+        fwd_out = bwd_out = None
+        for layer in range(cfg.n_layers):
+            in_dim = cfg.n_features if layer == 0 else cfg.hidden_size * n_dirs
+            dir_outputs = []
+            layer_h = []
+            layer_c = []
+            for d in range(n_dirs):
+                reverse = d == 1
+                weights = self._direction_weights(layer, reverse, in_dim)
+                weights = LSTMWeights(
+                    *(w.astype(compute_dtype) for w in weights)
+                )
+                h0 = c0 = None
+                if state is not None:
+                    h0 = state.hidden[layer, d].astype(compute_dtype)
+                    c0 = state.cell[layer, d].astype(compute_dtype)
+                (h_last, c_last), hs = lstm_layer(
+                    layer_input,
+                    weights,
+                    h0,
+                    c0,
+                    reverse=reverse,
+                    mask=mask,
+                    remat=cfg.remat,
+                )
+                dir_outputs.append(hs)
+                layer_h.append(h_last)
+                layer_c.append(c_last)
+            final_h.append(jnp.stack(layer_h))
+            final_c.append(jnp.stack(layer_c))
+            fwd_out = dir_outputs[0]
+            bwd_out = dir_outputs[1] if n_dirs == 2 else None
+            layer_output = (
+                jnp.concatenate(dir_outputs, axis=-1) if n_dirs == 2 else fwd_out
+            )
+            if cfg.n_layers > 1 and layer < cfg.n_layers - 1:
+                layer_output = nn.Dropout(cfg.dropout)(
+                    layer_output, deterministic=deterministic
+                )
+            layer_input = layer_output
+
+        # Head: identical to BiGRU (biGRU_model.py:108-137), shared helper.
+        last_hidden = jnp.sum(final_h[-1], axis=0)  # (B, H)
+        lstm_out = fwd_out + bwd_out if n_dirs == 2 else fwd_out  # (B, T, H)
+        logits = pool_concat_logits(
+            cfg, last_hidden, lstm_out,
+            mask=mask, seq_len=seq_len, compute_dtype=compute_dtype,
+        )
+
+        if return_state:
+            return logits, BiLSTMState(
+                hidden=jnp.stack(final_h), cell=jnp.stack(final_c)
+            )
+        return logits

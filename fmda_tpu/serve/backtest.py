@@ -22,7 +22,7 @@ from fmda_tpu.config import ModelConfig, TARGET_COLUMNS
 from fmda_tpu.data.normalize import NormParams, normalize
 from fmda_tpu.data.source import FeatureSource
 from fmda_tpu.data.windows import window_index_matrix
-from fmda_tpu.models.bigru import BiGRU
+from fmda_tpu.models import build_model
 from fmda_tpu.ops.metrics import MultilabelMetrics, multilabel_metrics
 
 
@@ -63,7 +63,7 @@ def backtest(
     if hi > n or lo > hi:
         raise ValueError(f"id range [{lo}, {hi}] invalid for source of {n} rows")
 
-    model = BiGRU(model_cfg)
+    model = build_model(model_cfg)
     forward = jax.jit(lambda p, x: model.apply({"params": p}, x))
 
     # one gather covers all windows: rows [lo-window+1, hi]

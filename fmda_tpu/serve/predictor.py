@@ -31,7 +31,7 @@ import numpy as np
 
 from fmda_tpu.config import TARGET_COLUMNS, TOPIC_PREDICT_TIMESTAMP, TOPIC_PREDICTION, ModelConfig
 from fmda_tpu.data.normalize import NormParams
-from fmda_tpu.models.bigru import BiGRU
+from fmda_tpu.models import build_model
 from fmda_tpu.stream.bus import MessageBus
 from fmda_tpu.stream.warehouse import Warehouse
 from fmda_tpu.utils.timeutils import get_timezone, parse_ts
@@ -91,7 +91,7 @@ class Predictor:
         self._x_min = jnp.asarray(norm_params.x_min)
         self._x_range = jnp.asarray(norm_params.x_max - norm_params.x_min)
 
-        model = BiGRU(model_cfg)
+        model = build_model(model_cfg)
 
         def forward(params, x):
             x = (x - self._x_min) / self._x_range

@@ -72,6 +72,12 @@ class StreamingBiGRU:
         window: int,
         batch: int = 1,
     ) -> None:
+        if cfg.cell != "gru":
+            raise ValueError(
+                "the carried-state streaming cores are GRU-specific; use "
+                "the window-re-scan Predictor for ModelConfig.cell="
+                f"{cfg.cell!r}"
+            )
         if cfg.bidirectional:
             raise ValueError(
                 "carried-state streaming needs bidirectional=False; the "
@@ -165,6 +171,12 @@ class StreamingBiGRUBidirectional:
         window: int,
         batch: int = 1,
     ) -> None:
+        if cfg.cell != "gru":
+            raise ValueError(
+                "the carried-state streaming cores are GRU-specific; use "
+                "the window-re-scan Predictor for ModelConfig.cell="
+                f"{cfg.cell!r}"
+            )
         if not cfg.bidirectional:
             raise ValueError(
                 "use StreamingBiGRU for unidirectional models (pure O(1))")

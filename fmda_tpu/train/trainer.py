@@ -28,7 +28,7 @@ import optax
 from fmda_tpu.config import ModelConfig, TrainConfig
 from fmda_tpu.data.pipeline import Batch, ChunkDataset, WindowBatches, prefetch_to_device
 from fmda_tpu.data.source import FeatureSource
-from fmda_tpu.models.bigru import BiGRU
+from fmda_tpu.models import build_model
 from fmda_tpu.ops.metrics import multilabel_metrics
 from fmda_tpu.train.losses import class_weights, weighted_bce_with_logits
 
@@ -64,7 +64,7 @@ class Trainer:
     ) -> None:
         self.model_cfg = model_cfg
         self.train_cfg = train_cfg
-        self.model = BiGRU(model_cfg)
+        self.model = build_model(model_cfg)
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(train_cfg.clip),
             optax.adam(train_cfg.learning_rate),

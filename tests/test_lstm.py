@@ -1,0 +1,166 @@
+"""The LSTM cell family: weight-for-weight torch parity + trainer wiring.
+
+Mirrors tests/test_model.py for ``ModelConfig(cell="lstm")``: the torch
+oracle is ``nn.LSTM`` plus the reference's pool-concat head semantics
+(biGRU_model.py:102-138 — head identical across cell families).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fmda_tpu.config import ModelConfig, TrainConfig
+from fmda_tpu.data import ArraySource
+from fmda_tpu.models import BiGRU, BiLSTM, BiLSTMState, build_model
+from fmda_tpu.ops.lstm import LSTMWeights, lstm_layer
+from fmda_tpu.train import Trainer
+
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return t.detach().cpu().numpy()
+
+
+def make_params(lstm, linear, n_layers, bidirectional):
+    params = {}
+    n_dirs = 2 if bidirectional else 1
+    for layer in range(n_layers):
+        for d in range(n_dirs):
+            suffix = f"l{layer}" + ("_reverse" if d == 1 else "")
+            for name in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+                params[f"{name}_{suffix}"] = jnp.asarray(
+                    _np(getattr(lstm, f"{name}_{suffix}")))
+    params["linear"] = {
+        "kernel": jnp.asarray(_np(linear.weight).T),
+        "bias": jnp.asarray(_np(linear.bias)),
+    }
+    return {"params": params}
+
+
+def torch_head_forward(lstm, linear, x, hidden_size, n_layers, bidirectional):
+    batch, seq_len = x.shape[0], x.shape[1]
+    n_dirs = 2 if bidirectional else 1
+    out, (h_n, _) = lstm(x)
+    h_n = h_n.view(n_layers, n_dirs, batch, hidden_size)
+    last_hidden = torch.sum(h_n[-1], dim=0)
+    if bidirectional:
+        out = out[:, :, :hidden_size] + out[:, :, hidden_size:]
+    max_pool = torch.nn.functional.adaptive_max_pool1d(
+        out.permute(0, 2, 1), (1,)
+    ).view(batch, -1)
+    avg_pool = torch.sum(out, dim=1) / torch.FloatTensor([seq_len])
+    return linear(torch.cat([last_hidden, max_pool, avg_pool], dim=1))
+
+
+@pytest.mark.parametrize(
+    "n_layers,bidirectional", [(1, True), (1, False), (2, True)]
+)
+def test_bilstm_matches_torch(n_layers, bidirectional):
+    torch.manual_seed(0)
+    hidden, feats, out_size, batch, seq_len = 16, 12, 4, 3, 9
+
+    lstm = torch.nn.LSTM(
+        feats, hidden, num_layers=n_layers, batch_first=True,
+        bidirectional=bidirectional,
+    )
+    linear = torch.nn.Linear(hidden * 3, out_size)
+    xt = torch.randn(batch, seq_len, feats)
+    expected = torch_head_forward(
+        lstm, linear, xt, hidden, n_layers, bidirectional)
+
+    cfg = ModelConfig(
+        hidden_size=hidden, n_features=feats, output_size=out_size,
+        n_layers=n_layers, bidirectional=bidirectional, dropout=0.0,
+        cell="lstm",
+    )
+    model = BiLSTM(cfg)
+    variables = make_params(lstm, linear, n_layers, bidirectional)
+    logits = model.apply(variables, jnp.asarray(xt.numpy()))
+
+    np.testing.assert_allclose(np.asarray(logits), _np(expected), atol=1e-5)
+
+
+def test_build_model_dispatch():
+    cfg = ModelConfig(n_features=8)
+    assert isinstance(build_model(cfg), BiGRU)
+    assert isinstance(
+        build_model(ModelConfig(n_features=8, cell="lstm")), BiLSTM)
+    with pytest.raises(ValueError, match="unknown ModelConfig.cell"):
+        build_model(ModelConfig(n_features=8, cell="tcn"))
+
+
+def test_lstm_masked_steps_carry_state():
+    rng = np.random.default_rng(0)
+    batch, seq, feats, hidden = 2, 6, 5, 4
+    w = LSTMWeights(
+        w_ih=jnp.asarray(rng.normal(size=(4 * hidden, feats)), jnp.float32),
+        w_hh=jnp.asarray(rng.normal(size=(4 * hidden, hidden)), jnp.float32),
+        b_ih=jnp.zeros(4 * hidden), b_hh=jnp.zeros(4 * hidden),
+    )
+    x = jnp.asarray(rng.normal(size=(batch, seq, feats)), jnp.float32)
+    # valid prefix of 4 steps == full scan over the truncated sequence
+    mask = jnp.asarray([[1, 1, 1, 1, 0, 0]] * batch, jnp.float32) > 0
+    (h_m, c_m), hs_m = lstm_layer(x, w, mask=mask)
+    (h_t, c_t), _ = lstm_layer(x[:, :4], w)
+    np.testing.assert_allclose(np.asarray(h_m), np.asarray(h_t), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_m), np.asarray(c_t), atol=1e-6)
+    # masked tail repeats the last valid hidden
+    np.testing.assert_allclose(
+        np.asarray(hs_m[:, 4]), np.asarray(hs_m[:, 3]), atol=1e-6)
+
+
+def test_unidirectional_state_carry_matches_full_scan():
+    cfg = ModelConfig(
+        hidden_size=6, n_features=5, output_size=4, bidirectional=False,
+        dropout=0.0, cell="lstm",
+    )
+    model = BiLSTM(cfg)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 8, 5)), jnp.float32)
+    import jax
+
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x)
+    # full scan over 8 steps vs two carried chunks of 4: final states equal
+    _, full_state = model.apply(variables, x, return_state=True)
+    _, s1 = model.apply(variables, x[:, :4], return_state=True)
+    _, s2 = model.apply(
+        variables, x[:, 4:], BiLSTMState(s1.hidden, s1.cell),
+        return_state=True)
+    np.testing.assert_allclose(
+        np.asarray(s2.hidden), np.asarray(full_state.hidden), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(s2.cell), np.asarray(full_state.cell), atol=1e-5)
+
+
+def test_streaming_cores_reject_lstm_cell():
+    from fmda_tpu.data.normalize import NormParams
+    from fmda_tpu.serve import StreamingBiGRU, StreamingBiGRUBidirectional
+
+    norm = NormParams(np.zeros(5, np.float32), np.ones(5, np.float32))
+    uni = ModelConfig(hidden_size=4, n_features=5, bidirectional=False,
+                      cell="lstm")
+    with pytest.raises(ValueError, match="GRU-specific"):
+        StreamingBiGRU(uni, {}, norm, window=3)
+    bi = ModelConfig(hidden_size=4, n_features=5, cell="lstm")
+    with pytest.raises(ValueError, match="GRU-specific"):
+        StreamingBiGRUBidirectional(bi, {}, norm, window=3)
+
+
+def test_trainer_runs_lstm_cell():
+    rng = np.random.default_rng(2)
+    n, feats = 120, 6
+    fields = tuple(f"f{i}" for i in range(feats))
+    src = ArraySource(
+        rng.normal(size=(n, feats)).astype(np.float32),
+        (rng.uniform(size=(n, 4)) > 0.7).astype(np.float32),
+        fields,
+    )
+    cfg = ModelConfig(hidden_size=8, n_features=feats, output_size=4,
+                      dropout=0.1, cell="lstm")
+    trainer = Trainer(cfg, TrainConfig(
+        batch_size=8, window=10, chunk_size=60, epochs=2))
+    state, history, dataset = trainer.fit(src)
+    losses = [m.loss for m in history["train"]]
+    assert all(np.isfinite(losses))
+    assert isinstance(trainer.model, BiLSTM)
