@@ -158,8 +158,11 @@ def test_sp_forward_is_differentiable():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
-def test_dp_training_matches_single_device():
-    """Same data, same seed: DP-sharded trainer == single-device trainer."""
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_dp_training_matches_single_device(cell):
+    """Same data, same seed: DP-sharded trainer == single-device trainer.
+    Parametrized over the cell families — the dp path is model-agnostic
+    and must stay so."""
     from fmda_tpu.data import ArraySource
     from fmda_tpu.train import Trainer
 
@@ -169,7 +172,7 @@ def test_dp_training_matches_single_device():
     src = ArraySource(x, y, tuple(f"f{i}" for i in range(6)))
 
     model_cfg = ModelConfig(hidden_size=6, n_features=6, output_size=4,
-                            dropout=0.0, use_pallas=False)
+                            dropout=0.0, use_pallas=False, cell=cell)
     train_cfg = TrainConfig(batch_size=16, window=4, chunk_size=50, epochs=2)
 
     single = Trainer(model_cfg, train_cfg)
