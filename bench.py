@@ -102,6 +102,49 @@ def _mfu(flops_per_step: float, step_time_s: float, device_kind: str,
 # ---------------------------------------------------------------------------
 
 
+def _slope_time(window_fn, *, target_s: float = 2.5, repeats: int = 3) -> float:
+    """Steady-state per-step seconds, robust to remote-attached devices.
+
+    ``window_fn(n)`` must run n steps and end with a **host fetch** of some
+    step output.  Two window sizes are timed (best of ``repeats`` each) and
+    the per-step cost is the slope ``(t_hi - t_lo) / (hi - lo)`` — the
+    constant per-window cost (the axon tunnel's ~80 ms fetch RTT, dispatch
+    tails) cancels in the difference.  ``jax.block_until_ready`` is
+    deliberately not used as the barrier: on the tunnel backend it can
+    return before the device finishes, so only a value fetch is trusted.
+    Window sizes adapt so the large window covers ~``target_s`` of compute
+    (SNR against RTT jitter) without wasting minutes on slow backends.
+    A non-positive slope (jitter larger than the window delta) retries with
+    4x the window; if it persists, RuntimeError — never a silently absurd
+    throughput number.
+    """
+    # size the windows from a *slope* estimate too: window_fn(8)/8 alone is
+    # RTT-inflated on the tunnel, which would undersize hi by ~the RTT ratio
+    t8, t24 = window_fn(8), window_fn(24)
+    t1 = (t24 - t8) / 16 if t24 > t8 else max(t24 / 24, 1e-9)
+    hi = int(min(512, max(44, target_s / max(t1, 1e-9))))
+    if t1 > 0.25:
+        # slow (CPU-fallback) backend: jitter is negligible relative to the
+        # step itself, so shrink the windows/repeats instead of spending
+        # minutes inside a phase-subprocess budget
+        hi, repeats = 24, 1
+    tried = None
+    for _ in range(2):
+        lo = max(4, hi // 11)
+        t_lo = t_hi = float("inf")
+        for _ in range(max(1, repeats)):
+            t_lo = min(t_lo, window_fn(lo))
+            t_hi = min(t_hi, window_fn(hi))
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / (hi - lo)
+        tried = (lo, hi, t_lo, t_hi)
+        hi = min(4096, hi * 4)  # noise-dominated: widen and retry once
+    lo, hi, t_lo, t_hi = tried
+    raise RuntimeError(
+        f"slope timing noise-dominated: t_lo={t_lo:.4f}s t_hi={t_hi:.4f}s "
+        f"at windows ({lo}, {hi})")
+
+
 def _bench_train_step(
     *,
     batch: int,
@@ -111,7 +154,6 @@ def _bench_train_step(
     dtype: str = "float32",
     remat: bool = False,
     warmup: int = 3,
-    steps: int = 20,
     repeats: int = 3,
     hidden: int = HIDDEN,
 ) -> dict:
@@ -144,18 +186,27 @@ def _bench_train_step(
 
     for _ in range(warmup):
         state, loss, _ = trainer._train_step(state, b, rng)
-    jax.block_until_ready(loss)
+    float(loss)
 
-    # Best of `repeats` timing windows: a remote-attached device (the axon
-    # tunnel) adds tens of ms of jitter per round-trip, so a single short
-    # window can read 2x slow; the min window is the reproducible number.
-    elapsed = float("inf")
-    for _ in range(max(1, repeats)):
+    # Slope timing (see _slope_time): two window sizes, each ended by a
+    # host fetch; the constant fetch/RTT cost of the axon tunnel cancels
+    # in the difference.  jax.block_until_ready is NOT trusted here — on
+    # the tunnel-attached backend it can return before the device
+    # finishes (measured: 20 grad-of-scan windows at T=1024 "completing"
+    # in 0.5 ms), which both inflated short windows by the ~80 ms RTT
+    # and deflated unfetched ones to dispatch time.
+    holder = {"state": state}
+
+    def window_fn(n: int) -> float:
+        st = holder["state"]
         t0 = time.perf_counter()
-        for _ in range(steps):
-            state, loss, _ = trainer._train_step(state, b, rng)
-        jax.block_until_ready(loss)
-        elapsed = min(elapsed, time.perf_counter() - t0)
+        for _ in range(n):
+            st, loss_, _ = trainer._train_step(st, b, rng)
+        float(loss_)  # host fetch: the only trustworthy completion barrier
+        holder["state"] = st
+        return time.perf_counter() - t0
+
+    step_s = _slope_time(window_fn, repeats=max(1, repeats))
 
     # optional device profile (XProf trace) of a few post-measurement
     # steps: FMDA_PROFILE_DIR=/path python bench.py
@@ -163,19 +214,19 @@ def _bench_train_step(
     if profile_dir:
         from fmda_tpu.utils.tracing import device_trace, step_annotation
 
+        state = holder["state"]  # the pre-timing state's buffers were donated
         with device_trace(profile_dir):
             for i in range(3):
                 with step_annotation("bench_train_step", i):
                     state, loss, _ = trainer._train_step(state, b, rng)
-            jax.block_until_ready(loss)
+            float(loss)  # host fetch barrier (block_until_ready no-ops here)
 
     dev = jax.devices()[0]
-    step_s = elapsed / steps
     flops = model_flops_per_step(batch, window, features, hidden)
     mfu_est, mfu_peak = _mfu(flops, step_s, dev.device_kind,
                              jax.default_backend())
     result = {
-        "seq_s": round(batch * steps / elapsed, 1),
+        "seq_s": round(batch / step_s, 1),
         "step_ms": round(step_s * 1e3, 3),
         "backend": jax.default_backend(),
         "device_kind": dev.device_kind,
@@ -215,7 +266,7 @@ def phase_flagship_wide() -> dict:
     return _bench_train_step(
         batch=512, window=WINDOW, features=FEATURES,
         use_pallas=False, dtype="bfloat16", hidden=1024,
-        warmup=2, steps=10,
+        warmup=2,
     )
 
 
@@ -226,7 +277,7 @@ def phase_longctx() -> dict:
     features = len(FeatureConfig(bid_levels=10, ask_levels=10).x_fields())
     return _bench_train_step(
         batch=16, window=1024, features=features,
-        use_pallas=True, remat=True, warmup=2, steps=5,
+        use_pallas=True, remat=True, warmup=2,
     )
 
 
@@ -275,14 +326,14 @@ def phase_multiticker() -> dict:
 
     for b in staged[:2]:
         state, loss, _ = trainer._train_step(state, b, rng)
-    jax.block_until_ready(loss)
+    float(loss)
     steps = 0
     t0 = time.perf_counter()
     for _ in range(3):
         for b in staged:
             state, loss, _ = trainer._train_step(state, b, rng)
             steps += 1
-    jax.block_until_ready(loss)
+    float(loss)  # host fetch: trustworthy completion barrier on the tunnel
     elapsed = time.perf_counter() - t0
 
     dev = jax.devices()[0]
@@ -378,16 +429,21 @@ def phase_kernel_sweep() -> dict:
     out: dict = {"backend": jax.default_backend(),
                  "device_kind": jax.devices()[0].device_kind, "shapes": {}}
 
-    def timed(fn, args, iters=10):
-        fn(*args)[0].block_until_ready()  # compile + warm
-        best = float("inf")
-        for _ in range(3):
+    def timed(fn, args):
+        r = fn(*args)
+        float(r[0][(0,) * r[0].ndim])  # compile + warm; host fetch barrier
+
+        def window_fn(n):
             t0 = time.perf_counter()
-            for _ in range(iters):
+            for _ in range(n):
                 r = fn(*args)
-            jax.block_until_ready(r)
-            best = min(best, (time.perf_counter() - t0) / iters)
-        return best
+            # scalar host fetch: the device queue is FIFO, so fetching the
+            # last dispatch's value completes every prior one too (see
+            # _slope_time — block_until_ready is a no-op on the tunnel)
+            float(r[0][(0,) * r[0].ndim])
+            return time.perf_counter() - t0
+
+        return _slope_time(window_fn, target_s=1.5)
 
     for batch, seq, hidden in shapes:
         r = np.random.default_rng(0)
@@ -578,12 +634,12 @@ def phase_longctx_sp() -> dict:
             mesh, x_host, y_host, params0, opt_state)
         for _ in range(warmup):
             params_w, opt_w, loss = step(params, opt_state, x, y)
-        jax.block_until_ready(loss)
+        float(loss)
         t0 = time.perf_counter()
         p, o = params, opt_state
         for _ in range(steps):
             p, o, loss = step(p, o, x, y)
-        jax.block_until_ready(loss)
+        float(loss)  # host fetch barrier (uniform with the other phases)
         step_s = (time.perf_counter() - t0) / steps
         if m == 1:
             t_m1 = step_s

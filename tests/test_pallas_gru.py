@@ -104,6 +104,38 @@ def test_pallas_kernel_gradients_match(reverse):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.parametrize("reverse", [False, True])
+def test_pallas_kernel_multiblock_parity(reverse, monkeypatch):
+    """Cross-block state carry: force block_t < T so the grid hands h (fwd)
+    and dh/dwt/db (bwd) across several grid steps — the blocked path the
+    tiny default shapes never exercise (their whole T fits one block) —
+    and check outputs AND gradients against the scan, both directions."""
+    from fmda_tpu.ops import pallas_gru
+
+    monkeypatch.setattr(pallas_gru, "_default_block_t", lambda *a, **k: 3)
+    w, _, xp, _ = _setup(seq=12)  # 4 blocks of 3
+    h0 = jax.random.normal(jax.random.PRNGKey(7), (4, 8))
+
+    h_ref, hs_ref = gru_scan(xp, h0, w.w_hh, w.b_hh, reverse=reverse)
+    h_pal, hs_pal = gru_scan_pallas(
+        xp, h0, w.w_hh, w.b_hh, reverse=reverse, interpret=True)
+    np.testing.assert_allclose(np.asarray(h_pal), np.asarray(h_ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hs_pal), np.asarray(hs_ref), atol=1e-5)
+
+    def make_loss(fn, **kw):
+        def loss(xp_, h0_, w_hh, b_hh):
+            h_last, hs = fn(xp_, h0_, w_hh, b_hh, reverse=reverse, **kw)
+            return jnp.sum(h_last**2) + jnp.sum(jnp.sin(hs))
+        return loss
+
+    g_pal = jax.grad(make_loss(gru_scan_pallas, interpret=True),
+                     argnums=(0, 1, 2, 3))(xp, h0, w.w_hh, w.b_hh)
+    g_ref = jax.grad(make_loss(gru_scan),
+                     argnums=(0, 1, 2, 3))(xp, h0, w.w_hh, w.b_hh)
+    for a, b in zip(g_pal, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
 @pytest.mark.parametrize("reverse", [False, True])
 @pytest.mark.parametrize(
