@@ -329,10 +329,16 @@ class ModelConfig:
     dropout: float = 0.5
     spatial_dropout: bool = True
     bidirectional: bool = True
-    #: Recurrent cell family: "gru" (the reference's model) or "lstm"
-    #: (same head/protocol over fmda_tpu.ops.lstm — the torch user's
-    #: one-line nn.GRU -> nn.LSTM swap, kept one config knob here).
+    #: Sequence-core family: "gru" (the reference's model), "lstm" (same
+    #: head/protocol over fmda_tpu.ops.lstm — the torch user's one-line
+    #: nn.GRU -> nn.LSTM swap), or "attn" (temporal transformer encoder
+    #: over fmda_tpu.ops.attention, the ring-shardable long-context core).
     cell: str = "gru"
+    #: Attention heads for cell="attn"; must divide hidden_size.
+    n_heads: int = 4
+    #: Causal (streaming-safe) attention for cell="attn"; the default
+    #: mirrors the reference's bidirectional window encoder.
+    attn_causal: bool = False
     #: Compute dtype for the GRU/head; params are kept in float32.
     dtype: str = "float32"
     #: Use the fused Pallas scan cell on TPU (falls back to lax.scan

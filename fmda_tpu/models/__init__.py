@@ -1,3 +1,4 @@
+from fmda_tpu.models.attn import TemporalTransformer
 from fmda_tpu.models.bigru import BiGRU, BiGRUState
 from fmda_tpu.models.bilstm import BiLSTM, BiLSTMState
 
@@ -7,7 +8,7 @@ def build_model(cfg):
     the window-re-scan Predictor, and the backtester.  (The streaming
     serving cores and the flagship entry points are GRU-specific and
     construct :class:`BiGRU` directly.)"""
-    cells = {"gru": BiGRU, "lstm": BiLSTM}
+    cells = {"gru": BiGRU, "lstm": BiLSTM, "attn": TemporalTransformer}
     if cfg.cell not in cells:
         raise ValueError(
             f"unknown ModelConfig.cell {cfg.cell!r}; expected one of "
@@ -16,4 +17,7 @@ def build_model(cfg):
     return cells[cfg.cell](cfg)
 
 
-__all__ = ["BiGRU", "BiGRUState", "BiLSTM", "BiLSTMState", "build_model"]
+__all__ = [
+    "BiGRU", "BiGRUState", "BiLSTM", "BiLSTMState",
+    "TemporalTransformer", "build_model",
+]

@@ -1,0 +1,123 @@
+"""Temporal transformer price-movement classifier (Flax) — the attention
+model family, ``ModelConfig(cell="attn")``.
+
+The reference has exactly one model, a torch biGRU over sliding feature
+windows (biGRU_model.py:8-138).  This family keeps the reference's
+*protocol* — spatial input dropout (biGRU_model.py:87-94), a sequence
+core, and the pool-concat head into ``Dense(3H -> n_classes)``
+(biGRU_model.py:108-137, shared via :mod:`fmda_tpu.models.common` with
+the GRU/LSTM families) — but swaps the recurrence for a pre-LN
+transformer encoder over :mod:`fmda_tpu.ops.attention`:
+
+- Dense embed (F -> H) + sinusoidal positions (parameter-free, so train
+  window 30 and serving window 5 share one checkpoint — the reference
+  ships that very inconsistency, predict.py:71 vs notebook cell 11);
+- ``n_layers`` blocks of pre-LN multi-head attention and a GELU MLP
+  (H -> 4H -> H), residual dropout on both;
+- the head treats the final LN output as the per-step sequence ("out_sum"
+  in GRU terms) and the last *valid* position as the final hidden.
+
+Why it earns its place TPU-side: attention is all batched matmuls (MXU
+food, no serial scan), and the same online-softmax primitive runs
+ring-sharded over the sp mesh axis for long context
+(:mod:`fmda_tpu.parallel.ring_attention`) where the GRU's sequence
+parallelism is stage-serial.  ``attn_causal=True`` makes every position's
+logits independent of its future, the streaming-serving-safe variant.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.config import ModelConfig
+from fmda_tpu.models.common import input_dropout, pool_concat_logits
+from fmda_tpu.ops.attention import merge_heads, mha, split_heads
+
+
+def sinusoidal_positions(seq_len: int, dim: int, dtype) -> jax.Array:
+    """Parameter-free (T, dim) position encoding (interleaved sin/cos)."""
+    pos = jnp.arange(seq_len, dtype=jnp.float32)[:, None]
+    half = (dim + 1) // 2
+    freq = jnp.exp(-jnp.log(10000.0) * jnp.arange(half, dtype=jnp.float32)
+                   / max(half, 1))
+    ang = pos * freq[None, :]
+    enc = jnp.zeros((seq_len, dim), jnp.float32)
+    enc = enc.at[:, 0::2].set(jnp.sin(ang)[:, : (dim + 1) // 2])
+    enc = enc.at[:, 1::2].set(jnp.cos(ang)[:, : dim // 2])
+    return enc.astype(dtype)
+
+
+class TemporalTransformer(nn.Module):
+    """See module docstring. ``cfg.n_features`` must be resolved."""
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        deterministic: bool = True,
+        mask: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        cfg = self.cfg
+        assert cfg.n_features is not None, "ModelConfig.n_features unresolved"
+        h, n_heads = cfg.hidden_size, cfg.n_heads
+        if h % n_heads != 0:
+            raise ValueError(
+                f"n_heads={n_heads} must divide hidden_size={h}")
+        seq_len = x.shape[1]
+        compute_dtype = jnp.dtype(cfg.dtype)
+        x = x.astype(compute_dtype)
+
+        x = input_dropout(cfg, x, deterministic=deterministic)
+        x = nn.Dense(h, dtype=compute_dtype, name="embed")(x)
+        x = x + sinusoidal_positions(seq_len, h, compute_dtype)[None]
+
+        # keys outside the validity mask are invisible to every query; a
+        # fully-padded row yields zeros (online-softmax l=0 guard) and is
+        # excluded by the pooling mask below
+        attn_mask = None
+        if mask is not None:
+            attn_mask = (mask > 0)[:, None, None, :]
+
+        for layer in range(cfg.n_layers):
+            y = nn.LayerNorm(dtype=compute_dtype, name=f"ln_attn_{layer}")(x)
+            qkv = nn.Dense(3 * h, dtype=compute_dtype,
+                           name=f"qkv_{layer}")(y)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            out = mha(
+                split_heads(q, n_heads),
+                split_heads(k, n_heads),
+                split_heads(v, n_heads),
+                causal=cfg.attn_causal,
+                mask=attn_mask,
+            )
+            out = nn.Dense(h, dtype=compute_dtype,
+                           name=f"proj_{layer}")(merge_heads(out))
+            x = x + nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+
+            y = nn.LayerNorm(dtype=compute_dtype, name=f"ln_mlp_{layer}")(x)
+            y = nn.Dense(4 * h, dtype=compute_dtype, name=f"mlp_in_{layer}")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(h, dtype=compute_dtype, name=f"mlp_out_{layer}")(y)
+            x = x + nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+
+        x = nn.LayerNorm(dtype=compute_dtype, name="ln_final")(x)
+
+        if mask is None:
+            last_hidden = x[:, -1]
+        else:
+            # last valid position per row (the GRU's h_last analogue)
+            idx = jnp.maximum(
+                jnp.sum((mask > 0).astype(jnp.int32), axis=1) - 1, 0)
+            last_hidden = jnp.take_along_axis(
+                x, idx[:, None, None], axis=1)[:, 0]
+        return pool_concat_logits(
+            cfg, last_hidden, x,
+            mask=mask, seq_len=seq_len, compute_dtype=compute_dtype,
+        )

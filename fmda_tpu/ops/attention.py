@@ -1,0 +1,148 @@
+"""Multi-head self-attention ops, written blockwise so the same math runs
+single-device or ring-sharded over the ``sp`` mesh axis.
+
+The reference has no attention anywhere — its one model is a torch
+``nn.GRU`` (biGRU_model.py:54-56) and its long-context story is "make the
+sliding window longer" (sql_pytorch_dataloader.py:8-18).  Attention is the
+framework's second long-context path: where the GRU's sequence parallelism
+is inherently serial across time shards (parallel/seq_parallel.py — the
+carry must travel the ring), attention over the same windows has NO serial
+dependency, so sequence shards compute concurrently and only the K/V blocks
+travel the ring (parallel/ring_attention.py).
+
+Everything is built from one primitive, :func:`online_attention_block`:
+a numerically-stable streaming-softmax accumulation step (the flash/ring
+attention recurrence).  Computing attention over K/V blocks b = 1..n::
+
+    m_b = max(m_{b-1}, rowmax(S_b))                 # running max
+    l_b = l_{b-1} * exp(m_{b-1} - m_b) + rowsum(exp(S_b - m_b))
+    o_b = o_{b-1} * exp(m_{b-1} - m_b) + exp(S_b - m_b) @ V_b
+
+and ``o_n / l_n`` equals softmax(S) @ V exactly (in exact arithmetic) no
+matter how the key axis was blocked — which is precisely what lets the
+ring pass blocks around devices and still match the single-device result.
+All accumulation is float32 regardless of the I/O dtype; logits are scaled
+by 1/sqrt(d_head).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class OnlineSoftmaxState(NamedTuple):
+    """Running streaming-softmax accumulators, all float32.
+
+    Shapes (B = batch, Tq = local query length, N = heads, D = d_head):
+    ``m``: (B, N, Tq) running row max; ``l``: (B, N, Tq) running row sum;
+    ``o``: (B, N, Tq, D) unnormalized output accumulator.
+    """
+
+    m: jax.Array
+    l: jax.Array
+    o: jax.Array
+
+
+def init_online_state(
+    batch: int, n_heads: int, q_len: int, d_head: int
+) -> OnlineSoftmaxState:
+    return OnlineSoftmaxState(
+        m=jnp.full((batch, n_heads, q_len), -jnp.inf, jnp.float32),
+        l=jnp.zeros((batch, n_heads, q_len), jnp.float32),
+        o=jnp.zeros((batch, n_heads, q_len, d_head), jnp.float32),
+    )
+
+
+def online_attention_block(
+    state: OnlineSoftmaxState,
+    q: jax.Array,  # (B, N, Tq, D)
+    k: jax.Array,  # (B, N, Tk, D)
+    v: jax.Array,  # (B, N, Tk, D)
+    mask: Optional[jax.Array] = None,  # (Tq, Tk) or (B, 1|N, Tq, Tk), True=keep
+) -> OnlineSoftmaxState:
+    """Fold one K/V block into the running softmax state.
+
+    The QK^T matmul runs on the MXU in the input dtype with f32
+    accumulation; everything after is f32 VPU work.  Fully-masked rows are
+    safe: the running max stays finite only once a row sees a real key, and
+    :func:`finalize_online_state` guards the l=0 case.
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum(
+        "bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+
+    m_new = jnp.maximum(state.m, jnp.max(s, axis=-1))
+    # rows that have seen no unmasked key yet keep m=-inf; exp(-inf - -inf)
+    # is nan, so pin the correction for those rows to 0
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    corr = jnp.where(
+        jnp.isneginf(state.m), 0.0, jnp.exp(state.m - m_safe))
+    p = jnp.exp(jnp.where(jnp.isneginf(s), -jnp.inf, s - m_safe[..., None]))
+    l_new = state.l * corr + jnp.sum(p, axis=-1)
+    o_new = state.o * corr[..., None] + jnp.einsum(
+        "bnqk,bnkd->bnqd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return OnlineSoftmaxState(m=m_new, l=l_new, o=o_new)
+
+
+def finalize_online_state(
+    state: OnlineSoftmaxState, dtype
+) -> jax.Array:
+    """Normalize the accumulator into attention output (B, N, Tq, D).
+    Rows that saw only masked keys (l == 0) come out as zeros."""
+    l = jnp.where(state.l == 0.0, 1.0, state.l)
+    return (state.o / l[..., None]).astype(dtype)
+
+
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Single-device multi-head attention via the same online-softmax
+    primitive the ring path uses (one block = the whole key axis), so the
+    sharded and unsharded paths are the *same numerics* by construction.
+
+    Args:
+      q, k, v: (B, N, T, D).
+      causal: apply a lower-triangular causal mask (needed for streaming
+        serving where position t must not see the future).
+      mask: optional extra mask, (Tq, Tk) or broadcastable (B, N, Tq, Tk).
+
+    Returns (B, N, Tq, D) in q's dtype.
+    """
+    tq, tk = q.shape[-2], k.shape[-2]
+    full_mask = None
+    if causal:
+        # suffix alignment: query i sits at global position tk - tq + i, so
+        # a short query block against a longer K/V history (streaming) sees
+        # its full past, not just the first i keys
+        q_pos = tk - tq + jnp.arange(tq)
+        full_mask = q_pos[:, None] >= jnp.arange(tk)[None, :]
+    if mask is not None:
+        full_mask = mask if full_mask is None else (full_mask & mask)
+    state = init_online_state(q.shape[0], q.shape[1], tq, q.shape[-1])
+    state = online_attention_block(state, q, k, v, full_mask)
+    return finalize_online_state(state, q.dtype)
+
+
+def split_heads(x: jax.Array, n_heads: int) -> jax.Array:
+    """(B, T, N*D) -> (B, N, T, D)."""
+    b, t, nd = x.shape
+    return x.reshape(b, t, n_heads, nd // n_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jax.Array) -> jax.Array:
+    """(B, N, T, D) -> (B, T, N*D)."""
+    b, n, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, n * d)

@@ -1,0 +1,217 @@
+"""Ring attention: sequence-parallel attention over the ``sp`` mesh axis.
+
+The second long-context path (the task the reference solves by growing its
+sliding window on one device, sql_pytorch_dataloader.py:8-18).  Where the
+GRU's sequence parallelism is serial across time shards — the recurrent
+carry must travel the ring stage by stage (seq_parallel.py) — attention
+has no serial dependency: every device computes its query block's
+attention concurrently, and only the K/V blocks travel the ring.
+
+Protocol (inside ``shard_map`` over the ``sp`` axis): each device holds a
+(B, N, T/sp, D) time shard of Q, K, V.  For ``sp`` steps, fold the
+currently-held K/V block into the online-softmax accumulator
+(:func:`fmda_tpu.ops.attention.online_attention_block`) and rotate K/V to
+the ring neighbor via ``ppermute`` over ICI.  Because the streaming
+softmax is exact under any key-axis blocking, the result is bit-for-bit
+the same *math* as single-device :func:`fmda_tpu.ops.attention.mha` —
+locked by tests/test_ring_attention.py on the 8-device CPU mesh.
+
+The compute/communication structure overlaps naturally: XLA schedules the
+next block's ppermute alongside the current block's matmuls.  Causal
+masking uses global positions derived from ``axis_index`` and the
+rotation step; fully-masked blocks still run their (masked) matmul —
+at sp <= 8 the skip is not worth a per-step ``lax.cond`` barrier.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fmda_tpu.ops.attention import (
+    finalize_online_state,
+    init_online_state,
+    merge_heads,
+    online_attention_block,
+    split_heads,
+)
+from fmda_tpu.parallel.collectives import all_gather, all_reduce_sum, ring_shift
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Sequence-sharded attention (call inside shard_map).
+
+    Args:
+      q, k, v: this device's time shard, (B, N, T_local, D); the global
+        sequence is the concatenation of shards in mesh-axis order.
+      axis_name: the sp mesh axis the sequence is sharded over.
+      causal: apply the causal mask in *global* positions.
+
+    Returns this device's output shard (B, N, T_local, D), in q's dtype.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    batch, n_heads, t_local, d_head = q.shape
+
+    state = init_online_state(batch, n_heads, t_local, d_head)
+    k_blk, v_blk = k, v
+    # ring step s hands us the K/V block owned by device (idx - s) mod n
+    for s in range(n):  # static: mesh size known at trace time
+        owner = (idx - s) % n
+        mask: Optional[jax.Array] = None
+        if causal:
+            q_pos = idx * t_local + jnp.arange(t_local)
+            k_pos = owner * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        state = online_attention_block(state, q, k_blk, v_blk, mask)
+        if s < n - 1:
+            k_blk = ring_shift(k_blk, axis_name)
+            v_blk = ring_shift(v_blk, axis_name)
+    return finalize_online_state(state, q.dtype)
+
+
+def _layer_norm(x: jax.Array, p, eps: float = 1e-6) -> jax.Array:
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def sp_attn_apply(
+    params,
+    x_local: jax.Array,
+    cfg,
+    axis_name: str,
+    seq_len: int,
+) -> jax.Array:
+    """Sequence-sharded :class:`~fmda_tpu.models.attn.TemporalTransformer`
+    forward (shard_map body): embed/LN/MLP run on the local time block,
+    attention runs as :func:`ring_attention`, the pool-concat head reduces
+    locally then crosses the axis — matches ``TemporalTransformer.apply``
+    (deterministic mode) on the full window, locked by
+    tests/test_ring_attention.py.
+
+    ``params`` is the module's ``params['params']`` tree, replicated.
+    """
+    from fmda_tpu.models.attn import sinusoidal_positions
+
+    h, n_heads = cfg.hidden_size, cfg.n_heads
+    compute_dtype = jnp.dtype(cfg.dtype)
+    idx = jax.lax.axis_index(axis_name)
+    t_local = x_local.shape[1]
+
+    x = x_local.astype(compute_dtype)
+    x = x @ params["embed"]["kernel"] + params["embed"]["bias"]
+    pos = sinusoidal_positions(seq_len, h, compute_dtype)
+    pos_local = jax.lax.dynamic_slice_in_dim(pos, idx * t_local, t_local)
+    x = x + pos_local[None]
+
+    for layer in range(cfg.n_layers):
+        y = _layer_norm(x, params[f"ln_attn_{layer}"])
+        qkv = y @ params[f"qkv_{layer}"]["kernel"] \
+            + params[f"qkv_{layer}"]["bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        out = ring_attention(
+            split_heads(q, n_heads),
+            split_heads(k, n_heads),
+            split_heads(v, n_heads),
+            axis_name,
+            causal=cfg.attn_causal,
+        )
+        out = merge_heads(out) @ params[f"proj_{layer}"]["kernel"] \
+            + params[f"proj_{layer}"]["bias"]
+        x = x + out
+
+        y = _layer_norm(x, params[f"ln_mlp_{layer}"])
+        y = y @ params[f"mlp_in_{layer}"]["kernel"] \
+            + params[f"mlp_in_{layer}"]["bias"]
+        y = jax.nn.gelu(y)
+        y = y @ params[f"mlp_out_{layer}"]["kernel"] \
+            + params[f"mlp_out_{layer}"]["bias"]
+        x = x + y
+
+    x = _layer_norm(x, params["ln_final"])
+
+    # head across the sharded time axis (same collective structure as
+    # seq_parallel.sp_bigru_apply): the global last position lives on the
+    # last sp shard; max/avg pool reduce locally then cross the axis
+    n = jax.lax.axis_size(axis_name)
+    last_local = x[:, -1]
+    last_hidden = all_reduce_sum(
+        jnp.where(idx == n - 1, last_local, jnp.zeros_like(last_local)),
+        axis_name,
+    )
+    local_max = jnp.max(x, axis=1)
+    max_pool = jnp.max(all_gather(local_max, axis_name, axis=0), axis=0)
+    sum_pool = all_reduce_sum(jnp.sum(x, axis=1), axis_name)
+    avg_pool = sum_pool / jnp.asarray(seq_len, x.dtype)
+
+    concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)
+    dense = params["linear"]
+    logits = concat @ dense["kernel"] + dense["bias"]
+    return logits.astype(jnp.float32)
+
+
+def make_attn_sp_forward(
+    mesh: Mesh,
+    cfg,
+    seq_len: int,
+    *,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+):
+    """Jit-ready sequence-parallel transformer forward over a (dp, sp)
+    mesh: x (B, T, F) sharded (dp, sp), params replicated, logits (B, C)
+    sharded over dp only — the attention twin of
+    :func:`fmda_tpu.parallel.seq_parallel.make_sp_forward`."""
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(dp_axis, sp_axis)),
+        out_specs=P(dp_axis),
+        # the head's psum/all_gather leave the logits replicated over sp,
+        # but the static vma checker can't prove it through jnp.where mixes
+        check_vma=False,
+    )
+    def forward(params, x_local):
+        return sp_attn_apply(params, x_local, cfg, sp_axis, seq_len)
+
+    return forward
+
+
+def make_ring_attention(
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    batch_axis: Optional[str] = "dp",
+    causal: bool = False,
+):
+    """Wire :func:`ring_attention` into a jittable function over the mesh.
+
+    Returns ``fn(q, k, v) -> out`` taking/returning GLOBAL (B, N, T, D)
+    arrays; the time axis is sharded over ``axis_name`` (and batch over
+    ``batch_axis`` when that axis exists in the mesh), K/V ride the ring.
+    """
+    b_axis = batch_axis if batch_axis in mesh.axis_names else None
+    spec = P(b_axis, None, axis_name, None)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name, causal=causal)
+
+    return fn

@@ -36,11 +36,26 @@ def make_sp_train_step(
 ):
     """Returns ``step(params, opt_state, x, y) -> (params, opt_state, loss)``
     jitted over the mesh.  ``n_microbatches > 1`` runs the bubble-filling
-    pipelined recurrence (per-dp-shard batch must be divisible by it)."""
-    forward = make_sp_forward(
-        mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
-        n_microbatches=n_microbatches,
-    )
+    pipelined recurrence (per-dp-shard batch must be divisible by it).
+
+    ``model_cfg.cell`` picks the sequence core: the GRU's staged/pipelined
+    carry-handoff scan, or (``"attn"``) the temporal transformer whose
+    attention runs as a K/V ring (fmda_tpu.parallel.ring_attention) —
+    same mesh, same shardings, different collective program."""
+    if model_cfg.cell == "attn":
+        from fmda_tpu.parallel.ring_attention import make_attn_sp_forward
+
+        if n_microbatches != 1:
+            raise ValueError(
+                "n_microbatches applies only to the recurrent cells: the "
+                "ring-attention program has no pipeline bubble to fill")
+        forward = make_attn_sp_forward(
+            mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis)
+    else:
+        forward = make_sp_forward(
+            mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
+            n_microbatches=n_microbatches,
+        )
     if model_cfg.remat:
         # long-context windows: recompute the forward in the backward pass
         # instead of keeping every per-step hidden alive (HBM is the
