@@ -84,6 +84,22 @@ def model_flops_per_step(batch: int, seq: int, features: int, hidden: int) -> fl
     return 3.0 * fwd
 
 
+def attn_flops_per_step(batch: int, seq: int, features: int, hidden: int,
+                        n_layers: int = 1) -> float:
+    """Analytic matmul FLOPs of one temporal-transformer train step:
+    embed + per-layer (qkv, QK^T, AV, proj, 4x MLP) + head; train ~= 3x
+    forward.  The T^2 terms are the attention scores/values (all heads
+    together contract to 2*B*T*T*H each)."""
+    per_layer = (2 * batch * seq * hidden * 3 * hidden
+                 + 2 * batch * seq * seq * hidden * 2
+                 + 2 * batch * seq * hidden * hidden
+                 + 2 * batch * seq * hidden * 4 * hidden * 2)
+    fwd = (2 * batch * seq * features * hidden
+           + n_layers * per_layer
+           + 2 * batch * 3 * hidden * CLASSES)
+    return 3.0 * fwd
+
+
 def _mfu(flops_per_step: float, step_time_s: float, device_kind: str,
          backend: str = ""):
     """(mfu_estimate, peak_key) — never silently null on a live TPU."""
@@ -156,6 +172,7 @@ def _bench_train_step(
     warmup: int = 3,
     repeats: int = 3,
     hidden: int = HIDDEN,
+    cell: str = "gru",
 ) -> dict:
     import jax
     import jax.numpy as jnp
@@ -168,7 +185,7 @@ def _bench_train_step(
     model_cfg = ModelConfig(
         hidden_size=hidden, n_features=features, output_size=CLASSES,
         dropout=0.5, spatial_dropout=True, use_pallas=use_pallas,
-        dtype=dtype, remat=remat,
+        dtype=dtype, remat=remat, cell=cell,
     )
     train_cfg = TrainConfig(batch_size=batch, window=window)
     weight = np.full(CLASSES, 2.0, np.float32)
@@ -222,7 +239,10 @@ def _bench_train_step(
             float(loss)  # host fetch barrier (block_until_ready no-ops here)
 
     dev = jax.devices()[0]
-    flops = model_flops_per_step(batch, window, features, hidden)
+    if cell == "attn":
+        flops = attn_flops_per_step(batch, window, features, hidden)
+    else:
+        flops = model_flops_per_step(batch, window, features, hidden)
     mfu_est, mfu_peak = _mfu(flops, step_s, dev.device_kind,
                              jax.default_backend())
     result = {
@@ -236,6 +256,7 @@ def _bench_train_step(
         "mfu_est": mfu_est,
         "mfu_peak": mfu_peak,
         "shape": {"B": batch, "T": window, "F": features, "H": hidden},
+        "cell": cell,
     }
     if profile_dir:
         result["profile_dir"] = profile_dir
@@ -278,6 +299,20 @@ def phase_longctx() -> dict:
     return _bench_train_step(
         batch=16, window=1024, features=features,
         use_pallas=True, remat=True, warmup=2,
+    )
+
+
+def phase_longctx_attn() -> dict:
+    """Long-context via the attention family (cell="attn"): same
+    seq-1024 windows as phase_longctx but through the temporal
+    transformer — all batched matmuls, no serial scan; the single-device
+    twin of the ring-attention sp path."""
+    from fmda_tpu.config import FeatureConfig
+
+    features = len(FeatureConfig(bid_levels=10, ask_levels=10).x_fields())
+    return _bench_train_step(
+        batch=16, window=1024, features=features,
+        use_pallas=False, remat=True, warmup=2, cell="attn",
     )
 
 
@@ -624,23 +659,29 @@ def phase_longctx_sp() -> dict:
         "mesh": f"dp={dp} sp={sp}", "remat": True,
         "shape": {"B": batch, "T": seq, "F": features, "H": HIDDEN},
     }
-    steps, warmup = 4, 1
-    t_m1 = None
-    for m in (1, 2, 4):
-        step = make_sp_train_step(
-            mesh, cfg, seq, optimizer, n_microbatches=m)
+    steps = 4
+
+    def time_step(step, params0, warmup=1):
+        # one shared timing discipline for every program in this phase:
+        # warmup, fetch barrier, timed steps, fetch barrier (the CPU mesh
+        # has no tunnel RTT, so plain window timing is sufficient here)
         opt_state = optimizer.init(params0)
-        x, y, params, opt_state = shard_train_inputs(
+        x, y, p, o = shard_train_inputs(
             mesh, x_host, y_host, params0, opt_state)
         for _ in range(warmup):
-            params_w, opt_w, loss = step(params, opt_state, x, y)
+            _, _, loss = step(p, o, x, y)
         float(loss)
         t0 = time.perf_counter()
-        p, o = params, opt_state
         for _ in range(steps):
             p, o, loss = step(p, o, x, y)
-        float(loss)  # host fetch barrier (uniform with the other phases)
-        step_s = (time.perf_counter() - t0) / steps
+        float(loss)
+        return (time.perf_counter() - t0) / steps, float(loss)
+
+    t_m1 = None
+    for m in (1, 2, 4):
+        step_s, loss = time_step(
+            make_sp_train_step(mesh, cfg, seq, optimizer, n_microbatches=m),
+            params0)
         if m == 1:
             t_m1 = step_s
         out[f"M{m}"] = {
@@ -652,8 +693,26 @@ def phase_longctx_sp() -> dict:
             # sp*M/(sp+M-1) over M=1 (the scan only; the projection and
             # backward dilute it in the full-step number)
             "model_speedup": round(sp * m / (sp + m - 1), 3),
-            "loss": round(float(loss), 4),
+            "loss": round(loss, 4),
         }
+
+    # the ring-attention program on the same mesh/shapes: no serial carry,
+    # so its step time is the comparison point for the recurrent pipeline
+    from fmda_tpu.models import build_model
+
+    attn_cfg = ModelConfig(
+        hidden_size=HIDDEN, n_features=features, output_size=CLASSES,
+        dropout=0.0, spatial_dropout=False, cell="attn", remat=True,
+    )
+    attn_params0 = build_model(attn_cfg).init(
+        {"params": jax.random.PRNGKey(1)}, jnp.asarray(x_host[:1]))["params"]
+    step_s, loss = time_step(
+        make_sp_train_step(mesh, attn_cfg, seq, optimizer), attn_params0)
+    out["ring_attn"] = {
+        "step_ms": round(step_s * 1e3, 1),
+        "seq_s": round(batch / step_s, 1),
+        "loss": round(loss, 4),
+    }
     return out
 
 
@@ -762,6 +821,7 @@ _PHASES = {
     "train_e2e": phase_train_e2e,
     "kernel_sweep": phase_kernel_sweep,
     "longctx": phase_longctx,
+    "longctx_attn": phase_longctx_attn,
     "multiticker": phase_multiticker,
     "serving": phase_serving,
     "torch": phase_torch,
@@ -913,6 +973,7 @@ def _capture_tpu_evidence(probe: dict) -> int:
         ("flagship_wide", 600.0),
         ("train_e2e", 900.0),
         ("longctx", 900.0),
+        ("longctx_attn", 900.0),
         ("multiticker", 600.0),
         ("serving", 600.0),
     ]:
@@ -960,6 +1021,7 @@ def main() -> None:
         ("tpu_export", 180.0),
         ("replay", 300.0),
         ("longctx", 600.0),
+        ("longctx_attn", 600.0),
         ("longctx_sp", 600.0),
         ("multiticker", 420.0),
         ("serving", 300.0),

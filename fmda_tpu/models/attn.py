@@ -12,8 +12,11 @@ transformer encoder over :mod:`fmda_tpu.ops.attention`:
 - Dense embed (F -> H) + sinusoidal positions (parameter-free, so train
   window 30 and serving window 5 share one checkpoint — the reference
   ships that very inconsistency, predict.py:71 vs notebook cell 11);
-- ``n_layers`` blocks of pre-LN multi-head attention and a GELU MLP
-  (H -> 4H -> H), residual dropout on both;
+- ``n_layers`` :class:`EncoderBlock` s (pre-LN multi-head attention and a
+  GELU MLP, residual dropout on both), each wrapped in ``nn.remat`` when
+  ``cfg.remat`` — backward recomputes the block instead of materialising
+  the (B, N, T, T) probabilities, the HBM-for-FLOPs trade the recurrent
+  families make through their scan (config.py ``remat``);
 - the head treats the final LN output as the per-step sequence ("out_sum"
   in GRU terms) and the last *valid* position as the final hidden.
 
@@ -51,6 +54,47 @@ def sinusoidal_positions(seq_len: int, dim: int, dtype) -> jax.Array:
     return enc.astype(dtype)
 
 
+class EncoderBlock(nn.Module):
+    """One pre-LN block: MHA + GELU MLP, residuals, dropout on both.
+
+    A separate module (rather than inline layers) so ``nn.remat`` can wrap
+    the whole block when ``cfg.remat`` — the sequence-parallel twin
+    (parallel/ring_attention.py ``sp_attn_apply``) reads this module's
+    param tree by block name.
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        attn_mask: Optional[jax.Array],
+        deterministic: bool,
+    ) -> jax.Array:
+        cfg = self.cfg
+        h = cfg.hidden_size
+        compute_dtype = jnp.dtype(cfg.dtype)
+        y = nn.LayerNorm(dtype=compute_dtype, name="ln_attn")(x)
+        qkv = nn.Dense(3 * h, dtype=compute_dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        out = mha(
+            split_heads(q, cfg.n_heads),
+            split_heads(k, cfg.n_heads),
+            split_heads(v, cfg.n_heads),
+            causal=cfg.attn_causal,
+            mask=attn_mask,
+        )
+        out = nn.Dense(h, dtype=compute_dtype, name="proj")(merge_heads(out))
+        x = x + nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+
+        y = nn.LayerNorm(dtype=compute_dtype, name="ln_mlp")(x)
+        y = nn.Dense(4 * h, dtype=compute_dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(h, dtype=compute_dtype, name="mlp_out")(y)
+        return x + nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+
+
 class TemporalTransformer(nn.Module):
     """See module docstring. ``cfg.n_features`` must be resolved."""
 
@@ -85,27 +129,16 @@ class TemporalTransformer(nn.Module):
         if mask is not None:
             attn_mask = (mask > 0)[:, None, None, :]
 
+        # remat: recompute each block in backward instead of storing its
+        # (B, N, T, T) attention intermediates (long-context HBM relief;
+        # static_argnums marks `deterministic`)
+        block_cls = (
+            nn.remat(EncoderBlock, static_argnums=(3,))
+            if cfg.remat else EncoderBlock
+        )
         for layer in range(cfg.n_layers):
-            y = nn.LayerNorm(dtype=compute_dtype, name=f"ln_attn_{layer}")(x)
-            qkv = nn.Dense(3 * h, dtype=compute_dtype,
-                           name=f"qkv_{layer}")(y)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            out = mha(
-                split_heads(q, n_heads),
-                split_heads(k, n_heads),
-                split_heads(v, n_heads),
-                causal=cfg.attn_causal,
-                mask=attn_mask,
-            )
-            out = nn.Dense(h, dtype=compute_dtype,
-                           name=f"proj_{layer}")(merge_heads(out))
-            x = x + nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
-
-            y = nn.LayerNorm(dtype=compute_dtype, name=f"ln_mlp_{layer}")(x)
-            y = nn.Dense(4 * h, dtype=compute_dtype, name=f"mlp_in_{layer}")(y)
-            y = nn.gelu(y)
-            y = nn.Dense(h, dtype=compute_dtype, name=f"mlp_out_{layer}")(y)
-            x = x + nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+            x = block_cls(cfg, name=f"block_{layer}")(
+                x, attn_mask, deterministic)
 
         x = nn.LayerNorm(dtype=compute_dtype, name="ln_final")(x)
 

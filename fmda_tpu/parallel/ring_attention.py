@@ -82,9 +82,13 @@ def ring_attention(
 
 
 def _layer_norm(x: jax.Array, p, eps: float = 1e-6) -> jax.Array:
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    """Matches flax LayerNorm(dtype=compute_dtype): statistics in f32
+    regardless of the compute dtype, scale/bias applied in x's dtype."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return y * p["scale"].astype(x.dtype) + p["bias"].astype(x.dtype)
 
 
 def sp_attn_apply(
@@ -110,16 +114,23 @@ def sp_attn_apply(
     idx = jax.lax.axis_index(axis_name)
     t_local = x_local.shape[1]
 
+    def dense(p, v):
+        # flax Dense(dtype=compute_dtype) semantics: params cast to the
+        # compute dtype before the matmul (bf16 operands on the MXU; the
+        # stored params stay f32)
+        return v @ p["kernel"].astype(compute_dtype) \
+            + p["bias"].astype(compute_dtype)
+
     x = x_local.astype(compute_dtype)
-    x = x @ params["embed"]["kernel"] + params["embed"]["bias"]
+    x = dense(params["embed"], x)
     pos = sinusoidal_positions(seq_len, h, compute_dtype)
     pos_local = jax.lax.dynamic_slice_in_dim(pos, idx * t_local, t_local)
     x = x + pos_local[None]
 
     for layer in range(cfg.n_layers):
-        y = _layer_norm(x, params[f"ln_attn_{layer}"])
-        qkv = y @ params[f"qkv_{layer}"]["kernel"] \
-            + params[f"qkv_{layer}"]["bias"]
+        blk = params[f"block_{layer}"]
+        y = _layer_norm(x, blk["ln_attn"])
+        qkv = dense(blk["qkv"], y)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         out = ring_attention(
             split_heads(q, n_heads),
@@ -128,17 +139,11 @@ def sp_attn_apply(
             axis_name,
             causal=cfg.attn_causal,
         )
-        out = merge_heads(out) @ params[f"proj_{layer}"]["kernel"] \
-            + params[f"proj_{layer}"]["bias"]
-        x = x + out
+        x = x + dense(blk["proj"], merge_heads(out))
 
-        y = _layer_norm(x, params[f"ln_mlp_{layer}"])
-        y = y @ params[f"mlp_in_{layer}"]["kernel"] \
-            + params[f"mlp_in_{layer}"]["bias"]
-        y = jax.nn.gelu(y)
-        y = y @ params[f"mlp_out_{layer}"]["kernel"] \
-            + params[f"mlp_out_{layer}"]["bias"]
-        x = x + y
+        y = _layer_norm(x, blk["ln_mlp"])
+        y = jax.nn.gelu(dense(blk["mlp_in"], y))
+        x = x + dense(blk["mlp_out"], y)
 
     x = _layer_norm(x, params["ln_final"])
 
@@ -157,8 +162,10 @@ def sp_attn_apply(
     avg_pool = sum_pool / jnp.asarray(seq_len, x.dtype)
 
     concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)
-    dense = params["linear"]
-    logits = concat @ dense["kernel"] + dense["bias"]
+    # the head Dense is declared WITHOUT dtype in pool_concat_logits, so
+    # flax promotes bf16 activations to the f32 params — match that here
+    # (no compute-dtype cast), keeping sp logits equal to the module's
+    logits = concat @ params["linear"]["kernel"] + params["linear"]["bias"]
     return logits.astype(jnp.float32)
 
 

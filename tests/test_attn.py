@@ -124,3 +124,47 @@ def test_trainer_runs_attn_cell_and_loss_drops():
         state, loss, _ = trainer._train_step(state, b, rng)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_remat_matches_plain_forward_and_grads():
+    """cfg.remat wraps each EncoderBlock in nn.remat: same function, same
+    gradients, just recomputed in backward (the long-context HBM trade)."""
+    cfg_plain, cfg_remat = _cfg(), _cfg(remat=True)
+    model_p, params, x = _init(cfg_plain, seq=12)
+    model_r = build_model(cfg_remat)
+
+    lp = model_p.apply(params, x)
+    lr = model_r.apply(params, x)
+    np.testing.assert_allclose(np.asarray(lr), np.asarray(lp), atol=1e-6)
+
+    def loss(m):
+        return lambda p: jnp.sum(jnp.sin(m.apply(p, x)))
+
+    gp = jax.grad(loss(model_p))(params)
+    gr = jax.grad(loss(model_r))(params)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_backtest_serves_attn_family():
+    """The serving path (window re-scan backtester) works for cell="attn"
+    via build_model — the family's serving story, since per-window
+    absolute positions make cross-tick K/V caching semantically invalid
+    (each tick re-positions the same row within its window)."""
+    from fmda_tpu.data import ArraySource
+    from fmda_tpu.data.normalize import NormParams
+    from fmda_tpu.serve import backtest
+
+    r = np.random.default_rng(0)
+    n, f, window = 60, 6, 8
+    x = r.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, :4] > 0).astype(np.float32)
+    src = ArraySource(x, y, tuple(f"f{i}" for i in range(f)))
+    cfg = _cfg(n_layers=1)
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(1)},
+        jnp.zeros((1, window, f)))["params"]
+    norm = NormParams(np.zeros(f, np.float32), np.ones(f, np.float32))
+    result = backtest(src, cfg, params, norm, window=window, batch_size=16)
+    assert result.probabilities.shape == (n - window + 1, 4)
+    assert not np.any(np.isnan(result.probabilities))

@@ -62,7 +62,8 @@ class TestPhaseRegistry:
         expected = {
             "flagship_pallas", "flagship_scan", "flagship_bf16",
             "flagship_wide", "train_e2e", "kernel_sweep", "longctx",
-            "longctx_sp", "multiticker", "serving", "torch", "tpu_export",
+            "longctx_attn", "longctx_sp", "multiticker", "serving", "torch",
+            "tpu_export",
             "replay",
         }
         assert expected == set(bench._PHASES)

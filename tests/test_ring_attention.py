@@ -143,6 +143,28 @@ def test_sp_transformer_matches_single_device(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_sp_transformer_bf16_matches_single_device():
+    """The sp path must follow the module's dtype semantics (params cast
+    to bf16 for the matmuls, LN stats in f32) — not silently run f32."""
+    from fmda_tpu.config import ModelConfig
+    from fmda_tpu.models import build_model
+    from fmda_tpu.parallel.ring_attention import make_attn_sp_forward
+
+    cfg = ModelConfig(
+        hidden_size=16, n_features=6, output_size=4, n_layers=1,
+        dropout=0.0, spatial_dropout=False, cell="attn", n_heads=4,
+        dtype="bfloat16")
+    model = build_model(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(13), (4, 16, 6))
+    params = model.init({"params": jax.random.PRNGKey(1)}, x)
+    ref = model.apply(params, x)
+
+    mesh = build_mesh(MeshConfig(dp=2, sp=2))
+    out = make_attn_sp_forward(mesh, cfg, 16)(params["params"], x)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
 def test_ring_attention_bf16_close():
     mesh = build_mesh(MeshConfig(dp=2, sp=4))
     q, k, v = _qkv(batch=2, heads=2, seq=16, d=8, key=4, dtype=jnp.bfloat16)
