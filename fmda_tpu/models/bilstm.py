@@ -114,6 +114,7 @@ class BiLSTM(nn.Module):
                     c0,
                     reverse=reverse,
                     mask=mask,
+                    use_pallas=cfg.use_pallas,
                     remat=cfg.remat,
                 )
                 dir_outputs.append(hs)

@@ -109,6 +109,27 @@ def lstm_scan(
     return (h_last, c_last), jnp.swapaxes(hs, 0, 1)
 
 
+def lstm_pallas_available() -> bool:
+    """True when the fused Pallas LSTM kernel can run on this backend."""
+    try:
+        from fmda_tpu.ops import pallas_lstm  # noqa: F401
+    except ImportError:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def select_lstm_scan_fn(use_pallas: bool, mask: Optional[jax.Array] = None):
+    """The kernel-vs-lax.scan choice, mirroring
+    :func:`fmda_tpu.ops.gru.select_scan_fn`: the fused kernel runs when
+    requested, unmasked, and on a TPU backend; anything else silently
+    falls back to :func:`lstm_scan`."""
+    if use_pallas and mask is None and lstm_pallas_available():
+        from fmda_tpu.ops import pallas_lstm
+
+        return pallas_lstm.lstm_scan_pallas
+    return lstm_scan
+
+
 def lstm_layer(
     x: jax.Array,
     weights: LSTMWeights,
@@ -117,12 +138,15 @@ def lstm_layer(
     *,
     reverse: bool = False,
     mask: Optional[jax.Array] = None,
+    use_pallas: bool = False,
     remat: bool = False,
 ) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
     """Full single-direction LSTM layer: projection + scan.
 
-    ``remat=True`` wraps the scan in :func:`jax.checkpoint` (the same
-    HBM-for-FLOPs trade as the GRU layer's long-context path).
+    ``use_pallas=True`` requests the fused Pallas TPU kernel (silent
+    fallback to :func:`lstm_scan` off-TPU or with a mask).  ``remat=True``
+    wraps the scan in :func:`jax.checkpoint` (the same HBM-for-FLOPs trade
+    as the GRU layer's long-context path).
 
     Returns ((h_last, c_last), hs) with hs: (B, T, H).
     """
@@ -133,6 +157,12 @@ def lstm_layer(
     if c0 is None:
         c0 = jnp.zeros((batch, hidden), dtype=x.dtype)
     xp = lstm_input_projection(x, weights)
+    scan_fn = select_lstm_scan_fn(use_pallas, mask)
+    if scan_fn is not lstm_scan:
+        # the Pallas pair already rematerialises (backward recomputes the
+        # gates in-VMEM from hs/cs), so `remat` is inherently satisfied
+        return scan_fn(xp, h0, c0, weights.w_hh, weights.b_hh,
+                       reverse=reverse)
     if remat:
         return jax.checkpoint(
             functools.partial(lstm_scan, reverse=reverse, mask=mask)

@@ -62,6 +62,24 @@ x, y, params, opt_state = shard_train_inputs_multihost(
 params, opt_state, loss = step(params, opt_state, x, y)
 sp_loss = float(jax.device_get(loss))
 
+# ---- ring-attention sp train step over the SAME global mesh: the K/V
+# ring rides the local sp axis while the gradient all-reduce crosses the
+# process boundary (DCN dp) exactly as the recurrent program's does
+from fmda_tpu.models import build_model
+
+attn_cfg = ModelConfig(hidden_size=8, n_features=12, output_size=4,
+                       dropout=0.0, spatial_dropout=False, cell="attn",
+                       n_heads=2)
+attn_params = build_model(attn_cfg).init(
+    {"params": jax.random.PRNGKey(1)}, jnp.asarray(x_global[:1]))["params"]
+attn_opt = optimizer.init(attn_params)
+attn_step = make_sp_train_step(mesh, attn_cfg, seq, optimizer,
+                               weight=jnp.ones(4), pos_weight=jnp.ones(4))
+xa, ya, attn_params, attn_opt = shard_train_inputs_multihost(
+    mesh, x_global[lo:hi], y_global[lo:hi], attn_params, attn_opt)
+_, _, attn_loss = attn_step(attn_params, attn_opt, xa, ya)
+attn_loss = float(jax.device_get(attn_loss))
+
 # ---- dp-only Trainer step through the process-local batch path
 from fmda_tpu.data.pipeline import Batch
 from fmda_tpu.train import Trainer
@@ -77,7 +95,8 @@ placed = next(iter(trainer._place_batches([local])))
 state, tr_loss, _ = trainer._train_step(state, placed, jax.random.PRNGKey(1))
 tr_loss = float(jax.device_get(tr_loss))
 
-print(json.dumps({"pid": pid, "sp_loss": sp_loss, "trainer_loss": tr_loss}))
+print(json.dumps({"pid": pid, "sp_loss": sp_loss, "trainer_loss": tr_loss,
+                  "attn_loss": attn_loss}))
 """
 
 
@@ -120,3 +139,6 @@ def test_two_process_dp_across_hosts(tmp_path):
     assert a["sp_loss"] == b["sp_loss"]
     assert a["trainer_loss"] == b["trainer_loss"]
     assert np.isfinite(a["trainer_loss"])
+    # the ring-attention program must agree across hosts the same way
+    assert a["attn_loss"] == b["attn_loss"]
+    assert np.isfinite(a["attn_loss"])
