@@ -342,9 +342,14 @@ class ModelConfig:
     #: Compute dtype for the GRU/head; params are kept in float32.
     dtype: str = "float32"
     #: Use the fused Pallas scan cell on TPU (falls back to lax.scan
-    #: elsewhere).  Default off: the flagship default path must be the one
-    #: exercised everywhere; bench.py and TPU-gated tests opt in explicitly
-    #: (ADVICE r1 — flip the default once the kernel has a TPU CI job).
+    #: elsewhere).  True means "kernel where it fits": selection is
+    #: additionally gated per shape on the kernel's VMEM feasibility
+    #: (fmda_tpu.ops.pallas_gru.kernel_supported) — at MXU-wide hidden
+    #: sizes the model auto-selects lax.scan, whose per-step matmul is
+    #: MXU-shaped there anyway.  Default off: the flagship default path
+    #: must be the one exercised everywhere; bench.py and TPU-gated tests
+    #: opt in explicitly (ADVICE r1 — flip the default once the kernel
+    #: has a TPU CI job).
     use_pallas: bool = False
     #: Rematerialise the recurrence in backward (jax.checkpoint): trades
     #: recompute FLOPs for HBM — enable for long-context windows.

@@ -7,7 +7,12 @@ from fmda_tpu.data.normalize import (
     normalize,
     save_norm_params,
 )
-from fmda_tpu.data.pipeline import ChunkDataset, WindowBatches, prefetch_to_device
+from fmda_tpu.data.pipeline import (
+    ChunkDataset,
+    WindowBatches,
+    background_compose,
+    prefetch_to_device,
+)
 
 __all__ = [
     "ArraySource",
@@ -22,5 +27,6 @@ __all__ = [
     "load_norm_params",
     "ChunkDataset",
     "WindowBatches",
+    "background_compose",
     "prefetch_to_device",
 ]

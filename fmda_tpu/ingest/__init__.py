@@ -1,10 +1,12 @@
 from fmda_tpu.ingest.transport import (
+    RateLimitTransport,
     RecordingTransport,
     SessionReplayTransport,
     ReplayTransport,
     RetryTransport,
     Transport,
     UrllibTransport,
+    live_transport,
 )
 from fmda_tpu.ingest.clients import AlphaVantageClient, IEXClient, TradierCalendarClient
 from fmda_tpu.ingest.scrapers import (
@@ -21,6 +23,8 @@ __all__ = [
     "RecordingTransport",
     "SessionReplayTransport",
     "RetryTransport",
+    "RateLimitTransport",
+    "live_transport",
     "IEXClient",
     "AlphaVantageClient",
     "TradierCalendarClient",

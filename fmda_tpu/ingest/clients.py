@@ -15,7 +15,7 @@ import json
 import logging
 from typing import Dict, List, Optional
 
-from fmda_tpu.ingest.transport import Transport, UrllibTransport
+from fmda_tpu.ingest.transport import Transport, live_transport
 from fmda_tpu.utils.jsonutils import change_keys, values_to_numbers
 from fmda_tpu.utils.timeutils import TS_FORMAT
 
@@ -32,7 +32,7 @@ class IEXClient:
         base_url: str = "https://cloud.iexapis.com/v1",
     ) -> None:
         self.token = token
-        self.transport = transport or UrllibTransport()
+        self.transport = transport or live_transport()
         self.base_url = base_url
 
     def get_deep_book(self, symbol: str, timestamp: _dt.datetime) -> Dict:
@@ -69,7 +69,7 @@ class AlphaVantageClient:
         staleness_warn_s: int = 4 * 60,
     ) -> None:
         self.token = token
-        self.transport = transport or UrllibTransport()
+        self.transport = transport or live_transport()
         self.base_url = base_url
         self.staleness_warn_s = staleness_warn_s
 
@@ -132,7 +132,7 @@ class TradierCalendarClient:
         base_url: str = "https://api.tradier.com/v1",
     ) -> None:
         self.token = token
-        self.transport = transport or UrllibTransport()
+        self.transport = transport or live_transport()
         self.base_url = base_url
 
     def get_market_calendar(self) -> List[Dict]:

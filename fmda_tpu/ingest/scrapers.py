@@ -29,7 +29,7 @@ from typing import Dict, List, Optional, Sequence
 
 from fmda_tpu.config import FeatureConfig
 from fmda_tpu.ingest.htmldom import Element, parse_html
-from fmda_tpu.ingest.transport import Transport, UrllibTransport
+from fmda_tpu.ingest.transport import Transport, live_transport
 from fmda_tpu.utils.jsonutils import to_number
 from fmda_tpu.utils.timeutils import TS_FORMAT
 
@@ -95,7 +95,7 @@ class EconomicCalendarScraper:
         self.features = features
         self.countries = tuple(countries)
         self.importance = tuple("bull" + i for i in importance)
-        self.transport = transport or UrllibTransport()
+        self.transport = transport or live_transport()
         self.registry = registry or SentItemsRegistry()
 
     def parse(self, html: str, current_dt: _dt.datetime) -> List[Dict]:
@@ -196,7 +196,7 @@ class VIXScraper:
     URL = "https://www.cnbc.com/quotes/?symbol=.VIX"
 
     def __init__(self, transport: Optional[Transport] = None) -> None:
-        self.transport = transport or UrllibTransport()
+        self.transport = transport or live_transport()
 
     def parse(self, html: str) -> float:
         root = parse_html(html)
@@ -226,7 +226,7 @@ class COTScraper:
         index_url: Optional[str] = None,
     ) -> None:
         self.report_subject = report_subject
-        self.transport = transport or UrllibTransport()
+        self.transport = transport or live_transport()
         self.index_url = index_url or self.INDEX_URL
 
     def find_report_url(self, index_html: str) -> Optional[str]:

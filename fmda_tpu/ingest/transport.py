@@ -174,6 +174,76 @@ class RetryTransport:
         ) from last
 
 
+class RateLimitTransport:
+    """Per-host request spacing (round-3 verdict: the reference rides
+    scrapy's AUTOTHROTTLE/DOWNLOAD_DELAY machinery,
+    economic_indicators_spider.py:212-255 settings; the replay-first
+    design needs its own).  Requests to the same host are spaced at
+    least ``min_interval_s`` apart — different hosts never block each
+    other, so one slow feed cannot starve the rest of a tick.
+    """
+
+    def __init__(
+        self,
+        inner: Transport,
+        min_interval_s: float = 1.0,
+        *,
+        clock=None,
+        sleep_fn=None,
+    ) -> None:
+        import time
+
+        self.inner = inner
+        self.min_interval_s = min_interval_s
+        self.clock = clock or time.monotonic
+        self.sleep_fn = sleep_fn or time.sleep
+        self._last: Dict[str, float] = {}
+
+    @staticmethod
+    def _host(url: str) -> str:
+        from urllib.parse import urlparse
+
+        return urlparse(url).netloc or url
+
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        host = self._host(url)
+        last = self._last.get(host)
+        if last is not None:
+            wait = self.min_interval_s - (self.clock() - last)
+            if wait > 0:
+                self.sleep_fn(wait)
+        self._last[host] = self.clock()
+        return self.inner.get(url, headers)
+
+
+def live_transport(
+    timeout_s: float = 20.0,
+    user_agent: str = "fmda-tpu/0.1",
+    *,
+    attempts: int = 3,
+    backoff_s: float = 1.0,
+    min_interval_s: float = 1.0,
+) -> Transport:
+    """The hardened default for live operation: stdlib HTTP behind
+    per-host rate limiting behind exponential-backoff retries.
+
+    Worst-case wall per GET is bounded (attempts x timeout plus
+    ``backoff_s * (2^attempts - 1)`` of sleep — ~69 s at the defaults),
+    so a dead feed degrades to a logged :class:`TransportError` the
+    session driver isolates per feed (ingest/session.py), never a stuck
+    tick loop.  Clients and scrapers construct this when not handed an
+    explicit transport (tests inject replay/recording transports).
+    """
+    return RetryTransport(
+        RateLimitTransport(
+            UrllibTransport(timeout_s, user_agent),
+            min_interval_s=min_interval_s,
+        ),
+        attempts=attempts,
+        backoff_s=backoff_s,
+    )
+
+
 class RecordingTransport:
     """Wrap a live transport and persist every response for later replay.
 

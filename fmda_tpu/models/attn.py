@@ -84,6 +84,10 @@ class EncoderBlock(nn.Module):
             split_heads(v, cfg.n_heads),
             causal=cfg.attn_causal,
             mask=attn_mask,
+            # cfg.use_pallas is the family-uniform kernel opt-in: for
+            # cell="attn" it requests the flash kernel (TPU + supported
+            # shape + no mask; silent jnp fallback otherwise)
+            use_flash=cfg.use_pallas,
         )
         out = nn.Dense(h, dtype=compute_dtype, name="proj")(merge_heads(out))
         x = x + nn.Dropout(cfg.dropout)(out, deterministic=deterministic)

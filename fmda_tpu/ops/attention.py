@@ -101,6 +101,32 @@ def finalize_online_state(
     return (state.o / l[..., None]).astype(dtype)
 
 
+def flash_available() -> bool:
+    """True when the fused Pallas flash-attention kernel can run here."""
+    try:
+        from fmda_tpu.ops import pallas_attention  # noqa: F401
+    except ImportError:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def flash_dispatch(
+    tq: int, tk: int, d_head: int,
+    *,
+    use_flash: bool,
+    has_mask: bool = False,
+) -> bool:
+    """THE dispatch decision :func:`mha` makes — exposed so callers that
+    *report* the executed path (bench.py's ``scan_path`` attribution)
+    ask this function instead of re-implementing the gate and silently
+    drifting from it."""
+    if not (use_flash and not has_mask and flash_available()):
+        return False
+    from fmda_tpu.ops import pallas_attention
+
+    return pallas_attention.flash_supported(tq, tk, d_head)
+
+
 def mha(
     q: jax.Array,
     k: jax.Array,
@@ -108,20 +134,38 @@ def mha(
     *,
     causal: bool = False,
     mask: Optional[jax.Array] = None,
+    use_flash: bool = False,
 ) -> jax.Array:
     """Single-device multi-head attention via the same online-softmax
     primitive the ring path uses (one block = the whole key axis), so the
     sharded and unsharded paths are the *same numerics* by construction.
+
+    ``use_flash=True`` requests the fused Pallas flash kernel
+    (:mod:`fmda_tpu.ops.pallas_attention`) on TPU backends — same math,
+    but the (T, T) scores never leave VMEM instead of costing
+    (B, N, T, T) f32 of HBM traffic.  The flag is the attn family's
+    ``ModelConfig.use_pallas`` (same opt-in convention as the GRU/LSTM
+    kernels: the default path stays the one exercised everywhere, and a
+    kernel regression can always be ruled out from config).  Anything
+    outside the kernel's envelope (masks, ragged Tq/Tk, T not a
+    multiple of 128, non-TPU backend) silently falls back to the jnp
+    path below.
 
     Args:
       q, k, v: (B, N, T, D).
       causal: apply a lower-triangular causal mask (needed for streaming
         serving where position t must not see the future).
       mask: optional extra mask, (Tq, Tk) or broadcastable (B, N, Tq, Tk).
+      use_flash: opt into the fused kernel where supported.
 
     Returns (B, N, Tq, D) in q's dtype.
     """
     tq, tk = q.shape[-2], k.shape[-2]
+    if flash_dispatch(tq, tk, q.shape[-1], use_flash=use_flash,
+                      has_mask=mask is not None):
+        from fmda_tpu.ops import pallas_attention
+
+        return pallas_attention.flash_attention(q, k, v, causal=causal)
     full_mask = None
     if causal:
         # suffix alignment: query i sits at global position tk - tq + i, so

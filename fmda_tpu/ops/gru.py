@@ -110,15 +110,34 @@ def pallas_scan_available() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def select_scan_fn(use_pallas: bool, mask: Optional[jax.Array] = None):
+def select_scan_fn(
+    use_pallas: bool,
+    mask: Optional[jax.Array] = None,
+    *,
+    shape: Optional[Tuple[int, int, int]] = None,
+    itemsize: int = 4,
+):
     """The canonical kernel-vs-lax.scan choice, shared by every caller
     (single-device :func:`gru_layer` and the sequence-parallel path) so
     the kernel's support envelope is gated in exactly one place: the
     fused kernel runs when requested, unmasked, and on a TPU backend;
-    anything else silently falls back to :func:`gru_scan`."""
+    anything else silently falls back to :func:`gru_scan`.
+
+    ``shape=(batch, seq_len, hidden)`` additionally gates on the
+    kernel's per-shape VMEM feasibility
+    (:func:`fmda_tpu.ops.pallas_gru.kernel_supported`): at MXU-sized
+    hidden widths the kernel's resident weights + f32 accumulators
+    outgrow VMEM, and the per-step matmul is large enough that
+    ``lax.scan`` is the right path — so ``use_pallas=True`` means "fused
+    kernel where it fits, scan where it doesn't", selected automatically
+    per shape at trace time (shapes are static under jit)."""
     if use_pallas and mask is None and pallas_scan_available():
         from fmda_tpu.ops import pallas_gru
 
+        if shape is not None and not pallas_gru.kernel_supported(
+            shape[0], shape[1], shape[2], itemsize
+        ):
+            return gru_scan
         return pallas_gru.gru_scan_pallas
     return gru_scan
 
@@ -150,7 +169,9 @@ def gru_layer(
     if h0 is None:
         h0 = jnp.zeros((batch, hidden), dtype=x.dtype)
     xp = input_projection(x, weights)
-    scan_fn = select_scan_fn(use_pallas, mask)
+    scan_fn = select_scan_fn(
+        use_pallas, mask,
+        shape=(batch, x.shape[1], hidden), itemsize=x.dtype.itemsize)
     if scan_fn is not gru_scan:
         # The Pallas kernel pair already rematerialises: the backward
         # kernel stores only the forward outputs (hs) and recomputes the

@@ -118,14 +118,26 @@ def lstm_pallas_available() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def select_lstm_scan_fn(use_pallas: bool, mask: Optional[jax.Array] = None):
+def select_lstm_scan_fn(
+    use_pallas: bool,
+    mask: Optional[jax.Array] = None,
+    *,
+    shape: Optional[Tuple[int, int, int]] = None,
+    itemsize: int = 4,
+):
     """The kernel-vs-lax.scan choice, mirroring
     :func:`fmda_tpu.ops.gru.select_scan_fn`: the fused kernel runs when
-    requested, unmasked, and on a TPU backend; anything else silently
-    falls back to :func:`lstm_scan`."""
+    requested, unmasked, on a TPU backend, and — when
+    ``shape=(batch, seq_len, hidden)`` is given — inside the kernel's
+    VMEM feasibility envelope; anything else silently falls back to
+    :func:`lstm_scan`."""
     if use_pallas and mask is None and lstm_pallas_available():
         from fmda_tpu.ops import pallas_lstm
 
+        if shape is not None and not pallas_lstm.kernel_supported(
+            shape[0], shape[1], shape[2], itemsize
+        ):
+            return lstm_scan
         return pallas_lstm.lstm_scan_pallas
     return lstm_scan
 
@@ -157,7 +169,9 @@ def lstm_layer(
     if c0 is None:
         c0 = jnp.zeros((batch, hidden), dtype=x.dtype)
     xp = lstm_input_projection(x, weights)
-    scan_fn = select_lstm_scan_fn(use_pallas, mask)
+    scan_fn = select_lstm_scan_fn(
+        use_pallas, mask,
+        shape=(batch, x.shape[1], hidden), itemsize=x.dtype.itemsize)
     if scan_fn is not lstm_scan:
         # the Pallas pair already rematerialises (backward recomputes the
         # gates in-VMEM from hs/cs), so `remat` is inherently satisfied

@@ -14,8 +14,10 @@ resident in a VMEM scratch buffer for the whole sequence and processes
   grid steps; smaller B or bf16 collapse it to one).  Per-grid-step
   DMA/barrier overhead — which dominates at small (B, H), where each
   step's matmul is microseconds — is amortized over block_t unrolled
-  in-kernel steps whose operands never leave VMEM (measured on v5e at the
-  flagship shape: 1.3 ms/train-step vs 2.4 ms for lax.scan);
+  in-kernel steps whose operands never leave VMEM (matched
+  kernel-vs-scan pairs live in the committed BENCH_TPU*.json
+  ``flagship_pallas``/``flagship_scan`` and ``kernel_sweep`` phases —
+  those artifacts, not this docstring, are the performance record);
 - the sequence is laid out **time-major** ``(T, B, 3H)`` so each grid
   step's block is ``(block_t, B, 3H)`` — its last two dims span the
   array's full (B, 3H) plane, satisfying Mosaic's divisible-by-(8, 128)-
@@ -62,18 +64,70 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+# Conservative per-core VMEM budget for a kernel's whole working set
+# (blocks + constants + scratch).  Real VMEM is ~16 MB/core; staying
+# well under leaves room for Mosaic's own temporaries and the gate
+# algebra's f32 upcasts.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _fwd_const_bytes(batch: int, hidden: int, itemsize: int) -> int:
+    """Grid-constant VMEM residents of the forward kernel: h0 + h_last +
+    h scratch (B,H each), w_hh_t (H,3H), b_hh (3H)."""
+    return itemsize * (3 * batch * hidden + 3 * hidden * hidden + 3 * hidden)
+
+
+def _bwd_const_bytes(batch: int, hidden: int, itemsize: int) -> int:
+    """Grid-constant VMEM residents of the backward kernel: both weight
+    copies (w_hh + w_hh_t, 3H*H each, I/O dtype) plus the f32
+    accumulators (dhlast, dh0, dh scratch: B,H; dwt: H,3H; db: 3H)."""
+    f32 = 4
+    return (
+        itemsize * 6 * hidden * hidden
+        + f32 * (3 * batch * hidden + 3 * hidden * hidden + 3 * hidden)
+    )
+
+
+def kernel_supported(
+    batch: int, seq_len: int, hidden: int, itemsize: int
+) -> bool:
+    """True when the fused kernel *pair* (forward + backward) fits the
+    VMEM budget at the minimum block size (block_t=1).
+
+    This is the per-shape gate behind automatic kernel-vs-scan selection
+    (:func:`fmda_tpu.ops.gru.select_scan_fn`): the kernel keeps the full
+    recurrent weights and f32 gradient accumulators resident in VMEM for
+    the whole sequence, so past H ~ 512 (f32) the backward's 6*H^2
+    weight copies + 3*H^2 f32 dW accumulator alone outgrow the ~16 MB
+    core budget and ``lax.scan`` — whose per-step matmul is MXU-shaped
+    at such H anyway — is the right path.  The crossover is measured on
+    hardware by ``bench.py --phase kernel_sweep``.
+    """
+    # time-varying blocks at K=1, double-buffered by Mosaic:
+    # fwd: xp (1,B,3H) in + hs (1,B,H) out -> 8*B*H elems
+    fwd = itemsize * 2 * (4 * batch * hidden) + _fwd_const_bytes(
+        batch, hidden, itemsize)
+    # bwd: xp + dxp (3H each) + hprev + dhs (H each) -> 16*B*H elems
+    bwd = itemsize * 2 * (8 * batch * hidden) + _bwd_const_bytes(
+        batch, hidden, itemsize)
+    return max(fwd, bwd) <= _VMEM_BUDGET
+
+
 def _default_block_t(
     seq_len: int, batch: int, hidden: int, itemsize: int,
-    units_per_step: int = 4,
+    units_per_step: int = 4, const_bytes: int = 0,
 ) -> int:
     """Largest divisor of T whose per-block working set stays inside a
     conservative VMEM budget.  ``units_per_step`` counts the H-sized rows
     a block carries per timestep (forward: xp 3H + hs H = 4; backward:
     xp 3H + hprev H + dhs H + dxp 3H = 8), doubled for Mosaic's block
-    double-buffering.  T=1 always divides, so the fallback is the
-    one-step-per-grid-step kernel; at the f32 flagship (B=256, T=30) this
-    yields block_t=15 forward / 10 backward (2 / 3 grid steps)."""
-    budget = 6 * 1024 * 1024
+    double-buffering.  ``const_bytes`` (the grid-constant residents:
+    weights, f32 accumulators) is charged against the budget first, so
+    large-H shapes pick smaller blocks instead of overflowing VMEM.
+    T=1 always divides, so the fallback is the one-step-per-grid-step
+    kernel; at the f32 flagship (B=256, T=30) this yields block_t=15
+    forward / 10 backward (2 / 3 grid steps)."""
+    budget = max(_VMEM_BUDGET // 2 - const_bytes, 0)
     per_step = batch * units_per_step * hidden * itemsize * 2
     cap = max(1, budget // max(per_step, 1))
     # unroll bound: past ~64 in-kernel steps the per-grid-step overhead is
@@ -152,7 +206,9 @@ def _gru_scan_pallas_fwd_impl(
     # last two dims, the only layout Mosaic can tile for B % 8 == 0
     xp_tm = jnp.swapaxes(xp, 0, 1)  # (T, B, 3H)
 
-    block_t = _default_block_t(seq_len, batch, hidden, xp.dtype.itemsize)
+    block_t = _default_block_t(
+        seq_len, batch, hidden, xp.dtype.itemsize,
+        const_bytes=_fwd_const_bytes(batch, hidden, xp.dtype.itemsize))
     n_blocks = seq_len // block_t
 
     # block index map (units of blocks): grid step t touches block t
@@ -296,7 +352,8 @@ def _gru_scan_pallas_bwd_impl(
     dhs_tm = jnp.swapaxes(dhs, 0, 1)  # (T, B, H)
 
     block_t = _default_block_t(
-        seq_len, batch, hidden, xp.dtype.itemsize, units_per_step=8)
+        seq_len, batch, hidden, xp.dtype.itemsize, units_per_step=8,
+        const_bytes=_bwd_const_bytes(batch, hidden, xp.dtype.itemsize))
     n_blocks = seq_len // block_t
 
     # grid step i processes blocks in reverse *processing* order

@@ -36,7 +36,43 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from fmda_tpu.ops.pallas_gru import _default_block_t
+from fmda_tpu.ops.pallas_gru import _VMEM_BUDGET, _default_block_t
+
+
+def _fwd_const_bytes(batch: int, hidden: int, itemsize: int) -> int:
+    """Grid-constant VMEM residents of the forward kernel: h0/c0 +
+    h_last/c_last + h/c scratch (6 x B,H), w_hh_t (H,4H), b_hh (4H)."""
+    return itemsize * (
+        6 * batch * hidden + 4 * hidden * hidden + 4 * hidden)
+
+
+def _bwd_const_bytes(batch: int, hidden: int, itemsize: int) -> int:
+    """Grid-constant VMEM residents of the backward kernel: both weight
+    copies (w_hh + w_hh_t, 4H*H each, I/O dtype) plus the f32
+    accumulators (dh_last/dc_last/dh0/dc0 + 2 scratch: 6 x B,H;
+    dwt: H,4H; db: 4H)."""
+    f32 = 4
+    return (
+        itemsize * 8 * hidden * hidden
+        + f32 * (6 * batch * hidden + 4 * hidden * hidden + 4 * hidden)
+    )
+
+
+def kernel_supported(
+    batch: int, seq_len: int, hidden: int, itemsize: int
+) -> bool:
+    """LSTM twin of :func:`fmda_tpu.ops.pallas_gru.kernel_supported`:
+    True when the fused kernel pair fits the VMEM budget at block_t=1.
+    The LSTM's working set is ~4/3 the GRU's (4H gate blocks, two
+    carried states), so its feasibility boundary sits at a slightly
+    smaller H."""
+    # fwd time-varying at K=1: xp (4H) + hs (H) + cs (H) = 6*B*H elems
+    fwd = itemsize * 2 * (6 * batch * hidden) + _fwd_const_bytes(
+        batch, hidden, itemsize)
+    # bwd: xp + dxp (4H each) + hprev/cprev/cnew/dhs (H each) = 12*B*H
+    bwd = itemsize * 2 * (12 * batch * hidden) + _bwd_const_bytes(
+        batch, hidden, itemsize)
+    return max(fwd, bwd) <= _VMEM_BUDGET
 
 
 def _lstm_step_kernel(
@@ -101,7 +137,8 @@ def _lstm_fwd_impl(
 
     # fwd per-step rows: xp 4H + hs H + cs H = 6H
     block_t = _default_block_t(
-        seq_len, batch, hidden, xp.dtype.itemsize, units_per_step=6)
+        seq_len, batch, hidden, xp.dtype.itemsize, units_per_step=6,
+        const_bytes=_fwd_const_bytes(batch, hidden, xp.dtype.itemsize))
     n_blocks = seq_len // block_t
 
     if reverse:
@@ -267,7 +304,8 @@ def _lstm_bwd_impl(
 
     # bwd per-step rows: xp 4H + hprev/cprev/cnew/dhs 4x H + dxp 4H = 12H
     block_t = _default_block_t(
-        seq_len, batch, hidden, xp.dtype.itemsize, units_per_step=12)
+        seq_len, batch, hidden, xp.dtype.itemsize, units_per_step=12,
+        const_bytes=_bwd_const_bytes(batch, hidden, xp.dtype.itemsize))
     n_blocks = seq_len // block_t
 
     if reverse:

@@ -274,8 +274,13 @@ def sp_bigru_apply(
     w_b = direction("l0_reverse") if cfg.bidirectional else None
     # canonical kernel gate (fmda_tpu.ops.gru): when selected, the fused
     # kernel scans each sp shard's local time block in VMEM; the ppermute
-    # carry handoff is unchanged
-    scan_fn = select_scan_fn(cfg.use_pallas)
+    # carry handoff is unchanged.  Shape-gated on the *local* block the
+    # kernel would actually see (pipelining splits the batch further, but
+    # smaller batches only shrink the working set).
+    scan_fn = select_scan_fn(
+        cfg.use_pallas,
+        shape=(x_local.shape[0], x_local.shape[1], cfg.hidden_size),
+        itemsize=compute_dtype.itemsize)
     last_hidden, gru_out_local = sp_bigru_layer(
         x_local, w_f, w_b, axis_name, vary_axes=vary_axes,
         n_microbatches=n_microbatches, scan_fn=scan_fn,

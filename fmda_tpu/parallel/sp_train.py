@@ -10,6 +10,7 @@ shards inside :func:`fmda_tpu.parallel.seq_parallel.sp_gru_scan`.
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Tuple
 
 import jax
@@ -20,6 +21,8 @@ from fmda_tpu.config import ModelConfig
 from fmda_tpu.parallel.mesh import batch_sharding, replicated_sharding, sequence_sharding
 from fmda_tpu.parallel.seq_parallel import make_sp_forward
 from fmda_tpu.train.losses import weighted_bce_with_logits
+
+log = logging.getLogger("fmda_tpu.parallel")
 
 
 def make_sp_train_step(
@@ -41,7 +44,16 @@ def make_sp_train_step(
     ``model_cfg.cell`` picks the sequence core: the GRU's staged/pipelined
     carry-handoff scan, or (``"attn"``) the temporal transformer whose
     attention runs as a K/V ring (fmda_tpu.parallel.ring_attention) —
-    same mesh, same shardings, different collective program."""
+    same mesh, same shardings, different collective program.
+
+    Note: every sp forward is the *deterministic* apply —
+    ``model_cfg.dropout`` is ignored during sp training (all cells; the
+    single-device trainer is the dropout-regularised path).  Set
+    dropout=0 in sp configs to make that explicit."""
+    if model_cfg.dropout:
+        log.warning(
+            "sp training runs the deterministic forward; "
+            "ModelConfig.dropout=%.2f is ignored", model_cfg.dropout)
     if model_cfg.cell == "attn":
         from fmda_tpu.parallel.ring_attention import make_attn_sp_forward
 

@@ -321,6 +321,9 @@ class StreamEngine:
         )
         self._emitted = 0
         self._dropped = 0
+        #: newest book-tick timestamp ingested (epoch s) — the stream-time
+        #: "now" that watermark ages in :attr:`stats` are measured against
+        self._max_deep_ts = -1
         #: per-stage wall-clock accounting (SURVEY.md §5: the reference has
         #: no tracing; here every step exposes ingest/join/land/signal time)
         self.timer = StageTimer()
@@ -362,6 +365,7 @@ class StreamEngine:
                     log.warning("bad deep message %s dropped: %s", raw[0], e2)
         for event in deep_events:
             bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
+            self._max_deep_ts = max(self._max_deep_ts, event.ts)
             if self._core is not None:
                 self._core.add_deep(event.ts)
         parsers = self._side_parsers
@@ -549,11 +553,37 @@ class StreamEngine:
     # -- observability -------------------------------------------------------
 
     @property
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, object]:
+        """Counters plus the lag/watermark observability the reference
+        sketched but never wired (spark_consumer.py:48-66's unused
+        ``count_kafka_mssg`` offset counter):
+
+        - ``consumer_lag``: per-topic published-but-unpolled message
+          count (``bus.end_offset - consumer.offset``) — a growing lag
+          means the engine step loop is falling behind its producers;
+        - ``watermark_age_s``: per side stream, how far that stream's
+          join watermark trails the newest ingested book tick (stream
+          time, not wall time — replay-safe).  A large age means the
+          feed has gone quiet while book ticks keep arriving, so joins
+          are waiting on it; None until both sides have seen data.
+        """
+        lag = {
+            topic: self.bus.end_offset(topic) - c.offset
+            for topic, c in self._consumers.items()
+        }
+        ages: Dict[str, Optional[int]] = {}
+        for topic, buf in self._side_streams.items():
+            wm = buf.watermark(self.features.watermark_s)
+            ages[topic] = (
+                self._max_deep_ts - wm
+                if wm >= 0 and self._max_deep_ts >= 0 else None
+            )
         return {
             "emitted": self._emitted,
             "dropped": self._dropped,
             "pending": len(self._pending_deep),
+            "consumer_lag": lag,
+            "watermark_age_s": ages,
         }
 
     # -- checkpoint / resume -------------------------------------------------
@@ -615,6 +645,12 @@ class StreamEngine:
         # the join loop trusts sorted order; make the invariant
         # self-establishing for checkpoints from any writer
         self._pending_deep.sort(key=lambda e: e.ts)
+        # stream-time "now" for watermark ages: the best post-restore
+        # estimate is the newest still-pending tick (already-joined ticks
+        # don't matter for the ages' None-vs-stale distinction)
+        if self._pending_deep:
+            self._max_deep_ts = max(
+                self._max_deep_ts, self._pending_deep[-1].ts)
         for topic, dump in state.get("buffers", {}).items():
             if topic in self._side_streams:
                 buf = self._side_streams[topic]

@@ -50,6 +50,13 @@ class _Broker:
 
 _BROKERS: Dict[Tuple[str, ...], _Broker] = {}
 
+#: Client-API call journal — every (method, detail) the adapter invokes,
+#: in order, including the exact serialized bytes handed to the producer.
+#: This is the recorded-wire-protocol surface the conformance fixture
+#: (tests/data/kafka_wire.json) locks: an adapter that changes how it
+#: drives the kafka-python client fails against the recording.
+JOURNAL: List[Tuple[str, str]] = []
+
 
 def _broker(bootstrap_servers) -> _Broker:
     if isinstance(bootstrap_servers, str):
@@ -60,6 +67,7 @@ def _broker(bootstrap_servers) -> _Broker:
 
 def reset() -> None:
     _BROKERS.clear()
+    JOURNAL.clear()
 
 
 class _Future:
@@ -77,7 +85,10 @@ class KafkaProducer:
         self._serializer = value_serializer or (lambda v: v)
 
     def send(self, topic: str, value=None) -> _Future:
-        offset = self._broker.append(topic, self._serializer(value))
+        data = self._serializer(value)
+        JOURNAL.append(("producer.send", f"{topic}:{data.decode('utf-8')}"
+                        if isinstance(data, bytes) else f"{topic}:{data}"))
+        offset = self._broker.append(topic, data)
         return _Future(RecordMetadata(topic, 0, offset))
 
     def flush(self) -> None:
@@ -96,17 +107,22 @@ class KafkaConsumer:
         self._closed = False
 
     def assign(self, partitions) -> None:
+        JOURNAL.append(("consumer.assign",
+                        ",".join(f"{tp.topic}/{tp.partition}"
+                                 for tp in partitions)))
         for tp in partitions:
             self._positions.setdefault(tp, 0)
 
     def seek(self, tp: TopicPartition, offset: int) -> None:
         if tp not in self._positions:
             raise AssertionError("seek() before assign() — client protocol bug")
+        JOURNAL.append(("consumer.seek", f"{tp.topic}/{tp.partition}@{offset}"))
         self._positions[tp] = offset
 
     def poll(self, timeout_ms: int = 0, max_records: Optional[int] = None):
         if self._closed:
             raise AssertionError("poll() on closed consumer")
+        JOURNAL.append(("consumer.poll", f"timeout_ms={timeout_ms}"))
         out: Dict[TopicPartition, List[ConsumerRecord]] = {}
         for tp, pos in self._positions.items():
             log = self._broker.topics.get(tp.topic, [])
@@ -122,7 +138,11 @@ class KafkaConsumer:
         return out
 
     def end_offsets(self, partitions) -> Dict[TopicPartition, int]:
+        JOURNAL.append(("consumer.end_offsets",
+                        ",".join(f"{tp.topic}/{tp.partition}"
+                                 for tp in partitions)))
         return {tp: self._broker.end_offset(tp.topic) for tp in partitions}
 
     def close(self) -> None:
+        JOURNAL.append(("consumer.close", ""))
         self._closed = True

@@ -136,3 +136,72 @@ def test_array_source_id_bounds():
     with pytest.raises(IndexError):
         src.fetch([11])
     assert src.fetch([1, 10]).shape == (2, 6)
+
+
+def test_background_compose_preserves_order_and_content():
+    from fmda_tpu.data.pipeline import Batch, background_compose
+
+    batches = [
+        Batch(
+            x=np.full((2, 3, 4), i, np.float32),
+            y=np.zeros((2, 4), np.float32),
+            mask=np.ones(2, np.float32),
+        )
+        for i in range(7)
+    ]
+    out = list(background_compose(iter(batches), depth=2))
+    assert len(out) == 7
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(b.x, batches[i].x)
+
+
+def test_background_compose_propagates_composer_errors():
+    from fmda_tpu.data.pipeline import background_compose
+
+    def bad_gen():
+        yield Batch(
+            x=np.zeros((1, 1, 1), np.float32),
+            y=np.zeros((1, 1), np.float32),
+            mask=np.ones(1, np.float32),
+        )
+        raise ValueError("composer blew up")
+
+    from fmda_tpu.data.pipeline import Batch
+
+    it = background_compose(bad_gen(), depth=1)
+    next(it)
+    with pytest.raises(ValueError, match="composer blew up"):
+        next(it)
+
+
+def test_background_compose_empty():
+    from fmda_tpu.data.pipeline import background_compose
+
+    assert list(background_compose(iter(()))) == []
+
+
+def test_background_compose_releases_worker_on_abandonment():
+    import threading
+    import time as _time
+
+    from fmda_tpu.data.pipeline import Batch, background_compose
+
+    def gen():
+        for i in range(100):
+            yield Batch(
+                x=np.zeros((1, 1, 1), np.float32),
+                y=np.zeros((1, 1), np.float32),
+                mask=np.ones(1, np.float32),
+            )
+
+    it = background_compose(gen(), depth=1)
+    next(it)
+    it.close()  # consumer abandons mid-stream
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        if not any(t.name == "fmda-batch-compose" and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        _time.sleep(0.05)
+    assert not any(t.name == "fmda-batch-compose" and t.is_alive()
+                   for t in threading.enumerate())

@@ -1,0 +1,153 @@
+"""Fused Pallas flash-attention kernel vs the jnp online-softmax path.
+
+Mirrors the GRU kernel's coverage ladder (tests/test_pallas_gru.py):
+interpret-mode numerical parity (values AND gradients, causal and not,
+f32 and bf16), Mosaic TPU lowering via jax.export without hardware, and
+an on-device parity test gated on a reachable TPU.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.ops.attention import mha
+from fmda_tpu.ops.pallas_attention import (
+    _BLOCK,
+    flash_attention,
+    flash_supported,
+)
+
+
+def _qkv(batch=2, heads=2, seq=2 * _BLOCK, d_head=16, key=0, dtype=None):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    shape = (batch, heads, seq, d_head)
+    q = jax.random.normal(ks[0], shape)
+    k = jax.random.normal(ks[1], shape)
+    v = jax.random.normal(ks[2], shape)
+    if dtype is not None:
+        q, k, v = (x.astype(dtype) for x in (q, k, v))
+    return q, k, v
+
+
+class TestFlashSupported:
+    def test_envelope(self):
+        assert flash_supported(1024, 1024, 32)
+        assert flash_supported(128, 128, 8)
+        assert not flash_supported(30, 30, 8)        # flagship window
+        assert not flash_supported(128, 256, 8)      # ragged streaming
+        assert not flash_supported(1024, 1024, 1024)  # VMEM
+
+    def test_direct_call_raises_outside_envelope(self):
+        q, k, v = _qkv(seq=32)
+        with pytest.raises(ValueError, match="flash_supported"):
+            flash_attention(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_parity(causal):
+    q, k, v = _qkv()
+    ref = mha(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_forward_parity_single_block():
+    """T == one block: the grid degenerates to a single K step."""
+    q, k, v = _qkv(seq=_BLOCK)
+    ref = mha(q, k, v)
+    out = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradient_parity(causal):
+    q, k, v = _qkv(d_head=8)
+
+    def loss(fn):
+        def f(q_, k_, v_):
+            o = fn(q_, k_, v_)
+            return jnp.sum(o * jnp.cos(o))  # non-trivial cotangent
+
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    ref = loss(lambda a, b, c: mha(a, b, c, causal=causal))(q, k, v)
+    out = loss(lambda a, b, c: flash_attention(
+        a, b, c, causal=causal, interpret=True))(q, k, v)
+    for g_out, g_ref, name in zip(out, ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g_out), np.asarray(g_ref), atol=5e-5, rtol=5e-5,
+            err_msg=f"d{name} mismatch (causal={causal})")
+
+
+def test_bf16_close_to_f32_reference():
+    """bf16 I/O with f32 accumulation tracks the f32 reference within
+    bf16 tolerance — catches low-precision accumulator bugs."""
+    q, k, v = _qkv()
+    ref = mha(q, k, v)
+    out = flash_attention(
+        *(x.astype(jnp.bfloat16) for x in (q, k, v)), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+
+def test_mha_dispatch_stays_on_jnp_path_off_tpu():
+    """On this (CPU) CI the dispatch must not touch the kernel; the jnp
+    path remains the executed one."""
+    q, k, v = _qkv()
+    out = mha(q, k, v)  # would raise inside pallas_call on CPU if taken
+    assert out.shape == q.shape
+
+
+def test_mosaic_lowering_via_export():
+    """The kernel lowers through the real Mosaic TPU pass (no hardware
+    needed): value + grad, both causal settings, both dtypes."""
+    q, k, v = _qkv(batch=1, heads=2, seq=2 * _BLOCK, d_head=8)
+
+    for causal in (False, True):
+        for dtype in (jnp.float32, jnp.bfloat16):
+            args = tuple(x.astype(dtype) for x in (q, k, v))
+
+            def train_like(q_, k_, v_, _c=causal):
+                def f(a, b, c):
+                    o = flash_attention(a, b, c, causal=_c)
+                    return jnp.sum(o.astype(jnp.float32) ** 2)
+
+                return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
+
+            exported = jax.export.export(
+                jax.jit(train_like), platforms=["tpu"])(*args)
+            assert "tpu" in exported.platforms
+
+
+def test_flash_on_tpu_device():
+    """On-device parity vs the jnp path — runs only when a TPU is
+    actually reachable (skipped on the CPU-forced CI mesh)."""
+    if jax.default_backend() != "tpu":
+        pytest.skip("no TPU backend in this environment")
+    q, k, v = _qkv(d_head=8)
+
+    def loss(fn):
+        def f(q_, k_, v_):
+            return jnp.sum(fn(q_, k_, v_) ** 2)
+
+        return jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+
+    # mask=() forces the jnp path in mha? no — pass mask=None but call
+    # the online path directly to avoid the dispatch picking the kernel
+    from fmda_tpu.ops import attention as A
+
+    def jnp_mha(q_, k_, v_):
+        state = A.init_online_state(
+            q_.shape[0], q_.shape[1], q_.shape[2], q_.shape[3])
+        state = A.online_attention_block(state, q_, k_, v_, None)
+        return A.finalize_online_state(state, q_.dtype)
+
+    g_pal = loss(lambda a, b, c: flash_attention(a, b, c))(q, k, v)
+    g_ref = loss(jnp_mha)(q, k, v)
+    for a, b in zip(g_pal, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)

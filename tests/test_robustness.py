@@ -47,6 +47,56 @@ def test_retry_transport_exhausts():
         t.get("http://x")
 
 
+def test_rate_limit_transport_spaces_same_host_only():
+    from fmda_tpu.ingest.transport import RateLimitTransport
+
+    class Echo:
+        def get(self, url, headers=None):
+            return b"ok"
+
+    now = {"t": 100.0}
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(round(s, 6))
+        now["t"] += s
+
+    t = RateLimitTransport(
+        Echo(), min_interval_s=2.0, clock=lambda: now["t"], sleep_fn=sleep)
+    t.get("https://a.example/x")        # first: no wait
+    t.get("https://b.example/y")        # different host: no wait
+    assert sleeps == []
+    t.get("https://a.example/z")        # same host, zero elapsed: full wait
+    assert sleeps == [2.0]
+    now["t"] += 5.0                     # interval already elapsed
+    t.get("https://a.example/w")
+    assert sleeps == [2.0]
+
+
+def test_live_transport_is_wired_retry_over_ratelimit():
+    """The hardened default the clients/scrapers construct: retries on
+    the outside (so each retry re-passes the rate limiter), stdlib
+    transport at the core, and a bounded worst case."""
+    from fmda_tpu.ingest.transport import (
+        RateLimitTransport, RetryTransport, UrllibTransport, live_transport)
+
+    t = live_transport(attempts=4, backoff_s=0.5, min_interval_s=3.0)
+    assert isinstance(t, RetryTransport)
+    assert t.attempts == 4
+    assert isinstance(t.inner, RateLimitTransport)
+    assert t.inner.min_interval_s == 3.0
+    assert isinstance(t.inner.inner, UrllibTransport)
+
+
+def test_clients_default_to_hardened_transport():
+    from fmda_tpu.ingest.clients import IEXClient
+    from fmda_tpu.ingest.scrapers import VIXScraper
+    from fmda_tpu.ingest.transport import RetryTransport
+
+    assert isinstance(IEXClient("tok").transport, RetryTransport)
+    assert isinstance(VIXScraper().transport, RetryTransport)
+
+
 # ----------------------------------------------------------------- races
 
 

@@ -17,7 +17,8 @@ def test_corpus_deterministic_and_learnable():
     wh2, _ = build_corpus(fc, cfg)
     n = len(wh1)
     assert n == 4 * cfg.bars_per_day
-    assert stats1 == {"emitted": n, "dropped": 0, "pending": 0}
+    assert (stats1["emitted"], stats1["dropped"], stats1["pending"]) == (
+        n, 0, 0)
     ids = range(1, n + 1)
     np.testing.assert_array_equal(wh1.fetch(ids), wh2.fetch(ids))
     np.testing.assert_array_equal(
