@@ -401,8 +401,11 @@ def phase_multiticker() -> dict:
     # device-resident copies: the step number must measure compute, not
     # the per-step ~10 MB host->device transfer a host-resident numpy
     # batch smuggles into _train_step (which serialises with the tunnel
-    # RTT — the round-3 142-183 ms multiticker "step" was mostly that)
-    staged_dev = [jax.device_put(b) for b in staged]
+    # RTT — the round-3 142-183 ms multiticker "step" was mostly that).
+    # Only a small rotating subset is staged (round-4 advice): the
+    # RTT-cancelling slope loop needs enough distinct batches to dodge
+    # cache effects, not the whole round resident in HBM.
+    staged_dev = [jax.device_put(b) for b in staged[:3]]
 
     for b in staged_dev[:2]:
         state, loss, _ = trainer._train_step(state, b, rng)
@@ -447,12 +450,32 @@ def phase_multiticker() -> dict:
     flops = model_flops_per_step(batch, WINDOW, FEATURES, HIDDEN)
     mfu_est, mfu_peak = _mfu(flops, step_s, dev.device_kind,
                              jax.default_backend())
+    # the overlap claim ("steady state is max(compose, step)") only holds
+    # when the step runs on an accelerator — on a CPU backend the compose
+    # thread and the XLA step compete for the same cores, so pipeline >=
+    # plain is EXPECTED there, not a regression (round-4 anomaly:
+    # pipeline_step_ms 453 > step_ms 436 on the CPU-fallback capture).
+    # On an accelerator the bar is the real overlap target max(step,
+    # compose); on CPU merely not regressing past the serial sum.
+    on_accel = jax.default_backend() != "cpu"
+    compose_per = compose_s / len(staged)
+    if on_accel:
+        overlap_effective = pipeline_s <= max(step_s, compose_per) * 1.25
+    else:
+        overlap_effective = pipeline_s <= (step_s + compose_per) * 1.1
     return {
         "seq_s": round(batch / step_s, 1),
         "step_ms": round(step_s * 1e3, 3),
         "pipeline_step_ms": round(pipeline_s * 1e3, 3),
         "pipeline_seq_s": round(batch / pipeline_s, 1),
         "compose_ms_per_batch": round(compose_s / len(staged) * 1e3, 3),
+        "overlap_effective": bool(overlap_effective),
+        "overlap_note": (
+            "pipeline overlap is host-vs-device; on a cpu backend compose "
+            "and step share cores, so pipeline_step_ms ~ step_ms + "
+            "compose is expected" if not on_accel else
+            "accelerator backend: pipeline_step_ms should approach "
+            "max(step_ms, compose_ms_per_batch)"),
         "backend": jax.default_backend(),
         "device_kind": dev.device_kind,
         "composition": f"{n_tickers} tickers x {per_ticker} windows, "
@@ -1014,12 +1037,23 @@ def _log_probe(probe: dict, context: str) -> None:
 
 def _wait_for_tpu(interval_s: float, budget_s: float) -> int:
     """Re-probe the ambient backend until it reports an accelerator, then
-    immediately capture the first on-TPU evidence: the TPU-gated kernel
-    parity test plus the flagship/longctx/serving phases, committing
-    partial results to BENCH_TPU.json as they land.
+    capture on-TPU evidence in TIERS (round-4 verdict next #8: one
+    10-minute tunnel window all day argues against all-or-nothing):
+
+      tier 1 "smoke" (~2-4 min): flagship pallas/scan pair + the flash
+        attention TPU parity test — the minimum artifact that settles
+        the kernel-vs-scan verdict and proves the flash kernel runs.
+      tier 2 "full": second flagship pair (reproducibility), kernel
+        parity tests, kernel_sweep, wide-MFU probe, longctx, multiticker,
+        serving — the complete round-5 evidence list.
+
+    Each capture writes the next free BENCH_TPU_r05[_N].json with a
+    flush after every phase, so a dying tunnel leaves whatever landed.
+    If the tunnel dies mid-capture (2 consecutive phase timeouts) the
+    watcher goes back to probing; only a COMPLETE full tier ends it.
 
     Run in the background for most of a round:
-        python bench.py --wait-for-tpu --probe-interval 600 &
+        python bench.py --wait-for-tpu --probe-interval 240 &
     """
     deadline = time.monotonic() + budget_s
     attempt = 0
@@ -1030,7 +1064,14 @@ def _wait_for_tpu(interval_s: float, budget_s: float) -> int:
         backend = probe.get("backend")
         if backend and backend != "cpu":
             print(f"TPU alive on attempt {attempt}: {probe}", file=sys.stderr)
-            return _capture_tpu_evidence(probe)
+            rc = _capture_tpu_evidence(probe)
+            if rc != 2:
+                # 0 = complete capture, 3 = complete but a gated test
+                # failed — both are final results; only tunnel death (2)
+                # warrants re-running the capture
+                return rc
+            print("capture aborted mid-run (tunnel died); resuming probe "
+                  "loop", file=sys.stderr)
         wait = min(interval_s, max(0.0, deadline - time.monotonic()))
         if wait <= 0:
             break
@@ -1040,19 +1081,83 @@ def _wait_for_tpu(interval_s: float, budget_s: float) -> int:
     return 1
 
 
+#: TPU-gated pytest node ids run during capture (tier -> list of node ids).
+_GATED_TESTS = {
+    "smoke": [
+        "tests/test_pallas_attention.py::test_flash_on_tpu_device",
+    ],
+    "full": [
+        "tests/test_pallas_gru.py::test_pallas_kernel_on_tpu_device",
+        "tests/test_pallas_lstm.py::test_pallas_lstm_on_tpu_device",
+    ],
+}
+
+#: (name, budget_s, alias) phase plans per capture tier.  Aliases let the
+#: full tier re-run the flagship pair under a distinct key — the round-4
+#: verdict's missing reproducibility check (67.6k vs 34.9k contradiction).
+_TIER_PLANS = {
+    "smoke": [
+        ("flagship_pallas", 420.0, "flagship_pallas"),
+        ("flagship_scan", 420.0, "flagship_scan"),
+    ],
+    "full": [
+        ("flagship_pallas", 420.0, "flagship_pallas_rerun"),
+        ("flagship_scan", 420.0, "flagship_scan_rerun"),
+        ("kernel_sweep", 900.0, "kernel_sweep"),
+        ("flagship_bf16", 420.0, "flagship_bf16"),
+        ("flagship_wide", 600.0, "flagship_wide"),
+        ("longctx", 900.0, "longctx"),
+        ("longctx_attn", 900.0, "longctx_attn"),
+        ("multiticker", 600.0, "multiticker"),
+        ("serving", 600.0, "serving"),
+        ("train_e2e", 900.0, "train_e2e"),
+    ],
+}
+
+
+def _run_gated_test(node_id: str, env: dict, timeout_s: float = 600.0) -> dict:
+    """Run one TPU-gated pytest node; only an actual '1 passed' counts
+    (pytest exits 0 on an all-skipped run too — the gated test skips if
+    the backend flipped back to CPU between the probe and this
+    subprocess)."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", node_id, "-x", "-q",
+             "--no-header"],
+            env=env, cwd=_REPO_DIR, timeout=timeout_s,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        tail = proc.stdout.decode(errors="replace")[-1200:]
+        return {
+            "rc": proc.returncode,
+            "passed": proc.returncode == 0 and "1 passed" in tail,
+            "output_tail": tail,
+            "wall_s": round(time.monotonic() - t0, 1),
+        }
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s:.0f}s",
+                "wall_s": round(time.monotonic() - t0, 1)}
+
+
 def _capture_tpu_evidence(probe: dict) -> int:
-    """The moment a probe succeeds: kernel parity test first (the single
-    most important on-device artifact), then the bench phases, writing
-    BENCH_TPU.json incrementally so a tunnel that dies mid-run still
-    leaves whatever landed.  Never overwrites an earlier capture — each
-    revival writes the next free BENCH_TPU[_N].json, so a partial second
-    window cannot clobber committed first-capture evidence."""
-    out_path = os.path.join(_REPO_DIR, "BENCH_TPU.json")
+    """The moment a probe succeeds: smoke tier first (flagship pair +
+    flash parity — the minimum decisive artifact), flushed to disk after
+    every phase, then the full tier while the tunnel holds.  Never
+    overwrites an earlier capture — each revival writes the next free
+    BENCH_TPU_r05[_N].json.  Returns 0 only for a complete full-tier
+    capture; 2 when the tunnel died mid-run (caller resumes probing)."""
+    out_path = os.path.join(_REPO_DIR, "BENCH_TPU_r05.json")
     n = 2
     while os.path.exists(out_path):
-        out_path = os.path.join(_REPO_DIR, f"BENCH_TPU_{n}.json")
+        out_path = os.path.join(_REPO_DIR, f"BENCH_TPU_r05_{n}.json")
         n += 1
-    results: dict = {"probe": probe, "phases": {}}
+    try:
+        loadavg = os.getloadavg()
+    except OSError:
+        loadavg = None
+    results: dict = {"probe": probe, "loadavg_at_start": loadavg,
+                     "tiers_completed": [], "gated_tests": {}, "phases": {}}
 
     def _flush():
         with open(out_path, "w") as f:
@@ -1060,61 +1165,132 @@ def _capture_tpu_evidence(probe: dict) -> int:
 
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO_DIR + os.pathsep + env.get("PYTHONPATH", "")
-    # conftest forces CPU by default; keep the ambient TPU for the gated test
+    # conftest forces CPU by default; keep the ambient TPU for gated tests
     env["FMDA_TESTS_KEEP_PLATFORM"] = "1"
-    # 1. on-device kernel parity (tests/test_pallas_gru.py TPU-gated test)
-    t0 = time.monotonic()
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-m", "pytest",
-             "tests/test_pallas_gru.py::test_pallas_kernel_on_tpu_device",
-             "-x", "-q", "--no-header"],
-            env=env, cwd=_REPO_DIR, timeout=900.0,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        )
-        tail = proc.stdout.decode(errors="replace")[-1500:]
-        results["kernel_parity_test"] = {
-            "rc": proc.returncode,
-            # pytest exits 0 on an all-skipped run too (the gated test
-            # skips if the backend flipped back to CPU between the probe
-            # and this subprocess) — only an actual "1 passed" counts
-            "passed": proc.returncode == 0 and "1 passed" in tail,
-            "output_tail": tail,
-            "wall_s": round(time.monotonic() - t0, 1),
-        }
-    except subprocess.TimeoutExpired:
-        results["kernel_parity_test"] = {"error": "timeout after 900s"}
-    _flush()
-    print(f"kernel parity: {results['kernel_parity_test']}", file=sys.stderr)
 
-    # 2. bench phases, most valuable first
-    for name, budget in [
-        ("flagship_pallas", 600.0),
-        ("flagship_scan", 600.0),
-        ("kernel_sweep", 900.0),
-        ("flagship_bf16", 600.0),
-        ("flagship_wide", 600.0),
-        ("train_e2e", 900.0),
-        ("longctx", 900.0),
-        ("longctx_attn", 900.0),
-        ("multiticker", 600.0),
-        ("serving", 600.0),
-    ]:
-        phase_env = env
-        if name == "flagship_pallas":
-            # an on-device XProf trace rides along with the first phase
-            # (utils.tracing.device_trace via FMDA_PROFILE_DIR)
-            phase_env = dict(env)
-            phase_env["FMDA_PROFILE_DIR"] = os.path.join(
-                _REPO_DIR, "artifacts", "profile_tpu")
-        t0 = time.monotonic()
-        results["phases"][name] = _run_phase_subprocess(
-            name, phase_env, budget)
-        results["phases"][name]["wall_s"] = round(time.monotonic() - t0, 1)
+    def _tunnel_dead() -> bool:
+        # two consecutive timeouts/rc-failures = the relay is gone; stop
+        # burning phase budgets against a dead stdio pipe
+        vals = list(results["phases"].values())
+        if len(vals) < 2:
+            return False
+        return all("error" in v and ("timeout" in v["error"]
+                                     or "rc=" in v["error"])
+                   for v in vals[-2:])
+
+    for tier in ("smoke", "full"):
+        for node_id in _GATED_TESTS[tier]:
+            key = node_id.rsplit("::", 1)[-1]
+            results["gated_tests"][key] = _run_gated_test(node_id, env)
+            _flush()
+            print(f"gated {key}: {results['gated_tests'][key]}",
+                  file=sys.stderr)
+        for name, budget, alias in _TIER_PLANS[tier]:
+            phase_env = env
+            if alias == "flagship_pallas":
+                # an on-device XProf trace rides along with the first
+                # phase (utils.tracing.device_trace via FMDA_PROFILE_DIR)
+                phase_env = dict(env)
+                phase_env["FMDA_PROFILE_DIR"] = os.path.join(
+                    _REPO_DIR, "artifacts", "profile_tpu")
+            t0 = time.monotonic()
+            results["phases"][alias] = _run_phase_subprocess(
+                name, phase_env, budget)
+            results["phases"][alias]["wall_s"] = round(
+                time.monotonic() - t0, 1)
+            _flush()
+            print(f"phase {alias}: {results['phases'][alias]}",
+                  file=sys.stderr)
+            if _tunnel_dead():
+                results["aborted"] = (f"tunnel died during tier '{tier}' "
+                                      f"(2 consecutive phase failures)")
+                _flush()
+                return 2
+        results["tiers_completed"].append(tier)
         _flush()
-        print(f"phase {name}: {results['phases'][name]}", file=sys.stderr)
-    ok = results.get("kernel_parity_test", {}).get("passed", False)
-    return 0 if ok else 2
+    # complete capture: stop the watcher either way — a genuinely FAILED
+    # gated test on a live tunnel is a result to report, not a reason to
+    # re-run the whole multi-hour capture in a loop (rc=2 is reserved for
+    # tunnel death, which the caller answers by resuming the probe loop)
+    ok = all(t.get("passed") for t in results["gated_tests"].values())
+    if not ok:
+        results["gated_test_failures"] = sorted(
+            k for k, t in results["gated_tests"].items()
+            if not t.get("passed"))
+        _flush()
+    return 0 if ok else 3
+
+
+_HISTORY_PATH = os.path.join(_REPO_DIR, "artifacts", "bench_history.jsonl")
+
+
+def _load_prev_round_bench():
+    """(label, record) of the most recent full bench run, or None — used
+    to annotate drift (round-4 verdict next #4: r04 silently halved CPU
+    throughput vs r03; a bench that can silently halve can't catch a
+    real 2x loss).  Prefers bench's own history file (full fidelity);
+    falls back to the driver's BENCH_r{NN}.json wrappers, whose
+    ``parsed`` field is the bench JSON when the driver could parse it
+    (its ``tail`` is head-truncated and useless)."""
+    import glob
+
+    try:
+        lines = [ln for ln in open(_HISTORY_PATH).read().splitlines() if ln]
+        if lines:
+            return "bench_history[-1]", json.loads(lines[-1])
+    except (OSError, json.JSONDecodeError):
+        pass
+    cands = sorted(glob.glob(os.path.join(_REPO_DIR, "BENCH_r[0-9]*.json")))
+    for path in reversed(cands):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(d.get("parsed"), dict):  # driver wrapper
+            return os.path.basename(path), d["parsed"]
+        if "phases" in d:  # raw bench output committed directly
+            return os.path.basename(path), d
+    return None
+
+
+def _append_history(record: dict) -> None:
+    try:
+        os.makedirs(os.path.dirname(_HISTORY_PATH), exist_ok=True)
+        with open(_HISTORY_PATH, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        pass
+
+
+def _annotate_vs_prev(phases: dict, prev_name: str, prev: dict) -> None:
+    """Attach per-phase ``vs_prev`` (improvement factor vs the previous
+    round's artifact) in place.  factor > 1 = this round is better.
+    ``drift: true`` marks a >1.5x move in either direction on a
+    same-backend comparison — cross-backend ratios (cpu round vs tpu
+    round) are reported but never flagged, they measure the hardware."""
+    prev_phases = prev.get("phases", {})
+    for name, cur in phases.items():
+        pv = prev_phases.get(name)
+        if not isinstance(pv, dict) or not isinstance(cur, dict):
+            continue
+        if "seq_s" in cur and pv.get("seq_s"):
+            factor = cur["seq_s"] / pv["seq_s"]
+            metric = "seq_s"
+        elif "p50_ms" in cur and pv.get("p50_ms"):
+            factor = pv["p50_ms"] / cur["p50_ms"]  # lower latency = better
+            metric = "p50_ms"
+        else:
+            continue
+        same_backend = cur.get("backend") == pv.get("backend")
+        cur["vs_prev"] = {
+            "artifact": prev_name,
+            "metric": metric,
+            "factor": round(factor, 3),
+            "prev_backend": pv.get("backend"),
+            "drift": bool(same_backend
+                          and (factor > 1.5 or factor < 1 / 1.5)),
+        }
 
 
 def main() -> None:
@@ -1196,7 +1372,15 @@ def main() -> None:
         round(value / torch_seq_s, 2) if torch_seq_s and value else None
     )
 
-    print(json.dumps({
+    prev = _load_prev_round_bench()
+    if prev is not None:
+        _annotate_vs_prev(phases, *prev)
+    try:
+        loadavg = [round(v, 2) for v in os.getloadavg()]
+    except OSError:
+        loadavg = None
+
+    record = {
         "metric": (
             "seq/sec/chip (biGRU train step, "
             f"B={BATCH} T={WINDOW} F={FEATURES} H={HIDDEN})"
@@ -1207,8 +1391,18 @@ def main() -> None:
         "backend": headline.get("backend", backend),
         "device_kind": headline.get("device_kind", device_kind),
         "fallback": fallback,
+        # host-load context: a loaded host explains (and annotates) a
+        # CPU-number collapse like r03->r04's silent halving
+        "loadavg": loadavg,
+        "vs_prev_artifact": prev[0] if prev else None,
+        "drift_flags": sorted(
+            n for n, p in phases.items()
+            if isinstance(p, dict) and p.get("vs_prev", {}).get("drift")),
         "phases": phases,
-    }))
+    }
+    record["utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    _append_history(record)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -611,6 +611,7 @@ class StreamEngine:
             "offsets": {t: c.offset for t, c in self._consumers.items()},
             "emitted": self._emitted,
             "dropped": self._dropped,
+            "max_deep_ts": self._max_deep_ts,
             "pending_deep": [dump_event(e) for e in self._pending_deep],
             "buffers": {
                 t: {
@@ -645,9 +646,11 @@ class StreamEngine:
         # the join loop trusts sorted order; make the invariant
         # self-establishing for checkpoints from any writer
         self._pending_deep.sort(key=lambda e: e.ts)
-        # stream-time "now" for watermark ages: the best post-restore
-        # estimate is the newest still-pending tick (already-joined ticks
-        # don't matter for the ages' None-vs-stale distinction)
+        # stream-time "now" for watermark ages: persisted exactly since
+        # round 5 (a checkpoint taken after all ticks joined would
+        # otherwise restore with no age signal until the next tick);
+        # older checkpoints fall back to the newest still-pending tick
+        self._max_deep_ts = state.get("max_deep_ts", self._max_deep_ts)
         if self._pending_deep:
             self._max_deep_ts = max(
                 self._max_deep_ts, self._pending_deep[-1].ts)

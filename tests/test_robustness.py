@@ -73,6 +73,44 @@ def test_rate_limit_transport_spaces_same_host_only():
     assert sleeps == [2.0]
 
 
+def test_rate_limit_transports_share_per_host_state():
+    """Two components each defaulting to their own live_transport() are
+    JOINTLY spaced per host (round-4 advice: the reference's scrapy
+    throttle is global, so per-instance state under-throttles)."""
+    from fmda_tpu.ingest.transport import RateLimitTransport
+
+    class Echo:
+        def get(self, url, headers=None):
+            return b"ok"
+
+    now = {"t": 100.0}
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(round(s, 6))
+        now["t"] += s
+
+    kw = dict(min_interval_s=2.0, clock=lambda: now["t"], sleep_fn=sleep,
+              shared=True)
+    try:
+        t1 = RateLimitTransport(Echo(), **kw)
+        t2 = RateLimitTransport(Echo(), **kw)
+        t1.get("https://shared.example/a")   # first: no wait
+        t2.get("https://shared.example/b")   # OTHER instance, same host: wait
+        assert sleeps == [2.0]
+        # instances created with a private map (clock injected, shared
+        # defaulted) do not see the shared history
+        t3 = RateLimitTransport(
+            Echo(), min_interval_s=2.0, clock=lambda: now["t"],
+            sleep_fn=sleep)
+        t3.get("https://shared.example/c")
+        assert sleeps == [2.0]
+    finally:
+        from fmda_tpu.ingest import transport as _tr
+
+        _tr._SHARED_LAST.clear()  # don't leak fake-clock entries
+
+
 def test_live_transport_is_wired_retry_over_ratelimit():
     """The hardened default the clients/scrapers construct: retries on
     the outside (so each retry re-passes the rate limiter), stdlib

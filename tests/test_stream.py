@@ -335,6 +335,11 @@ def test_engine_checkpoint_resume(tmp_path):
     )
     assert eng2.step() == 0
     assert len(wh) == 3
+    # stream-time "now" survives the restart even with nothing pending
+    # (round-4 advice: a post-join checkpoint restored watermark_age_s to
+    # None, indistinguishable from 'never saw data')
+    assert eng2._max_deep_ts == eng._max_deep_ts >= 0
+    assert eng2.stats["watermark_age_s"] == eng.stats["watermark_age_s"]
     # new data still flows
     for topic, msg in _session_messages(1, start="2020-02-07 10:30:00"):
         bus.publish(topic, msg)
