@@ -101,6 +101,40 @@ def finalize_online_state(
     return (state.o / l[..., None]).astype(dtype)
 
 
+def merge_softmax_segments(
+    o1: jax.Array,  # (..., T, D) — normalized attention over key set S1
+    lse1: jax.Array,  # (..., T) — logsumexp of S1's scores
+    o2: jax.Array,
+    lse2: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exactly combine two *normalized* attention results over disjoint
+    key segments into the result over their union.
+
+    With ``o_i = softmax(S_i) @ V_i`` and ``lse_i = logsumexp(S_i)``,
+    the unnormalized numerator of segment i is ``o_i * exp(lse_i)``, so::
+
+        m   = max(lse1, lse2)
+        a_i = exp(lse_i - m)
+        o   = (o1*a1 + o2*a2) / (a1 + a2)
+        lse = m + log(a1 + a2)
+
+    This is the segment-level form of the same online-softmax identity
+    :func:`online_attention_block` applies blockwise — it lets ring
+    attention fold one fused flash-kernel call per ring step
+    (each returning (o, lse) for its K/V block) with O(T*D) elementwise
+    work, no score materialisation.  Empty segments are represented by a
+    large-negative finite lse (the flash kernel's -1e30 sentinel): their
+    weight underflows to exactly 0, and merging two empty segments
+    yields o = 0 without NaNs (which -inf arithmetic would produce).
+    """
+    m = jnp.maximum(lse1, lse2)
+    a1 = jnp.exp(lse1 - m)
+    a2 = jnp.exp(lse2 - m)
+    denom = a1 + a2
+    o = (o1 * a1[..., None] + o2 * a2[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
 def flash_available() -> bool:
     """True when the fused Pallas flash-attention kernel can run here."""
     try:

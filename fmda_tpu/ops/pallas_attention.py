@@ -111,31 +111,41 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr[:])
         acc_scr[:] = jnp.zeros_like(acc_scr[:])
 
-    f32 = jnp.float32
-    q = q_ref[0]
-    k = k_ref[0]
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
-    ) * scale
-    if causal:
-        s = jnp.where(_causal_mask_block(qi, ki), s, _NEG)
+    def _compute():
+        f32 = jnp.float32
+        q = q_ref[0]
+        k = k_ref[0]
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
+        ) * scale
+        if causal:
+            s = jnp.where(_causal_mask_block(qi, ki), s, _NEG)
 
-    m_prev = m_scr[:, :1]  # (BLOCK, 1); lanes are replicated
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    corr = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    # exactly zero where masked (s==_NEG - m_new underflows to 0 anyway
-    # unless the whole row is masked and m_new==_NEG; this kills that)
-    p = jnp.where(s <= _NEG * 0.5, 0.0, p)
-    l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=f32,
-    )
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        m_prev = m_scr[:, :1]  # (BLOCK, 1); lanes are replicated
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        # exactly zero where masked (s==_NEG - m_new underflows to 0
+        # anyway unless the whole row is masked and m_new==_NEG; this
+        # kills that)
+        p = jnp.where(s <= _NEG * 0.5, 0.0, p)
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # blocks strictly above the diagonal are fully masked: skip their
+        # MXU/VPU work entirely (round-4 advice: causal paid ~2x), the
+        # state update is a no-op there by construction
+        pl.when(ki <= qi)(_compute)
+    else:
+        _compute()
 
     @pl.when(ki == n_k - 1)
     def _finalize():
@@ -208,32 +218,41 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr[:])
         dv_scr[:] = jnp.zeros_like(dv_scr[:])
 
-    f32 = jnp.float32
-    q = q_ref[0]
-    k = k_ref[0]
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
-    ) * scale
-    if causal:
-        s = jnp.where(_causal_mask_block(qi, ki), s, _NEG)
-    p = jnp.exp(s - lse_ref[0][:, :1])
-    p = jnp.where(s <= _NEG * 0.5, 0.0, p)
+    def _compute():
+        f32 = jnp.float32
+        q = q_ref[0]
+        k = k_ref[0]
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
+        ) * scale
+        if causal:
+            s = jnp.where(_causal_mask_block(qi, ki), s, _NEG)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = jnp.where(s <= _NEG * 0.5, 0.0, p)
 
-    do = do_ref[0]
-    io_dtype = q_ref.dtype
-    # dv += p^T @ do   (contract the query rows)
-    p_c = p.astype(io_dtype)
-    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-        p_c, do, (((0,), (0,)), ((), ())), preferred_element_type=f32)
-    # ds = p * (do @ v^T - delta) * scale
-    dp = jax.lax.dot_general(
-        do, v_ref[0], (((1,), (1,)), ((), ())), preferred_element_type=f32)
-    ds = p * (dp - delta_ref[0][:, :1]) * scale
-    # dk += ds^T @ q
-    dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-        ds.astype(io_dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=f32)
+        do = do_ref[0]
+        io_dtype = q_ref.dtype
+        # dv += p^T @ do   (contract the query rows)
+        p_c = p.astype(io_dtype)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p_c, do, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+        # ds = p * (do @ v^T - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=f32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        # dk += ds^T @ q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds.astype(io_dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=f32)
+
+    if causal:
+        # query blocks above the diagonal contribute nothing to this
+        # K/V block's gradients — skip their matmuls
+        pl.when(qi >= ki)(_compute)
+    else:
+        _compute()
 
     @pl.when(qi == n_q - 1)
     def _flush():
@@ -261,25 +280,33 @@ def _dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr[:])
 
-    f32 = jnp.float32
-    q = q_ref[0]
-    k = k_ref[0]
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
-    ) * scale
-    if causal:
-        s = jnp.where(_causal_mask_block(qi, ki), s, _NEG)
-    p = jnp.exp(s - lse_ref[0][:, :1])
-    p = jnp.where(s <= _NEG * 0.5, 0.0, p)
+    def _compute():
+        f32 = jnp.float32
+        q = q_ref[0]
+        k = k_ref[0]
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=f32
+        ) * scale
+        if causal:
+            s = jnp.where(_causal_mask_block(qi, ki), s, _NEG)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = jnp.where(s <= _NEG * 0.5, 0.0, p)
 
-    dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=f32)
-    ds = p * (dp - delta_ref[0][:, :1]) * scale
-    dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
-        ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=f32)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=f32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32)
+
+    if causal:
+        # key blocks past the diagonal are fully masked for this query
+        # block — no dq contribution, skip the matmuls
+        pl.when(ki <= qi)(_compute)
+    else:
+        _compute()
 
     @pl.when(ki == n_k - 1)
     def _flush():
@@ -287,13 +314,18 @@ def _dq_kernel(
 
 
 def _bwd_impl(
-    q, k, v, o, lse, do, *, causal: bool, interpret: bool
+    q, k, v, o, lse, do, dlse=None, *, causal: bool, interpret: bool
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     bn, t, d = q.shape
     n_blk = t // _BLOCK
     # delta = rowsum(do * o): cheap elementwise+reduce, plain XLA; ride
-    # it in lane-replicated, matching lse's layout
+    # it in lane-replicated, matching lse's layout.  An lse cotangent
+    # (the ring path differentiates through the per-block logsumexp)
+    # folds in for free: d lse_i / d s_ij = p_ij, so
+    # ds = p * (dp - delta + dlse) * scale — i.e. delta -= dlse.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (bn, t, 128))
 
     qspec = pl.BlockSpec((1, _BLOCK, d), lambda b, ki, qi: (b, qi, 0))
@@ -341,18 +373,19 @@ def _bwd_impl(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, interpret):
-    o, _ = _fwd_impl(q, k, v, causal=causal, interpret=interpret)
-    return o
+    o, lse = _fwd_impl(q, k, v, causal=causal, interpret=interpret)
+    return o, lse[..., 0]
 
 
 def _flash_fwd(q, k, v, causal, interpret):
     o, lse = _fwd_impl(q, k, v, causal=causal, interpret=interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse[..., 0]), (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, interpret, residuals, do):
+def _flash_bwd(causal, interpret, residuals, cts):
     q, k, v, o, lse = residuals
-    return _bwd_impl(q, k, v, o, lse, do, causal=causal,
+    do, dlse = cts
+    return _bwd_impl(q, k, v, o, lse, do, dlse, causal=causal,
                      interpret=interpret)
 
 
@@ -375,11 +408,36 @@ def flash_attention(
     ``mha(..., )``'s dispatch rather than directly unless you have
     already checked :func:`flash_supported`.
     """
+    out, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, interpret=interpret)
+    return out
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fused attention returning ``(o, lse)`` — o (B, N, T, D) in q's
+    dtype plus the per-row logsumexp (B, N, T) f32.
+
+    The lse is what makes the output *mergeable*: two attention results
+    over disjoint key segments combine exactly via
+    :func:`fmda_tpu.ops.attention.merge_softmax_segments`, which is how
+    ring attention folds one fused-kernel call per ring step
+    (parallel/ring_attention.py) instead of materialising jnp score
+    blocks.  Differentiable in both outputs (the lse cotangent folds
+    into the backward's delta term).  Fully-masked rows report
+    ``lse = -1e30`` (the kernel's finite -inf sentinel) and ``o = 0``.
+    """
     b, n, t, d = q.shape
     if not flash_supported(q.shape[-2], k.shape[-2], d):
         raise ValueError(
             f"flash kernel unsupported for Tq={q.shape[-2]} "
             f"Tk={k.shape[-2]} D={d}; gate on flash_supported()")
     fold = lambda x: x.reshape(b * n, t, d)
-    out = _flash(fold(q), fold(k), fold(v), causal, interpret)
-    return out.reshape(b, n, t, d)
+    out, lse = _flash(fold(q), fold(k), fold(v), causal, interpret)
+    return out.reshape(b, n, t, d), lse.reshape(b, n, t)

@@ -34,12 +34,88 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fmda_tpu.ops.attention import (
     finalize_online_state,
+    flash_available,
     init_online_state,
     merge_heads,
+    merge_softmax_segments,
     online_attention_block,
     split_heads,
 )
 from fmda_tpu.parallel.collectives import all_gather, all_reduce_sum, ring_shift
+
+
+def flash_ring_supported(t_local: int, d_head: int) -> bool:
+    """Can the fused flash kernel serve as the per-ring-step fold?  Each
+    step is a Tq=Tk=T_local self-shaped block, so the single-device
+    envelope applies to the LOCAL shard length."""
+    from fmda_tpu.ops.pallas_attention import flash_supported
+
+    return flash_supported(t_local, t_local, d_head)
+
+
+def _ring_attention_flash(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool,
+    interpret: bool,
+) -> jax.Array:
+    """Ring attention with the fused Pallas kernel as the per-step fold.
+
+    Each ring step runs one flash-kernel call on the local (T_local,
+    T_local) block — scores live only in VMEM — returning ``(o, lse)``,
+    merged into the running result by
+    :func:`~fmda_tpu.ops.attention.merge_softmax_segments` (O(T_local*D)
+    elementwise).  The jnp path materialises the same block in HBM per
+    step (round-4 verdict next #2: at T=1024, sp=4 that is a (256, 256)
+    f32 score block per head per step).
+
+    Causal structure: device ``idx`` owns global Q block ``idx``; ring
+    step ``s`` delivers K/V block ``owner = (idx - s) mod n``.
+
+    - ``s == 0`` → ``owner == idx``: the diagonal block — the kernel's
+      in-kernel causal mask is exactly right (both offsets equal, so
+      local positions ARE the global comparison).
+    - ``s > 0`` → strictly past (``owner < idx``, full attention) or
+      strictly future (``owner > idx``, fully masked).  A two-branch
+      ``lax.cond`` skips the future blocks' kernel work entirely —
+      the ring-level form of the kernel's own diagonal block skip.
+    """
+    from fmda_tpu.ops.pallas_attention import _NEG, flash_attention_with_lse
+
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    batch, n_heads, t_local, d_head = q.shape
+    f32 = jnp.float32
+
+    def block(qkv, blk_causal):
+        o_blk, lse_blk = flash_attention_with_lse(
+            *qkv, causal=blk_causal, interpret=interpret)
+        return o_blk.astype(f32), lse_blk
+
+    o, lse = block((q, k, v), causal)  # s = 0: the diagonal block
+    k_blk, v_blk = k, v
+    for s in range(1, n):  # static: mesh size known at trace time
+        k_blk = ring_shift(k_blk, axis_name)
+        v_blk = ring_shift(v_blk, axis_name)
+        if causal:
+            owner = (idx - s) % n
+
+            def _empty(qkv):
+                return (
+                    jnp.zeros((batch, n_heads, t_local, d_head), f32),
+                    jnp.full((batch, n_heads, t_local), _NEG, f32),
+                )
+
+            o_blk, lse_blk = jax.lax.cond(
+                owner > idx, _empty, lambda qkv: block(qkv, False),
+                (q, k_blk, v_blk))
+        else:
+            o_blk, lse_blk = block((q, k_blk, v_blk), False)
+        o, lse = merge_softmax_segments(o, lse, o_blk, lse_blk)
+    return o.astype(q.dtype)
 
 
 def ring_attention(
@@ -49,6 +125,8 @@ def ring_attention(
     axis_name: str,
     *,
     causal: bool = False,
+    use_flash: bool = False,
+    flash_interpret: bool = False,
 ) -> jax.Array:
     """Sequence-sharded attention (call inside shard_map).
 
@@ -57,12 +135,24 @@ def ring_attention(
         sequence is the concatenation of shards in mesh-axis order.
       axis_name: the sp mesh axis the sequence is sharded over.
       causal: apply the causal mask in *global* positions.
+      use_flash: fold each ring step with the fused Pallas flash kernel
+        where the local shard fits its envelope (TPU backends; the attn
+        family's ``ModelConfig.use_pallas``).  Off-envelope or off-TPU
+        silently uses the jnp online-softmax fold — same math either way
+        (locked by tests/test_ring_attention.py).
+      flash_interpret: run the kernel in interpret mode (tests on the
+        CPU mesh exercise the REAL flash ring path this way).
 
     Returns this device's output shard (B, N, T_local, D), in q's dtype.
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     batch, n_heads, t_local, d_head = q.shape
+
+    if (use_flash and flash_ring_supported(t_local, d_head)
+            and (flash_interpret or flash_available())):
+        return _ring_attention_flash(
+            q, k, v, axis_name, causal=causal, interpret=flash_interpret)
 
     state = init_online_state(batch, n_heads, t_local, d_head)
     k_blk, v_blk = k, v
@@ -97,6 +187,8 @@ def sp_attn_apply(
     cfg,
     axis_name: str,
     seq_len: int,
+    *,
+    flash_interpret: bool = False,
 ) -> jax.Array:
     """Sequence-sharded :class:`~fmda_tpu.models.attn.TemporalTransformer`
     forward (shard_map body): embed/LN/MLP run on the local time block,
@@ -138,6 +230,9 @@ def sp_attn_apply(
             split_heads(v, n_heads),
             axis_name,
             causal=cfg.attn_causal,
+            # same opt-in the unsharded module uses (models/attn.py:90)
+            use_flash=cfg.use_pallas,
+            flash_interpret=flash_interpret,
         )
         x = x + dense(blk["proj"], merge_heads(out))
 
@@ -176,6 +271,7 @@ def make_attn_sp_forward(
     *,
     dp_axis: str = "dp",
     sp_axis: str = "sp",
+    flash_interpret: bool = False,
 ):
     """Jit-ready sequence-parallel transformer forward over a (dp, sp)
     mesh: x (B, T, F) sharded (dp, sp), params replicated, logits (B, C)
@@ -192,7 +288,8 @@ def make_attn_sp_forward(
         check_vma=False,
     )
     def forward(params, x_local):
-        return sp_attn_apply(params, x_local, cfg, sp_axis, seq_len)
+        return sp_attn_apply(params, x_local, cfg, sp_axis, seq_len,
+                             flash_interpret=flash_interpret)
 
     return forward
 
@@ -203,6 +300,8 @@ def make_ring_attention(
     axis_name: str = "sp",
     batch_axis: Optional[str] = "dp",
     causal: bool = False,
+    use_flash: bool = False,
+    flash_interpret: bool = False,
 ):
     """Wire :func:`ring_attention` into a jittable function over the mesh.
 
@@ -217,8 +316,13 @@ def make_ring_attention(
     @functools.partial(
         jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs don't carry vma annotations, so the static
+        # checker can't type the flash fold; the specs are still enforced
+        check_vma=False,
     )
     def fn(q, k, v):
-        return ring_attention(q, k, v, axis_name, causal=causal)
+        return ring_attention(
+            q, k, v, axis_name, causal=causal, use_flash=use_flash,
+            flash_interpret=flash_interpret)
 
     return fn

@@ -94,6 +94,60 @@ def test_bf16_close_to_f32_reference():
         np.asarray(out, np.float32), np.asarray(ref), atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_with_lse_matches_reference_logsumexp(causal):
+    """The (o, lse) variant: lse must equal logsumexp of the scaled
+    (masked) scores row-wise — the contract merge_softmax_segments
+    relies on."""
+    from fmda_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    q, k, v = _qkv(d_head=8)
+    o, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                      interpret=True)
+    s = jnp.einsum("bnqd,bnkd->bnqk", q, k) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], jnp.float32))
+    if causal:
+        t = q.shape[-2]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool)), s, -jnp.inf)
+    ref_lse = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(lse), np.asarray(ref_lse), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(mha(q, k, v, causal=causal)),
+        atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_with_lse_gradient_parity_including_lse_cotangent(causal):
+    """Gradients when the loss touches BOTH outputs — the dlse term the
+    ring merge differentiates through (bwd folds it as delta - dlse)."""
+    from fmda_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    q, k, v = _qkv(d_head=8)
+
+    def ref_loss(q_, k_, v_):
+        s = jnp.einsum("bnqd,bnkd->bnqk", q_, k_) / jnp.sqrt(
+            jnp.asarray(q_.shape[-1], jnp.float32))
+        if causal:
+            t = q_.shape[-2]
+            s = jnp.where(jnp.tril(jnp.ones((t, t), bool)), s, -jnp.inf)
+        o = jnp.einsum("bnqk,bnkd->bnqd", jax.nn.softmax(s, axis=-1), v_)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        return jnp.sum(o * jnp.cos(o)) + jnp.sum(jnp.sin(lse))
+
+    def pal_loss(q_, k_, v_):
+        o, lse = flash_attention_with_lse(q_, k_, v_, causal=causal,
+                                          interpret=True)
+        return jnp.sum(o * jnp.cos(o)) + jnp.sum(jnp.sin(lse))
+
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    g_pal = jax.grad(pal_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_pal, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5,
+            err_msg=f"d{name} mismatch (causal={causal})")
+
+
 def test_mha_dispatch_stays_on_jnp_path_off_tpu():
     """On this (CPU) CI the dispatch must not touch the kernel; the jnp
     path remains the executed one."""
@@ -121,6 +175,27 @@ def test_mosaic_lowering_via_export():
             exported = jax.export.export(
                 jax.jit(train_like), platforms=["tpu"])(*args)
             assert "tpu" in exported.platforms
+
+
+def test_mosaic_lowering_with_lse_via_export():
+    """The ring fold's kernel program — (o, lse) outputs with gradients
+    through BOTH (the dlse-folded backward) — lowers through the real
+    Mosaic TPU pass."""
+    from fmda_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    q, k, v = _qkv(batch=1, heads=2, seq=2 * _BLOCK, d_head=8)
+
+    for causal in (False, True):
+        def train_like(q_, k_, v_, _c=causal):
+            def f(a, b, c):
+                o, lse = flash_attention_with_lse(a, b, c, causal=_c)
+                return jnp.sum(o ** 2) + jnp.sum(lse ** 2)
+
+            return jax.grad(f, argnums=(0, 1, 2))(q_, k_, v_)
+
+        exported = jax.export.export(
+            jax.jit(train_like), platforms=["tpu"])(q, k, v)
+        assert "tpu" in exported.platforms
 
 
 def test_flash_on_tpu_device():
