@@ -90,13 +90,17 @@ class EncoderBlock(nn.Module):
             use_flash=cfg.use_pallas,
         )
         out = nn.Dense(h, dtype=compute_dtype, name="proj")(merge_heads(out))
-        x = x + nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+        # residual dropout is the family's own knob (attn_dropout): the
+        # protocol's 0.5 applies to the input spatial dropout, not the
+        # core (the reference GRU has no internal dropout at 1 layer)
+        rate = cfg.attn_dropout if cfg.attn_dropout is not None else cfg.dropout
+        x = x + nn.Dropout(rate)(out, deterministic=deterministic)
 
         y = nn.LayerNorm(dtype=compute_dtype, name="ln_mlp")(x)
         y = nn.Dense(4 * h, dtype=compute_dtype, name="mlp_in")(y)
         y = nn.gelu(y)
         y = nn.Dense(h, dtype=compute_dtype, name="mlp_out")(y)
-        return x + nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return x + nn.Dropout(rate)(y, deterministic=deterministic)
 
 
 class TemporalTransformer(nn.Module):
