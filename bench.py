@@ -627,6 +627,80 @@ def phase_kernel_sweep() -> dict:
     return out
 
 
+def phase_attn_sweep() -> dict:
+    """Fused flash-attention kernel vs the jnp online-softmax path across
+    sequence lengths, fwd+bwd through jax.grad — the per-shape evidence
+    behind the attn family's use_pallas opt-in AND the ring fold's
+    per-step win (each sp ring step at T=1024, sp=4 runs exactly the
+    T=256 row's shape per device).  Skipped off-TPU (the kernel needs
+    Mosaic)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.ops.attention import flash_available, mha
+    from fmda_tpu.ops.pallas_attention import flash_attention, flash_supported
+
+    if not flash_available():
+        return {"error": "skipped (flash kernel unavailable on backend "
+                         f"'{jax.default_backend()}')"}
+
+    # (B, N, T, D): longctx protocol head shapes (H=32, 4 heads -> D=8)
+    # at the ring-step ladder T=128..1024; plus a D=64 row for the
+    # MXU-wide head the wide probe implies
+    shapes = [
+        (16, 4, 128, 8), (16, 4, 256, 8), (16, 4, 512, 8),
+        (16, 4, 1024, 8), (16, 4, 1024, 64),
+    ]
+    out: dict = {"backend": jax.default_backend(),
+                 "device_kind": jax.devices()[0].device_kind, "shapes": {},
+                 "note": "T=256 row = one ring step per device at the "
+                         "sp=4 longctx config; grad-of-sum-of-squares, "
+                         "slope-timed"}
+
+    def timed(fn, args):
+        g = fn(*args)
+        float(g[0][(0,) * g[0].ndim])  # compile + warm; host fetch barrier
+
+        def window_fn(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                g = fn(*args)
+            float(g[0][(0,) * g[0].ndim])
+            return time.perf_counter() - t0
+
+        return _slope_time(window_fn, target_s=1.5)
+
+    for b, n, t, d in shapes:
+        r = np.random.default_rng(0)
+        q, k, v = (
+            jnp.asarray(r.normal(size=(b, n, t, d)).astype(np.float32))
+            for _ in range(3))
+
+        def make(attn_fn):
+            def loss(q_, k_, v_):
+                return jnp.sum(attn_fn(q_, k_, v_) ** 2)
+
+            return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+        key = f"B{b}_N{n}_T{t}_D{d}"
+        entry: dict = {"flash_supported": flash_supported(t, t, d)}
+        try:
+            t_jnp = timed(make(lambda a, b_, c: mha(a, b_, c)), (q, k, v))
+            entry["jnp_ms"] = round(t_jnp * 1e3, 3)
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            entry["jnp_error"] = str(e)[:300]
+        try:
+            t_pal = timed(
+                make(lambda a, b_, c: flash_attention(a, b_, c)), (q, k, v))
+            entry["flash_ms"] = round(t_pal * 1e3, 3)
+            if "jnp_ms" in entry:
+                entry["speedup"] = round(t_jnp / t_pal, 3)
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            entry["flash_error"] = str(e)[:300]
+        out["shapes"][key] = entry
+    return out
+
+
 def phase_serving() -> dict:
     """Tick latency of the carried-state streaming cores on the flagship
     bidirectional model (north-star config 5: jit state-carry p50 tick
@@ -1007,6 +1081,7 @@ _PHASES = {
     "flagship_wide": phase_flagship_wide,
     "train_e2e": phase_train_e2e,
     "kernel_sweep": phase_kernel_sweep,
+    "attn_sweep": phase_attn_sweep,
     "longctx": phase_longctx,
     "longctx_attn": phase_longctx_attn,
     "multiticker": phase_multiticker,
@@ -1145,6 +1220,7 @@ _TIER_PLANS = {
         ("flagship_pallas", 420.0, "flagship_pallas_rerun"),
         ("flagship_scan", 420.0, "flagship_scan_rerun"),
         ("kernel_sweep", 900.0, "kernel_sweep"),
+        ("attn_sweep", 900.0, "attn_sweep"),
         ("flagship_bf16", 420.0, "flagship_bf16"),
         ("flagship_wide", 600.0, "flagship_wide"),
         ("longctx", 900.0, "longctx"),
@@ -1392,6 +1468,7 @@ def main() -> None:
         ("flagship_wide", 300.0),
         ("train_e2e", 600.0),
         ("kernel_sweep", 600.0),
+        ("attn_sweep", 600.0),
     ]
     # phases that ignore the probed backend: torch is the CPU baseline by
     # definition; longctx_sp runs on the 8-device virtual CPU mesh (the
@@ -1403,7 +1480,7 @@ def main() -> None:
     phases: dict = {}
     on_cpu = probe_failed or probe.get("backend") == "cpu"
     for name, budget in plan:
-        if name in ("flagship_wide", "kernel_sweep") and on_cpu:
+        if name in ("flagship_wide", "kernel_sweep", "attn_sweep") and on_cpu:
             # accelerator-only probes (the phases self-skip too, but the
             # inline guard saves the subprocess spawn + jax import)
             phases[name] = {"error": "skipped (no accelerator backend)"}
