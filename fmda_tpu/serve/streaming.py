@@ -29,6 +29,13 @@ directions at the window edges (the training-time semantics,
 sql_pytorch_dataloader windows).  Longer forward memory, O(1)/O(window)
 ticks — choose per deployment; both are exposed, and both are verified
 against explicit reference computations in tests.
+
+Both recurrent families stream through the same cores: ``cell="lstm"``
+carries ``(h, c)`` instead of ``(h,)`` and re-scans the backward
+direction with the LSTM recurrence — dispatch via
+:func:`_recurrent_cell_ops`.  The attn family deliberately has no
+carried-state core: its sliding-window positions re-index every tick, so
+the window re-encode IS the :class:`~fmda_tpu.serve.predictor.Predictor`.
 """
 
 from __future__ import annotations
@@ -43,15 +50,55 @@ import numpy as np
 from fmda_tpu.config import ModelConfig, TARGET_COLUMNS
 from fmda_tpu.data.normalize import NormParams
 from fmda_tpu.ops.gru import GRUWeights, gru_gates, gru_scan
+from fmda_tpu.ops.lstm import LSTMWeights, lstm_gates, lstm_scan
 
 log = logging.getLogger("fmda_tpu.serve")
 
 
-def _layer0_weights(params, reverse: bool) -> GRUWeights:
+def _layer0_weights(params, reverse: bool, cell: str = "gru"):
     suffix = "l0_reverse" if reverse else "l0"
-    return GRUWeights(
+    cls = GRUWeights if cell == "gru" else LSTMWeights
+    return cls(
         params[f"weight_ih_{suffix}"], params[f"weight_hh_{suffix}"],
         params[f"bias_ih_{suffix}"], params[f"bias_hh_{suffix}"],
+    )
+
+
+def _recurrent_cell_ops(cell: str):
+    """(gate_step, bwd_scan, n_carry, n_gates) for a recurrent family.
+
+    ``gate_step(xp, carry, w) -> (h_new, carry_new)`` advances one tick
+    (carry is a tuple: ``(h,)`` for GRU, ``(h, c)`` for LSTM — both
+    families' torch-convention ``BiGRUState``/``BiLSTMState`` analogues);
+    ``bwd_scan(xp_nf, zeros, w) -> hs`` is the backward-direction window
+    re-scan from a zero state.  The attn family has no carried state —
+    its window re-encode IS the :class:`~fmda_tpu.serve.predictor
+    .Predictor` (sliding positions re-index every tick), so it
+    deliberately stays out of this dispatch.
+    """
+    if cell == "gru":
+        def gate_step(xp, carry, w):
+            h_new = gru_gates(xp, carry[0], w.w_hh, w.b_hh)
+            return h_new, (h_new,)
+
+        def bwd_scan(xp_nf, zeros, w):
+            return gru_scan(xp_nf, zeros, w.w_hh, w.b_hh)[1]
+
+        return gate_step, bwd_scan, 1, 3
+    if cell == "lstm":
+        def gate_step(xp, carry, w):
+            h_new, c_new = lstm_gates(xp, carry[0], carry[1], w.w_hh, w.b_hh)
+            return h_new, (h_new, c_new)
+
+        def bwd_scan(xp_nf, zeros, w):
+            return lstm_scan(xp_nf, zeros, jnp.zeros_like(zeros),
+                             w.w_hh, w.b_hh)[1]
+
+        return gate_step, bwd_scan, 2, 4
+    raise ValueError(
+        "the carried-state streaming cores cover the recurrent families "
+        "(cell='gru'/'lstm'); use the window-re-scan Predictor for "
+        f"ModelConfig.cell={cell!r}"
     )
 
 
@@ -72,12 +119,7 @@ class StreamingBiGRU:
         window: int,
         batch: int = 1,
     ) -> None:
-        if cfg.cell != "gru":
-            raise ValueError(
-                "the carried-state streaming cores are GRU-specific; use "
-                "the window-re-scan Predictor for ModelConfig.cell="
-                f"{cfg.cell!r}"
-            )
+        gate_step, _, self._n_carry, _ = _recurrent_cell_ops(cfg.cell)
         if cfg.bidirectional:
             raise ValueError(
                 "carried-state streaming needs bidirectional=False; the "
@@ -98,12 +140,12 @@ class StreamingBiGRU:
         x_min = jnp.asarray(norm.x_min)
         x_range = jnp.asarray(norm.x_max - norm.x_min)
 
-        def step(params, h, ring, ring_pos, row):
-            """One tick: row (B, F) -> (logits, new_h, new_ring, new_pos)."""
-            w = _layer0_weights(params, reverse=False)
+        def step(params, carry, ring, ring_pos, row):
+            """One tick: row (B, F) -> (logits, new_carry, new_ring, pos)."""
+            w = _layer0_weights(params, reverse=False, cell=cfg.cell)
             x = ((row - x_min) / x_range).astype(dtype)
             xp = x @ w.w_ih.T + w.b_ih
-            h_new = gru_gates(xp, h, w.w_hh, w.b_hh)
+            h_new, carry_new = gate_step(xp, carry, w)
             ring = jax.lax.dynamic_update_index_in_dim(
                 ring, h_new, ring_pos % self.window, axis=1
             )
@@ -119,14 +161,17 @@ class StreamingBiGRU:
             logits = (
                 concat @ params["linear"]["kernel"] + params["linear"]["bias"]
             )
-            return logits, h_new, ring, ring_pos + 1
+            return logits, carry_new, ring, ring_pos + 1
 
         self._step = jax.jit(step)
         self.reset()
 
     def reset(self) -> None:
         hidden = self.cfg.hidden_size
-        self._h = jnp.zeros((self.batch, hidden), self._dtype)
+        # carry tuple: (h,) for GRU, (h, c) for LSTM
+        self._h = tuple(
+            jnp.zeros((self.batch, hidden), self._dtype)
+            for _ in range(self._n_carry))
         self._ring = jnp.zeros((self.batch, self.window, hidden), self._dtype)
         self._pos = jnp.asarray(0, jnp.int32)
 
@@ -171,12 +216,8 @@ class StreamingBiGRUBidirectional:
         window: int,
         batch: int = 1,
     ) -> None:
-        if cfg.cell != "gru":
-            raise ValueError(
-                "the carried-state streaming cores are GRU-specific; use "
-                "the window-re-scan Predictor for ModelConfig.cell="
-                f"{cfg.cell!r}"
-            )
+        gate_step, bwd_scan, self._n_carry, self._n_gates = \
+            _recurrent_cell_ops(cfg.cell)
         if not cfg.bidirectional:
             raise ValueError(
                 "use StreamingBiGRU for unidirectional models (pure O(1))")
@@ -195,15 +236,15 @@ class StreamingBiGRUBidirectional:
         x_range = jnp.asarray(norm.x_max - norm.x_min)
         w = window
 
-        def step(params, h_fwd, hs_ring, xpb_ring, pos, row):
+        def step(params, carry, hs_ring, xpb_ring, pos, row):
             p = params
-            wf = _layer0_weights(p, reverse=False)
-            wb = _layer0_weights(p, reverse=True)
+            wf = _layer0_weights(p, reverse=False, cell=cfg.cell)
+            wb = _layer0_weights(p, reverse=True, cell=cfg.cell)
             x = ((row - x_min) / x_range).astype(dtype)
 
             # forward: one carried-gate step
             xpf = x @ wf.w_ih.T + wf.b_ih
-            h_new = gru_gates(xpf, h_fwd, wf.w_hh, wf.b_hh)
+            h_new, carry_new = gate_step(xpf, carry, wf)
             # project the row for the backward direction once, on arrival
             xpb = x @ wb.w_ih.T + wb.b_ih
 
@@ -219,10 +260,10 @@ class StreamingBiGRUBidirectional:
             xpb_nf = jnp.take(xpb_ring, idx, axis=1)
             hs_fwd_nf = jnp.take(hs_ring, idx, axis=1)
 
-            # backward direction: scan newest -> oldest with h0 = 0 (ticks
-            # past n_valid run on stale slots; their outputs are masked out)
-            h0 = jnp.zeros_like(h_new)
-            h_bwd_seq = gru_scan(xpb_nf, h0, wb.w_hh, wb.b_hh)[1]
+            # backward direction: scan newest -> oldest with zero state at
+            # the newest row (ticks past n_valid run on stale slots; their
+            # outputs are masked out)
+            h_bwd_seq = bwd_scan(xpb_nf, jnp.zeros_like(h_new), wb)
             h_bwd_last = jax.lax.dynamic_index_in_dim(
                 h_bwd_seq, n_valid - 1, axis=1, keepdims=False)
 
@@ -234,18 +275,21 @@ class StreamingBiGRUBidirectional:
             last_hidden = h_new + h_bwd_last
             concat = jnp.concatenate([last_hidden, max_pool, avg_pool], axis=-1)
             logits = concat @ p["linear"]["kernel"] + p["linear"]["bias"]
-            return logits, h_new, hs_ring, xpb_ring, pos + 1
+            return logits, carry_new, hs_ring, xpb_ring, pos + 1
 
         self._step = jax.jit(step)
         self.reset()
 
     def reset(self) -> None:
         hidden = self.cfg.hidden_size
-        self._h = jnp.zeros((self.batch, hidden), self._dtype)
+        # carry tuple: (h,) for GRU, (h, c) for LSTM
+        self._h = tuple(
+            jnp.zeros((self.batch, hidden), self._dtype)
+            for _ in range(self._n_carry))
         self._hs_ring = jnp.zeros(
             (self.batch, self.window, hidden), self._dtype)
         self._xpb_ring = jnp.zeros(
-            (self.batch, self.window, 3 * hidden), self._dtype)
+            (self.batch, self.window, self._n_gates * hidden), self._dtype)
         self._pos = jnp.asarray(0, jnp.int32)
 
     @property

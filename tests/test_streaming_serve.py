@@ -173,6 +173,101 @@ def test_streaming_bidirectional_equals_reference_computation():
     assert core.ticks_seen == 9
 
 
+def _lstm_setup(feats=6, hidden=5, window=4, seed=0, bidirectional=False):
+    cfg = ModelConfig(hidden_size=hidden, n_features=feats, output_size=4,
+                      dropout=0.0, bidirectional=bidirectional,
+                      use_pallas=False, cell="lstm")
+    from fmda_tpu.models import build_model
+    model = build_model(cfg)
+    x = jnp.zeros((1, window, feats))
+    params = model.init({"params": jax.random.PRNGKey(seed)}, x)["params"]
+    norm = NormParams(np.zeros(feats, np.float32), np.ones(feats, np.float32))
+    return cfg, params, norm
+
+
+def test_streaming_lstm_equals_full_history_scan():
+    """cell='lstm' through the same carried-state core: streaming ==
+    full-history LSTM scan + trailing-window pooled head (the (h, c)
+    carry analogue of the GRU test above)."""
+    from fmda_tpu.ops.lstm import LSTMWeights, lstm_input_projection, lstm_scan
+
+    cfg, params, norm = _lstm_setup()
+    window = 4
+    core = StreamingBiGRU(cfg, params, norm, window=window)
+    rows = np.random.default_rng(5).normal(
+        size=(10, cfg.n_features)).astype(np.float32)
+
+    w = LSTMWeights(params["weight_ih_l0"], params["weight_hh_l0"],
+                    params["bias_ih_l0"], params["bias_hh_l0"])
+    xp = lstm_input_projection(jnp.asarray(rows)[None], w)
+    zeros = jnp.zeros((1, cfg.hidden_size))
+    _, hs = lstm_scan(xp, zeros, zeros, w.w_hh, w.b_hh)
+    hs = np.asarray(hs[0])
+
+    for t in range(10):
+        probs = core.step(rows[t])[0]
+        lo = max(0, t - window + 1)
+        trailing = hs[lo : t + 1]
+        concat = np.concatenate(
+            [hs[t], trailing.max(axis=0), trailing.mean(axis=0)])
+        logits = concat @ np.asarray(params["linear"]["kernel"]) + np.asarray(
+            params["linear"]["bias"])
+        expected = 1 / (1 + np.exp(-logits))
+        np.testing.assert_allclose(probs, expected, atol=1e-5)
+    assert core.ticks_seen == 10
+
+
+def test_streaming_lstm_bidirectional_equals_reference_computation():
+    """Bidirectional cell='lstm' streaming: carried (h, c) forward +
+    training-exact backward re-scan, against an explicit lstm-ops oracle."""
+    from fmda_tpu.ops.lstm import LSTMWeights, lstm_input_projection, lstm_scan
+    from fmda_tpu.serve.streaming import StreamingBiGRUBidirectional
+
+    cfg, params, norm = _lstm_setup(bidirectional=True)
+    window = 4
+    core = StreamingBiGRUBidirectional(cfg, params, norm, window=window)
+    rows = np.random.default_rng(7).normal(
+        size=(9, cfg.n_features)).astype(np.float32)
+
+    wf = LSTMWeights(params["weight_ih_l0"], params["weight_hh_l0"],
+                     params["bias_ih_l0"], params["bias_hh_l0"])
+    wb = LSTMWeights(
+        params["weight_ih_l0_reverse"], params["weight_hh_l0_reverse"],
+        params["bias_ih_l0_reverse"], params["bias_hh_l0_reverse"])
+    zeros = jnp.zeros((1, cfg.hidden_size))
+    xpf = lstm_input_projection(jnp.asarray(rows)[None], wf)
+    _, hs_fwd = lstm_scan(xpf, zeros, zeros, wf.w_hh, wf.b_hh)
+    hs_fwd = np.asarray(hs_fwd[0])
+
+    for t in range(9):
+        probs = core.step(rows[t])[0]
+        lo = max(0, t - window + 1)
+        win = jnp.asarray(rows[lo : t + 1])[None]
+        xpb = lstm_input_projection(win, wb)
+        (h_bwd_last, _), hs_bwd = lstm_scan(
+            xpb, zeros, zeros, wb.w_hh, wb.b_hh, reverse=True)
+        hs_bwd = np.asarray(hs_bwd[0])
+        summed = hs_fwd[lo : t + 1] + hs_bwd
+        concat = np.concatenate([
+            hs_fwd[t] + np.asarray(h_bwd_last[0]),
+            summed.max(axis=0), summed.mean(axis=0)])
+        logits = concat @ np.asarray(params["linear"]["kernel"]) + np.asarray(
+            params["linear"]["bias"])
+        expected = 1 / (1 + np.exp(-logits))
+        np.testing.assert_allclose(probs, expected, atol=1e-5)
+    assert core.ticks_seen == 9
+
+
+def test_streaming_rejects_attn():
+    """The attn family has no carried state — the clear error points to
+    the window-re-scan Predictor."""
+    cfg = ModelConfig(hidden_size=4, n_features=3, output_size=4,
+                      cell="attn", bidirectional=False)
+    with pytest.raises(ValueError, match="Predictor"):
+        StreamingBiGRU(cfg, {}, NormParams(np.zeros(3, np.float32),
+                                           np.ones(3, np.float32)), window=2)
+
+
 def test_streaming_bidirectional_predictor_end_to_end():
     """The bus-facing StreamingPredictor serves the flagship bidirectional
     model through the O(window) carried core."""
