@@ -339,11 +339,13 @@ def phase_longctx() -> dict:
     )
 
 
-def phase_longctx_attn() -> dict:
+def phase_longctx_attn(dtype: str = "float32") -> dict:
     """Long-context via the attention family (cell="attn"): same
     seq-1024 windows as phase_longctx but through the temporal
     transformer — all batched matmuls, no serial scan; the single-device
-    twin of the ring-attention sp path."""
+    twin of the ring-attention sp path.  The bf16 variant is the MXU
+    dtype the flash kernel is built for (bf16 operands, f32
+    accumulators in VMEM)."""
     from fmda_tpu.config import FeatureConfig
 
     features = len(FeatureConfig(bid_levels=10, ask_levels=10).x_fields())
@@ -351,7 +353,7 @@ def phase_longctx_attn() -> dict:
     # (T=1024 is in-envelope; jnp online softmax elsewhere)
     return _bench_train_step(
         batch=16, window=1024, features=features,
-        use_pallas=True, remat=True, warmup=2, cell="attn",
+        use_pallas=True, remat=True, warmup=2, cell="attn", dtype=dtype,
     )
 
 
@@ -1084,6 +1086,7 @@ _PHASES = {
     "attn_sweep": phase_attn_sweep,
     "longctx": phase_longctx,
     "longctx_attn": phase_longctx_attn,
+    "longctx_attn_bf16": lambda: phase_longctx_attn(dtype="bfloat16"),
     "multiticker": phase_multiticker,
     "serving": phase_serving,
     "torch": phase_torch,
@@ -1225,6 +1228,7 @@ _TIER_PLANS = {
         ("flagship_wide", 600.0, "flagship_wide"),
         ("longctx", 900.0, "longctx"),
         ("longctx_attn", 900.0, "longctx_attn"),
+        ("longctx_attn_bf16", 900.0, "longctx_attn_bf16"),
         ("multiticker", 600.0, "multiticker"),
         ("serving", 600.0, "serving"),
         ("train_e2e", 900.0, "train_e2e"),
