@@ -196,26 +196,45 @@ def _parse_volume(value: dict) -> _Event:
     return _Event(to_epoch(ts_str), ts_str, payload)
 
 
+#: COT flattening keys, built once at import (same f-string-hoisting as
+#: :func:`_deep_key_table`; the combined name is both the nested lookup
+#: key and the payload key, spark_consumer.py:200-225)
+_COT_KEY_TABLE = tuple(
+    (group, tuple(f"{group}_{v}" for v in COT_VALUES))
+    for group in COT_GROUPS
+)
+
+
 def _parse_cot(value: dict) -> _Event:
     """Flatten nested COT groups (spark_consumer.py:200-225)."""
     ts_str = value["Timestamp"]
     payload: Dict[str, float] = {}
-    for group in COT_GROUPS:
-        nested = value.get(group) or {}
-        for v in COT_VALUES:
-            key = f"{group}_{v}"
-            payload[key] = float(nested.get(key) or 0.0)
+    vget = value.get
+    for group, keys in _COT_KEY_TABLE:
+        nget = (vget(group) or {}).get
+        for key in keys:
+            payload[key] = float(nget(key) or 0.0)
     return _Event(to_epoch(ts_str), ts_str, payload)
 
 
-def _parse_ind(value: dict, events: Tuple[str, ...]) -> _Event:
+def _ind_key_table(events: Tuple[str, ...]):
+    """(event, ((payload_key, nested_key), ...)) — built once per engine
+    (39 f-strings per message otherwise, spark_consumer.py:239-259)."""
+    return tuple(
+        (event, tuple((f"{event}_{v}", v) for v in EVENT_VALUES))
+        for event in events
+    )
+
+
+def _parse_ind(value: dict, key_table) -> _Event:
     """Flatten the indicator template message (spark_consumer.py:239-259)."""
     ts_str = value["Timestamp"]
     payload: Dict[str, float] = {}
-    for event in events:
-        nested = value.get(event) or {}
-        for ev_val in EVENT_VALUES:
-            payload[f"{event}_{ev_val}"] = float(nested.get(ev_val) or 0.0)
+    vget = value.get
+    for event, pairs in key_table:
+        nget = (vget(event) or {}).get
+        for out_key, ev_val in pairs:
+            payload[out_key] = float(nget(ev_val) or 0.0)
     return _Event(to_epoch(ts_str), ts_str, payload)
 
 
@@ -304,7 +323,8 @@ class StreamEngine:
             TOPIC_VOLUME: _parse_volume,
             TOPIC_COT: _parse_cot,
             TOPIC_IND: (
-                lambda v, _repl=features.event_list_repl: _parse_ind(v, _repl)
+                lambda v, _kt=_ind_key_table(features.event_list_repl):
+                _parse_ind(v, _kt)
             ),
         }
         #: timestamps of landed ticks — the "exactly one output row per
