@@ -55,13 +55,17 @@ from fmda_tpu.ops.lstm import LSTMWeights, lstm_gates, lstm_scan
 log = logging.getLogger("fmda_tpu.serve")
 
 
-def _layer0_weights(params, reverse: bool, cell: str = "gru"):
-    suffix = "l0_reverse" if reverse else "l0"
+def _layer_weights(params, reverse: bool, cell: str = "gru", layer: int = 0):
+    suffix = f"l{layer}" + ("_reverse" if reverse else "")
     cls = GRUWeights if cell == "gru" else LSTMWeights
     return cls(
         params[f"weight_ih_{suffix}"], params[f"weight_hh_{suffix}"],
         params[f"bias_ih_{suffix}"], params[f"bias_hh_{suffix}"],
     )
+
+
+def _layer0_weights(params, reverse: bool, cell: str = "gru"):
+    return _layer_weights(params, reverse, cell, layer=0)
 
 
 def _recurrent_cell_ops(cell: str):
@@ -126,8 +130,6 @@ class StreamingBiGRU:
                 "backward direction would require the future. Use the "
                 "window-re-scan Predictor for bidirectional models."
             )
-        if cfg.n_layers != 1:
-            raise ValueError("streaming core currently covers 1-layer models")
         self.cfg = cfg
         self.window = window
         self.batch = batch
@@ -141,11 +143,25 @@ class StreamingBiGRU:
         x_range = jnp.asarray(norm.x_max - norm.x_min)
 
         def step(params, carry, ring, ring_pos, row):
-            """One tick: row (B, F) -> (logits, new_carry, new_ring, pos)."""
-            w = _layer0_weights(params, reverse=False, cell=cfg.cell)
+            """One tick: row (B, F) -> (logits, new_carry, new_ring, pos).
+
+            ``carry`` is a per-layer tuple of cell-carry tuples — stacked
+            layers stay O(1)/tick because layer l's input at tick t is
+            just layer l-1's hidden output at tick t (unidirectional
+            stacking has no window dependence; the ring pools the LAST
+            layer's outputs, models/bigru.py:148-150)."""
             x = ((row - x_min) / x_range).astype(dtype)
-            xp = x @ w.w_ih.T + w.b_ih
-            h_new, carry_new = gate_step(xp, carry, w)
+            layer_in = x
+            carry_new = []
+            h_new = None
+            for layer in range(cfg.n_layers):
+                w = _layer_weights(params, reverse=False, cell=cfg.cell,
+                                   layer=layer)
+                xp = layer_in @ w.w_ih.T + w.b_ih
+                h_new, c_new = gate_step(xp, carry[layer], w)
+                carry_new.append(c_new)
+                layer_in = h_new
+            carry_new = tuple(carry_new)
             ring = jax.lax.dynamic_update_index_in_dim(
                 ring, h_new, ring_pos % self.window, axis=1
             )
@@ -168,10 +184,11 @@ class StreamingBiGRU:
 
     def reset(self) -> None:
         hidden = self.cfg.hidden_size
-        # carry tuple: (h,) for GRU, (h, c) for LSTM
+        # per-layer tuple of cell-carry tuples ((h,) GRU / (h, c) LSTM)
         self._h = tuple(
-            jnp.zeros((self.batch, hidden), self._dtype)
-            for _ in range(self._n_carry))
+            tuple(jnp.zeros((self.batch, hidden), self._dtype)
+                  for _ in range(self._n_carry))
+            for _ in range(self.cfg.n_layers))
         self._ring = jnp.zeros((self.batch, self.window, hidden), self._dtype)
         self._pos = jnp.asarray(0, jnp.int32)
 
@@ -222,7 +239,14 @@ class StreamingBiGRUBidirectional:
             raise ValueError(
                 "use StreamingBiGRU for unidirectional models (pure O(1))")
         if cfg.n_layers != 1:
-            raise ValueError("streaming core currently covers 1-layer models")
+            # stacked bidirectional streaming degenerates to a full window
+            # re-encode (layer 1 needs layer 0's backward outputs over the
+            # whole window, which change every tick) — that IS the
+            # Predictor, so serve multi-layer bidirectional models there
+            raise ValueError(
+                "bidirectional carried-state streaming covers 1-layer "
+                "models; use the window-re-scan Predictor for stacked "
+                "bidirectional models")
         self.cfg = cfg
         self.window = window
         self.batch = batch

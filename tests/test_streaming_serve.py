@@ -258,6 +258,69 @@ def test_streaming_lstm_bidirectional_equals_reference_computation():
     assert core.ticks_seen == 9
 
 
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_streaming_multilayer_equals_full_history_scan(cell):
+    """Stacked unidirectional streaming stays O(1)/tick: per-layer
+    carries, layer l fed layer l-1's tick output — equal to the 2-layer
+    full-history scan + trailing pooled head."""
+    from fmda_tpu.models import build_model
+
+    feats, hidden, window = 6, 5, 4
+    cfg = ModelConfig(hidden_size=hidden, n_features=feats, output_size=4,
+                      dropout=0.0, bidirectional=False, use_pallas=False,
+                      cell=cell, n_layers=2)
+    model = build_model(cfg)
+    params = model.init({"params": jax.random.PRNGKey(2)},
+                        jnp.zeros((1, window, feats)))["params"]
+    norm = NormParams(np.zeros(feats, np.float32), np.ones(feats, np.float32))
+    core = StreamingBiGRU(cfg, params, norm, window=window)
+    rows = np.random.default_rng(9).normal(
+        size=(10, feats)).astype(np.float32)
+
+    # full-history oracle: layer 0 over the rows, layer 1 over layer 0's
+    # outputs (torch stacking), trailing-window pooled head on layer 1
+    def full_scan(layer, x):
+        if cell == "gru":
+            w = GRUWeights(*(params[f"{n}_l{layer}"] for n in
+                             ("weight_ih", "weight_hh", "bias_ih",
+                              "bias_hh")))
+            _, hs = gru_layer(x, w)
+            return hs
+        from fmda_tpu.ops.lstm import (
+            LSTMWeights, lstm_input_projection, lstm_scan)
+
+        w = LSTMWeights(*(params[f"{n}_l{layer}"] for n in
+                          ("weight_ih", "weight_hh", "bias_ih", "bias_hh")))
+        zeros = jnp.zeros((1, hidden))
+        return lstm_scan(lstm_input_projection(x, w), zeros, zeros,
+                         w.w_hh, w.b_hh)[1]
+
+    hs0 = full_scan(0, jnp.asarray(rows)[None])
+    hs1 = np.asarray(full_scan(1, hs0)[0])
+
+    for t in range(10):
+        probs = core.step(rows[t])[0]
+        lo = max(0, t - window + 1)
+        trailing = hs1[lo : t + 1]
+        concat = np.concatenate(
+            [hs1[t], trailing.max(axis=0), trailing.mean(axis=0)])
+        logits = concat @ np.asarray(params["linear"]["kernel"]) + np.asarray(
+            params["linear"]["bias"])
+        expected = 1 / (1 + np.exp(-logits))
+        np.testing.assert_allclose(probs, expected, atol=1e-5)
+
+
+def test_streaming_bidirectional_rejects_multilayer():
+    cfg = ModelConfig(hidden_size=4, n_features=3, output_size=4,
+                      bidirectional=True, n_layers=2)
+    from fmda_tpu.serve.streaming import StreamingBiGRUBidirectional
+
+    with pytest.raises(ValueError, match="Predictor"):
+        StreamingBiGRUBidirectional(
+            cfg, {}, NormParams(np.zeros(3, np.float32),
+                                np.ones(3, np.float32)), window=2)
+
+
 def test_streaming_rejects_attn():
     """The attn family has no carried state — the clear error points to
     the window-re-scan Predictor."""
