@@ -162,13 +162,11 @@ def _parse_deep_batch(raws) -> List[_Event]:
         np.asarray([r[4] for r in raws]),
         [parse_ts(t) for t in ts_strs],
     )
+    # .tolist() already yields python floats — no per-value float() needed
     cols = {k: v.tolist() for k, v in feats.items()}
+    items = list(cols.items())
     return [
-        _Event(
-            to_epoch(ts),
-            ts,
-            {k: float(v[i]) for k, v in cols.items()},
-        )
+        _Event(to_epoch(ts), ts, {k: v[i] for k, v in items})
         for i, ts in enumerate(ts_strs)
     ]
 
