@@ -36,6 +36,7 @@ def make_sp_train_step(
     dp_axis: str = "dp",
     sp_axis: str = "sp",
     n_microbatches: int = 1,
+    flash_interpret: bool = False,
 ):
     """Returns ``step(params, opt_state, x, y) -> (params, opt_state, loss)``
     jitted over the mesh.  ``n_microbatches > 1`` runs the bubble-filling
@@ -61,8 +62,12 @@ def make_sp_train_step(
             raise ValueError(
                 "n_microbatches applies only to the recurrent cells: the "
                 "ring-attention program has no pipeline bubble to fill")
+        # flash_interpret runs the ring's fused-kernel fold in interpret
+        # mode — CPU-mesh tests exercise the REAL pod program (remat +
+        # shard_map + kernel custom-vjp) without hardware
         forward = make_attn_sp_forward(
-            mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis)
+            mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
+            flash_interpret=flash_interpret)
     else:
         forward = make_sp_forward(
             mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
