@@ -1261,6 +1261,23 @@ def _run_gated_test(node_id: str, env: dict, timeout_s: float = 600.0) -> dict:
                 "wall_s": round(time.monotonic() - t0, 1)}
 
 
+#: Advisory lock taken by the watcher during a TPU capture.  Two bench
+#: processes sharing the one tunneled chip hang each other's phases, so
+#: a concurrently-started `python bench.py` (e.g. the driver's
+#: end-of-round run racing a just-revived tunnel) must fall back to CPU
+#: instead of contending.
+_CAPTURE_LOCK = os.path.join(_REPO_DIR, "artifacts", "tpu_capture.lock")
+_CAPTURE_LOCK_STALE_S = 2 * 3600.0
+
+
+def _capture_lock_active() -> bool:
+    try:
+        age = time.time() - os.path.getmtime(_CAPTURE_LOCK)
+    except OSError:
+        return False
+    return age < _CAPTURE_LOCK_STALE_S
+
+
 def _capture_tpu_evidence(probe: dict) -> int:
     """The moment a probe succeeds: smoke tier first (flagship pair +
     flash parity — the minimum decisive artifact), flushed to disk after
@@ -1279,7 +1296,22 @@ def _capture_tpu_evidence(probe: dict) -> int:
         loadavg = None
     results: dict = {"probe": probe, "loadavg_at_start": loadavg,
                      "tiers_completed": [], "gated_tests": {}, "phases": {}}
+    try:
+        os.makedirs(os.path.dirname(_CAPTURE_LOCK), exist_ok=True)
+        with open(_CAPTURE_LOCK, "w") as f:
+            f.write(f"pid={os.getpid()} out={os.path.basename(out_path)}\n")
+    except OSError:
+        pass
+    try:
+        return _capture_tpu_evidence_locked(results, out_path)
+    finally:
+        try:
+            os.remove(_CAPTURE_LOCK)
+        except OSError:
+            pass
 
+
+def _capture_tpu_evidence_locked(results: dict, out_path: str) -> int:
     def _flush():
         with open(out_path, "w") as f:
             json.dump(results, f, indent=1)
@@ -1439,8 +1471,20 @@ def _annotate_vs_prev(phases: dict, prev_name: str, prev: dict) -> None:
 
 def main() -> None:
     deadline = time.monotonic() + GLOBAL_BUDGET_S
-    probe = _probe_backend()
-    _log_probe(probe, "bench main")
+    capture_busy = _capture_lock_active()
+    if capture_busy:
+        # the watcher is mid-capture on the one tunneled chip; two bench
+        # processes sharing it hang each other's phases — run CPU-forced
+        # and say so rather than contend (the capture's own artifact
+        # carries the TPU numbers)
+        print("TPU capture in progress (artifacts/tpu_capture.lock); "
+              "running CPU-forced to avoid sharing the chip",
+              file=sys.stderr)
+        probe = {"error": "tpu busy: watcher capture in progress"}
+        _log_probe(probe, "bench main (capture lock)")
+    else:
+        probe = _probe_backend()
+        _log_probe(probe, "bench main")
     probe_failed = "error" in probe
     if probe_failed:
         print(f"backend probe failed: {probe['error']}; forcing CPU",

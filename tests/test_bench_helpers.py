@@ -61,8 +61,9 @@ class TestPhaseRegistry:
     def test_expected_phases_registered(self):
         expected = {
             "flagship_pallas", "flagship_scan", "flagship_bf16",
-            "flagship_wide", "train_e2e", "kernel_sweep", "longctx",
-            "longctx_attn", "longctx_sp", "multiticker", "serving", "torch",
+            "flagship_wide", "train_e2e", "kernel_sweep", "attn_sweep",
+            "longctx", "longctx_attn", "longctx_attn_bf16", "longctx_sp",
+            "multiticker", "serving", "torch",
             "tpu_export",
             "replay",
         }
