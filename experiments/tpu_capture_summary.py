@@ -1,0 +1,130 @@
+"""Summarise a tiered TPU capture (BENCH_TPU_r05*.json) into markdown.
+
+The watcher (bench.py --wait-for-tpu) writes captures incrementally;
+this renders whatever landed — gated tests, flagship verdict with the
+reproducibility rerun, kernel/attn sweeps, MFU probe, serving split —
+into a table block ready for RESULTS/PARITY, with the
+kernel-vs-scan verdict computed from the slope-timed pairs (the round-4
+contradiction was two RTT-polluted pre-fix captures; see PARITY.md).
+
+Usage: python experiments/tpu_capture_summary.py [capture.json ...]
+       (default: every BENCH_TPU_r05*.json in the repo root)
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}"
+    return str(v)
+
+
+def summarise(path: str) -> str:
+    with open(path) as f:
+        cap = json.load(f)
+    lines = [f"### {os.path.basename(path)}", ""]
+    probe = cap.get("probe", {})
+    lines.append(
+        f"Backend `{probe.get('backend')}` ({probe.get('device_kind')}); "
+        f"tiers completed: {cap.get('tiers_completed', [])}; "
+        f"loadavg at start: {cap.get('loadavg_at_start')}"
+        + (f"; **aborted**: {cap['aborted']}" if "aborted" in cap else ""))
+    lines.append("")
+
+    gated = cap.get("gated_tests", {})
+    if gated:
+        lines.append("| gated hardware test | passed | wall s |")
+        lines.append("|---|---|---|")
+        for k, t in gated.items():
+            lines.append(
+                f"| {k} | {t.get('passed', t.get('error'))} "
+                f"| {_fmt(t.get('wall_s'))} |")
+        lines.append("")
+
+    phases = cap.get("phases", {})
+
+    def seq(alias):
+        p = phases.get(alias, {})
+        return p.get("seq_s") if isinstance(p, dict) else None
+
+    pal, scan = seq("flagship_pallas"), seq("flagship_scan")
+    pal2, scan2 = seq("flagship_pallas_rerun"), seq("flagship_scan_rerun")
+    if pal and scan:
+        verdict = "kernel wins" if pal > scan else "scan wins"
+        repro = ""
+        if pal2 and scan2:
+            agree = (pal > scan) == (pal2 > scan2)
+            repro = (f"; rerun {_fmt(pal2)} vs {_fmt(scan2)} "
+                     f"({'agrees' if agree else 'DISAGREES'})")
+        lines.append(
+            f"**Flagship verdict (slope-timed)**: pallas {_fmt(pal)} vs "
+            f"scan {_fmt(scan)} seq/s — {verdict}{repro}.")
+        lines.append("")
+
+    rows = []
+    for alias, p in phases.items():
+        if not isinstance(p, dict):
+            continue
+        if "error" in p:
+            err = " ".join(p["error"].split())[:80]  # newline-safe cell
+            rows.append((alias, f"ERROR: {err}", "", "", ""))
+            continue
+        if "seq_s" in p:
+            rows.append((
+                alias, _fmt(p.get("seq_s")), _fmt(p.get("step_ms"), 3),
+                str(p.get("scan_path", p.get("pallas_active", ""))),
+                _fmt(p.get("mfu_est"), 4)))
+        elif "p50_ms" in p:
+            rows.append((
+                alias, f"p50 {_fmt(p.get('p50_ms'), 3)} ms",
+                f"p99 {_fmt(p.get('p99_ms'), 3)} ms",
+                f"device {_fmt(p.get('device_tick_ms'), 4)} ms", ""))
+    if rows:
+        lines.append("| phase | seq/s | step ms | path | mfu |")
+        lines.append("|---|---|---|---|---|")
+        for r in rows:
+            lines.append("| " + " | ".join(str(c) for c in r) + " |")
+        lines.append("")
+
+    for sweep_key, label in (("kernel_sweep", "GRU kernel vs lax.scan"),
+                             ("attn_sweep", "flash vs jnp attention")):
+        sw = phases.get(sweep_key, {})
+        shapes = sw.get("shapes") if isinstance(sw, dict) else None
+        if not shapes:
+            continue
+        lines.append(f"**{label}** ({sweep_key}):")
+        lines.append("")
+        lines.append("| shape | baseline ms | kernel ms | speedup | gate |")
+        lines.append("|---|---|---|---|---|")
+        for shape, e in shapes.items():
+            base = e.get("scan_ms", e.get("jnp_ms"))
+            kern = e.get("pallas_ms", e.get("flash_ms"))
+            gate = e.get("kernel_supported", e.get("flash_supported"))
+            lines.append(
+                f"| {shape} | {_fmt(base, 3)} | {_fmt(kern, 3)} "
+                f"| {_fmt(e.get('speedup'), 3)} | {gate} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    paths = sys.argv[1:] or sorted(
+        glob.glob(os.path.join(REPO, "BENCH_TPU_r05*.json")))
+    if not paths:
+        print("no BENCH_TPU_r05*.json captures found", file=sys.stderr)
+        sys.exit(1)
+    print("\n".join(summarise(p) for p in paths))
+
+
+if __name__ == "__main__":
+    main()
