@@ -133,18 +133,30 @@ def test_unidirectional_state_carry_matches_full_scan():
         np.asarray(s2.cell), np.asarray(full_state.cell), atol=1e-5)
 
 
-def test_streaming_cores_reject_lstm_cell():
+def test_streaming_cores_accept_lstm_cell():
+    """Round 5: both recurrent families stream (the exact-numerics
+    parity lives in tests/test_streaming_serve.py); only the stateless
+    attn family is rejected."""
+    import jax
+
     from fmda_tpu.data.normalize import NormParams
+    from fmda_tpu.models import build_model
     from fmda_tpu.serve import StreamingBiGRU, StreamingBiGRUBidirectional
 
     norm = NormParams(np.zeros(5, np.float32), np.ones(5, np.float32))
-    uni = ModelConfig(hidden_size=4, n_features=5, bidirectional=False,
-                      cell="lstm")
-    with pytest.raises(ValueError, match="GRU-specific"):
-        StreamingBiGRU(uni, {}, norm, window=3)
-    bi = ModelConfig(hidden_size=4, n_features=5, cell="lstm")
-    with pytest.raises(ValueError, match="GRU-specific"):
-        StreamingBiGRUBidirectional(bi, {}, norm, window=3)
+    uni = ModelConfig(hidden_size=4, n_features=5, output_size=4,
+                      bidirectional=False, cell="lstm", dropout=0.0)
+    params = build_model(uni).init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 3, 5)))["params"]
+    core = StreamingBiGRU(uni, params, norm, window=3)
+    assert core.step(np.zeros(5, np.float32)).shape == (1, 4)
+
+    bi = ModelConfig(hidden_size=4, n_features=5, output_size=4,
+                     cell="lstm", dropout=0.0)
+    bparams = build_model(bi).init(
+        {"params": jax.random.PRNGKey(1)}, jnp.zeros((1, 3, 5)))["params"]
+    bcore = StreamingBiGRUBidirectional(bi, bparams, norm, window=3)
+    assert bcore.step(np.zeros(5, np.float32)).shape == (1, 4)
 
 
 def test_trainer_runs_lstm_cell():
