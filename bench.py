@@ -771,16 +771,75 @@ def phase_serving() -> dict:
         device_tick_ms = round(device_tick_s * 1e3, 4)
     except RuntimeError:
         device_tick_ms = None  # noisy host: report end-to-end only
+
+    # The OTHER serving mode (round-4 verdict next #5 asks for both): the
+    # window-re-scan Predictor — warehouse row lookup + window fetch +
+    # normalize + jitted bidirectional apply + sigmoid, per signal, on a
+    # real sqlite warehouse.  Training-exact semantics, O(window x F)
+    # per tick vs the carried core's O(window x H).
+    from fmda_tpu.config import (
+        DEFAULT_TOPICS, FeatureConfig, WarehouseConfig)
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+    from fmda_tpu.serve.predictor import Predictor
+    from fmda_tpu.stream import InProcessBus
+
+    fc = FeatureConfig()
+    wh, _ = build_corpus(
+        fc, SyntheticMarketConfig(seed=1, n_days=3),
+        warehouse_config=WarehouseConfig(path=":memory:"))
+    pred_core = Predictor(
+        InProcessBus(DEFAULT_TOPICS), wh, cfg, params,
+        NormParams(np.zeros(len(wh.x_fields), np.float32),
+                   np.ones(len(wh.x_fields), np.float32)),
+        window=WINDOW, max_staleness_s=None)
+    ts_all = [t for t in wh.recent_timestamps(len(wh))]
+    servable = sorted(ts_all)[WINDOW + 1:]
+    for ts in servable[:5]:
+        pred_core.predict_for_timestamp(ts)  # warm compile + sqlite cache
+    pl = np.empty(len(servable))
+    for i, ts in enumerate(servable):
+        t0 = time.perf_counter()
+        pred_core.predict_for_timestamp(ts)
+        pl[i] = time.perf_counter() - t0
+    predictor_p50 = round(float(np.percentile(pl, 50)) * 1e3, 3)
+    predictor_p99 = round(float(np.percentile(pl, 99)) * 1e3, 3)
+
+    # device-isolated predictor forward (slope-timed, RTT cancels): the
+    # jitted normalize+apply+sigmoid on a device-resident window
+    xw = jnp.asarray(
+        np.random.default_rng(1).normal(
+            size=(1, WINDOW, len(wh.x_fields))).astype(np.float32))
+
+    def pred_window_fn(n: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            probs = pred_core._forward(pred_core._params, xw)
+        float(probs[0])
+        return time.perf_counter() - t0
+
+    pred_window_fn(4)
+    try:
+        predictor_device_ms = round(
+            _slope_time(pred_window_fn, target_s=1.0) * 1e3, 4)
+    except RuntimeError:
+        predictor_device_ms = None
+
     return {
         "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
         "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
         "device_tick_ms": device_tick_ms,
+        "predictor_p50_ms": predictor_p50,
+        "predictor_p99_ms": predictor_p99,
+        "predictor_device_ms": predictor_device_ms,
+        "predictor_ticks": len(servable),
         "backend": jax.default_backend(),
         "device_kind": dev.device_kind,
-        "model": "bidirectional carried-state",
+        "model": "bidirectional carried-state + window-re-scan Predictor",
         "timing_note": "p50/p99 = end-to-end step() incl. host round-trip"
                        " (tunnel RTT on the axon TPU); device_tick_ms ="
-                       " slope-timed chained device steps, RTT cancelled",
+                       " slope-timed chained device steps, RTT cancelled;"
+                       " predictor_* = warehouse->window->device per"
+                       " signal (training-exact re-scan mode)",
         "reference_floor_ms": 15000.0,
     }
 
