@@ -950,7 +950,8 @@ def phase_longctx_sp() -> dict:
         x, y, p, o = shard_train_inputs(
             mesh, x_host, y_host, params0, opt_state)
         for _ in range(warmup):
-            _, _, loss = step(p, o, x, y)
+            # the step donates p/o — always carry the returned tree
+            p, o, loss = step(p, o, x, y)
         float(loss)
         t0 = time.perf_counter()
         for _ in range(steps):

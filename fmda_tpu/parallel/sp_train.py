@@ -10,6 +10,7 @@ shards inside :func:`fmda_tpu.parallel.seq_parallel.sp_gru_scan`.
 
 from __future__ import annotations
 
+import functools
 import logging
 from typing import Optional, Tuple
 
@@ -79,7 +80,12 @@ def make_sp_train_step(
         # constraint at seq_len=1024-class windows, SURVEY §5)
         forward = jax.checkpoint(forward)
 
-    @jax.jit
+    # donate params + optimizer state (the single-device Trainer's step
+    # donates too): the updated tree reuses the old buffers instead of
+    # holding both alive across the update — on long-context configs the
+    # Adam moments are the largest replicated tree in HBM.  x/y are NOT
+    # donated (callers step the same batch repeatedly).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x, y):
         def loss_fn(p):
             logits = forward(p, x)
@@ -104,11 +110,22 @@ def shard_train_inputs(
     dp_axis: str = "dp",
     sp_axis: str = "sp",
 ) -> Tuple:
-    """Place (x, y, params, opt_state) with the step's expected shardings."""
+    """Place (x, y, params, opt_state) with the step's expected shardings.
+
+    The returned params/opt_state are fresh copies: the train step
+    DONATES them (their buffers are consumed by the first call), and
+    ``jax.device_put`` may alias its input when the placement already
+    matches — donating an alias would silently delete the caller's
+    original tree (e.g. a params0 reused to init several step variants).
+    """
     x = jax.device_put(
         jnp.asarray(x), sequence_sharding(mesh, dp_axis, sp_axis))
     y = jax.device_put(jnp.asarray(y), batch_sharding(mesh, dp_axis))
     replicated = replicated_sharding(mesh)
-    params = jax.device_put(params, replicated)
-    opt_state = jax.device_put(opt_state, replicated)
-    return x, y, params, opt_state
+
+    def fresh(tree):
+        return jax.device_put(
+            jax.tree.map(lambda a: jnp.array(a, copy=True), tree),
+            replicated)
+
+    return x, y, fresh(params), fresh(opt_state)
