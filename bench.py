@@ -1134,6 +1134,63 @@ def phase_replay() -> dict:
     return out
 
 
+def phase_runtime_fleet() -> dict:
+    """Fleet-serving smoke: the dynamic micro-batching runtime
+    (fmda_tpu.runtime, docs/runtime.md) vs a synthetic 64-session
+    multi-ticker load on the flagship feature width — p50/p99 tick
+    latency + throughput, the serving-trajectory baseline later PRs
+    regress against.  CPU-friendly by design (one small batched GRU step
+    per flush)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.config import ModelConfig
+    from fmda_tpu.models import build_model
+    from fmda_tpu.runtime import (
+        BatcherConfig, FleetGateway, FleetLoadConfig, SessionPool,
+        run_fleet_load)
+
+    sessions, rounds = 64, 50
+    buckets = (16, 64)
+    cfg = ModelConfig(hidden_size=HIDDEN, n_features=FEATURES,
+                      output_size=CLASSES, dropout=0.0,
+                      bidirectional=False, use_pallas=False)
+    model = build_model(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, WINDOW, FEATURES)))["params"]
+    pool = SessionPool(cfg, params, capacity=sessions, window=WINDOW)
+    gateway = FleetGateway(
+        pool,
+        batcher_config=BatcherConfig(bucket_sizes=buckets,
+                                     max_linger_s=0.002))
+    # compile every bucket up front on padding-only flushes (touching
+    # only the trash slot), so the measured latencies are steady-state
+    for b in buckets:
+        pool.step(np.full(b, pool.padding_slot, np.int32),
+                  np.zeros((b, FEATURES), np.float32))
+    assert pool.compile_count == len(buckets)
+    out = run_fleet_load(gateway, FleetLoadConfig(
+        n_sessions=sessions, n_ticks=rounds, duty=0.9, seed=0))
+    lat = out["latency"]
+    return {
+        "sessions": sessions,
+        "rounds": rounds,
+        "ticks_served": out["ticks_served"],
+        "ticks_per_s": out["ticks_per_s"],
+        "tick_p50_ms": lat["total"]["p50_ms"],
+        "tick_p99_ms": lat["total"]["p99_ms"],
+        "device_p50_ms": lat["device"]["p50_ms"],
+        "compile_count": out["compile_count"],
+        "shed": out["counters"].get("shed_oldest", 0),
+        "bucket_sizes": list(buckets),
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "timing_note": "total = submit->published per tick (incl. "
+                       "micro-batch linger); device = batched jit step "
+                       "per flush; buckets precompiled, so steady-state",
+    }
+
+
 _PHASES = {
     "flagship_pallas": lambda: phase_flagship(use_pallas=True),
     "flagship_scan": lambda: phase_flagship(use_pallas=False),
@@ -1153,6 +1210,7 @@ _PHASES = {
     "tpu_export": phase_tpu_export,
     "replay": phase_replay,
     "longctx_sp": phase_longctx_sp,
+    "runtime_fleet_smoke": phase_runtime_fleet,
 }
 
 
@@ -1381,18 +1439,16 @@ def _capture_tpu_evidence_locked(results: dict, out_path: str) -> int:
     # conftest forces CPU by default; keep the ambient TPU for gated tests
     env["FMDA_TESTS_KEEP_PLATFORM"] = "1"
 
-    def _tunnel_dead() -> bool:
+    def _phase_failed(v: dict) -> bool:
+        return "error" in v and ("timeout" in v["error"] or "rc=" in v["error"])
+
+    def _tunnel_dead(consecutive_failures: int) -> bool:
         # two consecutive timeouts/rc-failures *could* be the relay dying
         # — or a reproducible phase bug on a healthy TPU.  Disambiguate
         # with a fresh probe: only a failing probe aborts the capture
         # (otherwise the watcher would loop the whole multi-hour capture
         # on a deterministic phase error forever).
-        vals = list(results["phases"].values())
-        if len(vals) < 2:
-            return False
-        if not all("error" in v and ("timeout" in v["error"]
-                                     or "rc=" in v["error"])
-                   for v in vals[-2:]):
+        if consecutive_failures < 2:
             return False
         reprobe = _probe_backend()
         _log_probe(reprobe, "mid-capture tunnel check")
@@ -1406,6 +1462,10 @@ def _capture_tpu_evidence_locked(results: dict, out_path: str) -> int:
             _flush()
             print(f"gated {key}: {results['gated_tests'][key]}",
                   file=sys.stderr)
+        # consecutive-failure count is per tier: a timeout ending the
+        # smoke tier and one starting the full tier can be hours apart —
+        # pairing them as "two consecutive" was ADVICE r5 low #4
+        consecutive_failures = 0
         for name, budget, alias in _TIER_PLANS[tier]:
             phase_env = env
             if alias == "flagship_pallas":
@@ -1422,7 +1482,11 @@ def _capture_tpu_evidence_locked(results: dict, out_path: str) -> int:
             _flush()
             print(f"phase {alias}: {results['phases'][alias]}",
                   file=sys.stderr)
-            if _tunnel_dead():
+            if _phase_failed(results["phases"][alias]):
+                consecutive_failures += 1
+            else:
+                consecutive_failures = 0
+            if _tunnel_dead(consecutive_failures):
                 results["aborted"] = (f"tunnel died during tier '{tier}' "
                                       f"(2 consecutive phase failures)")
                 _flush()
@@ -1572,6 +1636,7 @@ def main() -> None:
         ("longctx_sp", 600.0),
         ("multiticker", 420.0),
         ("serving", 300.0),
+        ("runtime_fleet_smoke", 240.0),
         ("flagship_bf16", 300.0),
         ("flagship_wide", 300.0),
         ("train_e2e", 600.0),

@@ -116,6 +116,29 @@ class Application:
         self.predictors.append(predictor)
         return predictor
 
+    def attach_fleet(self, model_cfg, params, **gateway_kwargs):
+        """Multi-tenant serving runtime (fmda_tpu.runtime) on this app's
+        bus, sized by ``config.runtime``: slot pool + deadline-aware
+        micro-batcher + admission-controlled gateway.  ``model_cfg`` must
+        be a unidirectional recurrent config (the batched carried-state
+        kernels); kwargs override the gateway's defaults."""
+        from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+
+        rc = self.config.runtime
+        pool = SessionPool(
+            model_cfg, params, capacity=rc.capacity, window=rc.window)
+        gateway_kwargs.setdefault(
+            "batcher_config",
+            BatcherConfig(bucket_sizes=tuple(rc.bucket_sizes),
+                          max_linger_s=rc.max_linger_ms / 1e3))
+        gateway_kwargs.setdefault("queue_bound", rc.queue_bound)
+        # same decision threshold as the solo serving paths (cmd_serve
+        # wires train.prob_threshold into Predictor/StreamingPredictor)
+        gateway_kwargs.setdefault(
+            "threshold", self.config.train.prob_threshold)
+        self.fleet = FleetGateway(pool, self.bus, **gateway_kwargs)
+        return self.fleet
+
     # -- the loop -------------------------------------------------------------
 
     def run_tick(self) -> Dict[str, int]:

@@ -341,6 +341,60 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_serve_fleet(args) -> int:
+    """Multi-tenant serving proof: N concurrent ticker sessions through
+    the dynamic micro-batching runtime (fmda_tpu.runtime; docs/runtime.md)
+    against a synthetic multi-ticker load — one fused jit step per flush
+    serves every active session.  Prints the runtime metrics (per-stage
+    latency histograms, shed/queue counters, compiled-bucket count) as
+    one JSON object."""
+    _ensure_backend(args)
+    import dataclasses
+
+    import jax
+
+    from fmda_tpu.app import Application
+    from fmda_tpu.runtime import FleetLoadConfig, run_fleet_load
+
+    cfg = _config(args)
+    overrides = {
+        k: v for k, v in dict(
+            capacity=max(args.sessions, cfg.runtime.capacity),
+            max_linger_ms=args.max_linger_ms,
+            queue_bound=args.queue_bound,
+            window=args.window,
+            bucket_sizes=(tuple(int(b) for b in args.bucket_sizes.split(","))
+                          if args.bucket_sizes else None),
+        ).items() if v is not None
+    }
+    cfg = dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime, **overrides))
+    app = Application(cfg)
+
+    # synthetic proof run: a randomly-initialised unidirectional carrier
+    # (the serving math is checkpoint-independent; --hidden sizes it)
+    from fmda_tpu.models import build_model
+
+    model_cfg = dataclasses.replace(
+        cfg.model, bidirectional=False, dropout=0.0,
+        hidden_size=args.hidden, n_features=cfg.features.n_features,
+        cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
+    model = build_model(model_cfg)
+    import jax.numpy as jnp
+
+    params = model.init(
+        {"params": jax.random.PRNGKey(args.seed)},
+        jnp.zeros((1, cfg.runtime.window, model_cfg.n_features)))["params"]
+
+    gateway = app.attach_fleet(model_cfg, params)
+    out = run_fleet_load(gateway, FleetLoadConfig(
+        n_sessions=args.sessions,
+        n_ticks=args.ticks, duty=args.duty, seed=args.seed))
+    out["backend"] = jax.default_backend()
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="fmda_tpu", description=__doc__,
@@ -423,6 +477,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--from-start", action="store_true",
                    help="serve existing history too, not just new rows")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "serve-fleet", parents=[common],
+        help="multi-tenant micro-batching runtime vs a synthetic fleet")
+    p.add_argument("--sessions", type=int, default=64,
+                   help="concurrent ticker sessions (pool capacity grows "
+                        "to fit when the config's is smaller)")
+    p.add_argument("--ticks", type=int, default=100,
+                   help="submission rounds over the fleet")
+    p.add_argument("--duty", type=float, default=1.0,
+                   help="fraction of sessions ticking per round")
+    p.add_argument("--hidden", type=int, default=32)
+    p.add_argument("--window", type=int, default=None,
+                   help="override config runtime.window (default 30)")
+    p.add_argument("--bucket-sizes", default=None, metavar="N,N,...",
+                   help="override config runtime.bucket_sizes "
+                        "(ascending; each is one compiled program)")
+    p.add_argument("--max-linger-ms", type=float, default=None,
+                   help="override config runtime.max_linger_ms")
+    p.add_argument("--queue-bound", type=int, default=None,
+                   help="override config runtime.queue_bound")
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_serve_fleet)
     return parser
 
 

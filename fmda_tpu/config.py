@@ -30,6 +30,9 @@ TOPIC_IND = "ind"
 TOPIC_DEEP = "deep"
 TOPIC_PREDICT_TIMESTAMP = "predict_timestamp"
 TOPIC_PREDICTION = "prediction"
+#: Fleet-serving results (fmda_tpu.runtime): one topic, per-session
+#: consumption keyed on the message's ``session`` field.
+TOPIC_FLEET_PREDICTION = "fleet_prediction"
 
 DEFAULT_TOPICS: Tuple[str, ...] = (
     TOPIC_VIX,
@@ -39,6 +42,7 @@ DEFAULT_TOPICS: Tuple[str, ...] = (
     TOPIC_DEEP,
     TOPIC_PREDICT_TIMESTAMP,
     TOPIC_PREDICTION,
+    TOPIC_FLEET_PREDICTION,
 )
 
 
@@ -340,16 +344,17 @@ class ModelConfig:
     #: mirrors the reference's bidirectional window encoder.
     attn_causal: bool = False
     #: Residual/internal dropout for cell="attn" encoder blocks; None
-    #: falls back to ``dropout``.  Separate knob because the protocol's
-    #: dropout=0.5 is the INPUT spatial dropout (biGRU_model.py:87-94) —
-    #: the reference's 1-layer GRU core itself carries no dropout, so
-    #: 0.5 on every transformer residual over-regularises the attn
-    #: family relative to its siblings.  The 0.1 default is the measured
-    #: winner of the family-shootout sweep (RESULTS_FAMILIES.md: test
-    #: accuracy 0.237 vs 0.193 at 0.5, best val + backtest edge of the
-    #: sweep; 0.0 scores higher on raw test accuracy but halves the
-    #: backtest edge).
-    attn_dropout: Optional[float] = 0.1
+    #: (the default) falls back to ``dropout``.  Separate knob because
+    #: the protocol's dropout=0.5 is the INPUT spatial dropout
+    #: (biGRU_model.py:87-94) — the reference's 1-layer GRU core itself
+    #: carries no dropout, so 0.5 on every transformer residual
+    #: over-regularises the attn family relative to its siblings.  The
+    #: family-shootout sweep measured 0.1 as the winner
+    #: (RESULTS_FAMILIES.md: test accuracy 0.237 vs 0.193 at 0.5, best
+    #: val + backtest edge; 0.0 scores higher on raw test accuracy but
+    #: halves the backtest edge) — the shootout/experiment configs set
+    #: it explicitly (experiments/family_shootout.py --attn-dropout).
+    attn_dropout: Optional[float] = None
     #: Compute dtype for the GRU/head; params are kept in float32.
     dtype: str = "float32"
     #: Use the fused Pallas scan cell on TPU (falls back to lax.scan
@@ -420,6 +425,40 @@ class EngineConfig:
     checkpoint_path: Optional[str] = None
 
 
+#: Fleet-runtime defaults shared by RuntimeConfig and the direct
+#: constructors (BatcherConfig, FleetGateway) so bench/test-style direct
+#: constructions can't drift from the config defaults.
+DEFAULT_BUCKET_SIZES: Tuple[int, ...] = (8, 32, 64, 128)
+DEFAULT_MAX_LINGER_S: float = 0.002
+DEFAULT_QUEUE_BOUND: int = 1024
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Fleet-serving runtime knobs (fmda_tpu.runtime; docs/runtime.md).
+
+    Net-new vs the reference (its serving is one hand-run predict.py per
+    process) — these size the multi-tenant gateway → micro-batcher →
+    session-pool path.
+    """
+
+    #: Max concurrent sessions (slots in the pooled state tree).
+    capacity: int = 128
+    #: Ascending padded micro-batch sizes; each is ONE compiled XLA
+    #: program, replayed forever (keep the set small).  64 is in the
+    #: default set because it is the documented default fleet size —
+    #: without it a 64-session flush pads to 128 and half the batched
+    #: step is wasted lanes.
+    bucket_sizes: Tuple[int, ...] = DEFAULT_BUCKET_SIZES
+    #: Max time (ms) the oldest queued tick may linger before a flush is
+    #: forced — the latency half of the batching trade.
+    max_linger_ms: float = DEFAULT_MAX_LINGER_S * 1e3
+    #: Bound on queued ticks; overload sheds the oldest, counted.
+    queue_bound: int = DEFAULT_QUEUE_BOUND
+    #: Pooled-head trailing window of the carried streaming state.
+    window: int = 30
+
+
 @dataclass(frozen=True)
 class SessionConfig:
     """Ingestion-session driver knobs (ref: producer.py:257-263)."""
@@ -445,6 +484,7 @@ class FrameworkConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     session: SessionConfig = field(default_factory=SessionConfig)
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
 
     def __post_init__(self) -> None:
         if self.model.n_features is None:
@@ -473,6 +513,7 @@ _SECTIONS = {
     "train": TrainConfig,
     "mesh": MeshConfig,
     "session": SessionConfig,
+    "runtime": RuntimeConfig,
 }
 
 

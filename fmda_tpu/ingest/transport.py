@@ -198,7 +198,22 @@ class RateLimitTransport:
     space them, and a threaded driver needs the lock anyway).  Tests
     that inject a ``clock`` get private state so fake time never mixes
     with real-clock entries.
+
+    Shared-state semantics (``_SHARED_LAST``): the map is global
+    throttle state — it is never pruned, and instances with *different*
+    ``min_interval_s`` against the same host interact (each request
+    stamps the host's slot, so the next requester waits by its OWN
+    interval from whoever went last — matching the reference's global
+    scrapy AUTOTHROTTLE rather than per-client budgets).  Tests that
+    touch real-clock instances must call :meth:`_reset_shared_state`
+    (e.g. in a ``finally:``) so entries never leak across tests.
     """
+
+    @staticmethod
+    def _reset_shared_state() -> None:
+        """Clear the process-wide per-host throttle map (test hygiene)."""
+        with _SHARED_LAST_LOCK:
+            _SHARED_LAST.clear()
 
     def __init__(
         self,

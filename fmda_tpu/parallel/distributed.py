@@ -80,14 +80,22 @@ def shard_train_inputs_multihost(
     """Multi-host variant of ``sp_train.shard_train_inputs``: x/y are this
     process's *local* batch rows; params/optimizer are replicated (every
     host passes identical values — true after identical init seeds or a
-    checkpoint restore)."""
+    checkpoint restore).
+
+    Like the single-host helper, params/opt_state come back as fresh
+    copies (:func:`~fmda_tpu.parallel.sp_train.place_fresh_copy`):
+    ``make_sp_train_step`` donates argnums (0, 1), and a plain
+    ``device_put`` may alias the caller's tree when placement already
+    matches — the first step would then delete the caller's originals.
+    """
+    from fmda_tpu.parallel.sp_train import place_fresh_copy
+
     x = make_global_batch(
         mesh, x_local, PartitionSpec(dp_axis, sp_axis))
     y = make_global_batch(mesh, y_local, PartitionSpec(dp_axis))
     replicated = replicated_sharding(mesh)
-    params = jax.device_put(params, replicated)
-    opt_state = jax.device_put(opt_state, replicated)
-    return x, y, params, opt_state
+    return (x, y, place_fresh_copy(params, replicated),
+            place_fresh_copy(opt_state, replicated))
 
 
 def place_local_batch(mesh: Mesh, batch, dp_axis: str = "dp"):

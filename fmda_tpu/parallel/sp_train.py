@@ -100,6 +100,20 @@ def make_sp_train_step(
     return step
 
 
+def place_fresh_copy(tree, sharding):
+    """Copy-before-place for trees the train step will DONATE.
+
+    ``jax.device_put`` may alias its input when the placement already
+    matches — donating an alias would silently delete the caller's
+    original tree (e.g. a params0 reused to init several step variants),
+    surfacing later as "deleted buffer" errors.  Shared by the
+    single-host and multi-host input-placement helpers so neither can
+    drift back to the aliasing bug (ADVICE r5).
+    """
+    return jax.device_put(
+        jax.tree.map(lambda a: jnp.array(a, copy=True), tree), sharding)
+
+
 def shard_train_inputs(
     mesh: jax.sharding.Mesh,
     x,
@@ -112,20 +126,13 @@ def shard_train_inputs(
 ) -> Tuple:
     """Place (x, y, params, opt_state) with the step's expected shardings.
 
-    The returned params/opt_state are fresh copies: the train step
-    DONATES them (their buffers are consumed by the first call), and
-    ``jax.device_put`` may alias its input when the placement already
-    matches — donating an alias would silently delete the caller's
-    original tree (e.g. a params0 reused to init several step variants).
+    The returned params/opt_state are fresh copies
+    (:func:`place_fresh_copy`): the train step DONATES them, so handing
+    back an alias of the caller's tree would consume it on first call.
     """
     x = jax.device_put(
         jnp.asarray(x), sequence_sharding(mesh, dp_axis, sp_axis))
     y = jax.device_put(jnp.asarray(y), batch_sharding(mesh, dp_axis))
     replicated = replicated_sharding(mesh)
-
-    def fresh(tree):
-        return jax.device_put(
-            jax.tree.map(lambda a: jnp.array(a, copy=True), tree),
-            replicated)
-
-    return x, y, fresh(params), fresh(opt_state)
+    return (x, y, place_fresh_copy(params, replicated),
+            place_fresh_copy(opt_state, replicated))

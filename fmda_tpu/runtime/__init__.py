@@ -1,0 +1,40 @@
+"""fmda_tpu.runtime — dynamic micro-batching serving runtime.
+
+Multiplexes thousands of independent ticker sessions onto the batched
+carried-state streaming kernels: a slot-pool session manager packs N
+carried states into one state tree (:mod:`~fmda_tpu.runtime.session_pool`),
+a deadline-aware micro-batcher coalesces tick requests into a few
+compiled-once padded shapes (:mod:`~fmda_tpu.runtime.batcher`), and an
+admission-controlled gateway with bounded queueing and counted load
+shedding serves results back per-session over the framework's MessageBus
+(:mod:`~fmda_tpu.runtime.gateway`).  ``python -m fmda_tpu serve-fleet``
+runs the whole stack against a synthetic multi-ticker load
+(:mod:`~fmda_tpu.runtime.loadgen`).  Architecture: docs/runtime.md.
+"""
+
+from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
+from fmda_tpu.runtime.gateway import FleetGateway, FleetResult
+from fmda_tpu.runtime.loadgen import FleetLoadConfig, run_fleet_load
+from fmda_tpu.runtime.metrics import LatencyHistogram, RuntimeMetrics
+from fmda_tpu.runtime.session_pool import (
+    PoolExhausted,
+    SessionHandle,
+    SessionPool,
+    StaleSessionError,
+)
+
+__all__ = [
+    "BatcherConfig",
+    "MicroBatcher",
+    "Tick",
+    "FleetGateway",
+    "FleetResult",
+    "FleetLoadConfig",
+    "run_fleet_load",
+    "LatencyHistogram",
+    "RuntimeMetrics",
+    "PoolExhausted",
+    "SessionHandle",
+    "SessionPool",
+    "StaleSessionError",
+]

@@ -1,0 +1,252 @@
+"""Admission-control front door: bounded queue → micro-batcher → pool → bus.
+
+One :class:`FleetGateway` owns the serving loop for a fleet of sessions:
+
+- ``open_session``/``close_session`` — admission control against the
+  slot pool (a full pool **rejects loudly**, it never queues forever);
+- ``submit`` — enqueue a session's newest row behind a **bounded** queue;
+  overload sheds the *oldest* queued tick with a counted metric
+  (``shed_oldest``) — stale market data is the cheapest thing to lose,
+  and an unbounded queue is how serving systems die;
+- ``pump`` — flush micro-batches whenever the batcher says so
+  (batch-full or deadline), run the one fused pool step, and publish each
+  session's result on the :class:`~fmda_tpu.stream.bus.MessageBus`
+  (``fleet_prediction`` topic, ``session`` field keying per-session
+  consumption) — the same transport every other stage of the framework
+  already speaks.
+
+Every tick's journey is measured (enqueue→dispatch→device→publish
+histograms in :class:`~fmda_tpu.runtime.metrics.RuntimeMetrics`); every
+loss path is a counter, never a silent drop.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fmda_tpu.config import (
+    DEFAULT_QUEUE_BOUND,
+    TARGET_COLUMNS,
+    TOPIC_FLEET_PREDICTION,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
+from fmda_tpu.runtime.metrics import RuntimeMetrics
+from fmda_tpu.runtime.session_pool import (
+    PoolExhausted,
+    SessionHandle,
+    SessionPool,
+)
+from fmda_tpu.serve.predictor import labels_over_threshold
+
+log = logging.getLogger("fmda_tpu.runtime")
+
+
+@dataclass(frozen=True)
+class FleetResult:
+    """One served tick: the probabilities for one session's newest row."""
+
+    session_id: str
+    seq: int
+    probabilities: np.ndarray
+    labels: Tuple[str, ...]
+
+
+class FleetGateway:
+    """Multiplexes many ticker sessions onto one batched serving step."""
+
+    #: Log every Nth shed (the counter is the source of truth; the log is
+    #: a human-visible heartbeat that shedding is happening).
+    SHED_LOG_EVERY = 1000
+
+    def __init__(
+        self,
+        pool: SessionPool,
+        bus=None,
+        *,
+        batcher_config: Optional[BatcherConfig] = None,
+        queue_bound: int = DEFAULT_QUEUE_BOUND,
+        metrics: Optional[RuntimeMetrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        prediction_topic: str = TOPIC_FLEET_PREDICTION,
+        threshold: float = 0.5,
+        y_fields: Tuple[str, ...] = TARGET_COLUMNS,
+    ) -> None:
+        if queue_bound < 1:
+            raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
+        if bus is not None and prediction_topic not in bus.topics():
+            # fail at construction, not mid-flush: a publish KeyError
+            # after pool.step would lose results whose state advance is
+            # irreversible (pre-PR-1 configs with an explicit bus.topics
+            # list lack the fleet topic)
+            raise ValueError(
+                f"bus has no topic {prediction_topic!r} (configured: "
+                f"{sorted(bus.topics())}); add it to bus.topics — the "
+                "default layout includes it as TOPIC_FLEET_PREDICTION")
+        self.pool = pool
+        self.bus = bus
+        self.queue_bound = queue_bound
+        self.metrics = metrics or RuntimeMetrics()
+        self.clock = clock
+        self.prediction_topic = prediction_topic
+        self.threshold = threshold
+        self.y_fields = tuple(y_fields)
+        self.batcher = MicroBatcher(batcher_config, clock=clock)
+        self._seq: Dict[str, int] = {}
+
+    # -- admission ----------------------------------------------------------
+
+    def open_session(
+        self, session_id: str, norm: Optional[NormParams] = None
+    ) -> SessionHandle:
+        """Admit a session (raises :class:`PoolExhausted` when the fleet
+        is full — counted, so rejected admissions show up on dashboards,
+        and the caller decides whether to retry, evict, or scale)."""
+        try:
+            handle = self.pool.alloc(session_id, norm)
+        except PoolExhausted:
+            # only capacity rejections count here — a duplicate-id
+            # ValueError is a client bug, not a fleet-is-full signal
+            self.metrics.count("rejected_sessions")
+            raise
+        self._sessions_changed()
+        return handle
+
+    def close_session(self, session_id: str) -> None:
+        handle = self.pool.handle_for(session_id)
+        if handle is None:
+            raise KeyError(f"no open session {session_id!r}")
+        self.pool.free(handle)
+        self._seq.pop(session_id, None)
+        self._sessions_changed()
+
+    def _sessions_changed(self) -> None:
+        self.metrics.gauge("active_sessions", self.pool.n_active)
+        # when every active session is already pending a flush cannot
+        # grow — tell the batcher so small fleets don't wait out the
+        # linger on every steady-state flush
+        self.batcher.full_target = self.pool.n_active
+
+    # -- the request path ---------------------------------------------------
+
+    def submit(self, session_id: str, row: np.ndarray) -> int:
+        """Enqueue a session's newest feature row; returns the tick's
+        per-session sequence number.  Overload sheds the oldest queued
+        tick (counted + heartbeat-logged), never blocks, never grows the
+        queue past ``queue_bound``."""
+        handle = self.pool.handle_for(session_id)
+        if handle is None:
+            raise KeyError(f"no open session {session_id!r}")
+        row = np.array(row, np.float32)  # copy: the queue must OWN rows
+        if row.shape != (self.pool.cfg.n_features,):
+            # reject at the submitter — a malformed row reaching _flush
+            # would throw there and lose the whole batch's other ticks
+            raise ValueError(
+                f"row shape {row.shape} != ({self.pool.cfg.n_features},) "
+                f"for session {session_id!r}")
+        while len(self.batcher) >= self.queue_bound:
+            shed = self.batcher.shed_oldest()
+            self.metrics.count("shed_oldest")
+            n = self.metrics.counters["shed_oldest"]
+            if n == 1 or n % self.SHED_LOG_EVERY == 0:
+                log.warning(
+                    "queue full (bound=%d): shed oldest tick (session %s, "
+                    "seq %d); %d shed so far",
+                    self.queue_bound, shed.handle.session_id, shed.seq, n)
+        seq = self._seq.get(session_id, 0)
+        self._seq[session_id] = seq + 1
+        self.batcher.add(Tick(
+            handle=handle, row=row, t_enqueue=self.clock(), seq=seq))
+        self.metrics.gauge("queue_depth", len(self.batcher))
+        return seq
+
+    @property
+    def saturated(self) -> bool:
+        """Backpressure signal: the next submit will shed.  Well-behaved
+        producers check this and slow down instead of racing the shedder."""
+        return len(self.batcher) >= self.queue_bound
+
+    # -- the serving loop ---------------------------------------------------
+
+    def pump(self, *, force: bool = False) -> List[FleetResult]:
+        """Flush ready micro-batches (all pending ones when ``force`` —
+        the drain path).  Returns every result served this call; each is
+        also published on the bus when one is attached."""
+        results: List[FleetResult] = []
+        while True:
+            if force:
+                if not len(self.batcher):
+                    break
+            elif not self.batcher.ready(self.clock()):
+                break
+            ticks = self.batcher.take_batch()
+            if not ticks:
+                break
+            results.extend(self._flush(ticks))
+        self.metrics.gauge("queue_depth", len(self.batcher))
+        return results
+
+    def drain(self) -> List[FleetResult]:
+        """Serve everything still queued, deadline or not (shutdown/end
+        of load)."""
+        return self.pump(force=True)
+
+    def _flush(self, ticks: List[Tick]) -> List[FleetResult]:
+        t_dispatch = self.clock()
+        live = []
+        for tick in ticks:
+            # a session freed while its tick was queued: drop, visibly
+            if self.pool.is_live(tick.handle):
+                live.append(tick)
+            else:
+                self.metrics.count("stale_dropped")
+        if not live:
+            return []
+        bucket = self.batcher.bucket_for(len(live))
+        slots = np.full(bucket, self.pool.padding_slot, np.int32)
+        rows = np.zeros((bucket, self.pool.cfg.n_features), np.float32)
+        for i, tick in enumerate(live):
+            slots[i] = tick.handle.slot
+            rows[i] = tick.row
+        # "device" measures ONLY the jit step (+ its host transfer), not
+        # the stale filter or batch assembly above — those land between
+        # enqueue_to_dispatch and device, and always inside "total"
+        t_assembled = self.clock()
+        with self.metrics.timer.stage("device"):
+            probs = self.pool.step(slots, rows)  # blocks: host np array
+        t_device = self.clock()
+
+        results = []
+        with self.metrics.timer.stage("publish"):
+            for i, tick in enumerate(live):
+                p = probs[i]
+                _, labels = labels_over_threshold(
+                    p, self.threshold, self.y_fields)
+                results.append(FleetResult(
+                    tick.handle.session_id, tick.seq, p, labels))
+                if self.bus is not None:
+                    self.bus.publish(self.prediction_topic, {
+                        "session": tick.handle.session_id,
+                        "seq": tick.seq,
+                        "probabilities": [float(v) for v in p],
+                        "pred_labels": list(labels),
+                        "prob_threshold": self.threshold,
+                    })
+        t_publish = self.clock()
+
+        m = self.metrics
+        m.count("flushes")
+        m.count("ticks_served", len(live))
+        m.count(f"flushes_bucket_{bucket}")
+        m.count("padded_lanes", bucket - len(live))
+        m.observe("device", t_device - t_assembled)
+        m.observe("publish", t_publish - t_device)
+        for tick in live:
+            m.observe("enqueue_to_dispatch", t_dispatch - tick.t_enqueue)
+            m.observe("total", t_publish - tick.t_enqueue)
+        return results

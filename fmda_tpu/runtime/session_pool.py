@@ -1,0 +1,298 @@
+"""Slot-pool session manager: N carried streaming states in one state tree.
+
+The streaming carriers (:class:`fmda_tpu.serve.streaming.StreamingBiGRU`)
+already accept a ``batch`` dimension, but a fixed batch serves tickers in
+lockstep — every row advances every lane.  A serving fleet is the opposite
+shape: thousands of independent sessions, each ticking on its own clock,
+and any given micro-batch carries rows for an arbitrary *subset* of them.
+
+:class:`SessionPool` packs up to ``capacity`` carried states into one
+``(capacity+1, ...)`` state tree and exposes a single jitted step over a
+*gather → batched cell → scatter* program:
+
+- ``slots (B,)`` selects which sessions this flush advances; their carry,
+  ring, and tick positions are gathered, advanced with exactly the solo
+  carrier's ops (same normalize → input-proj → gate → ring-update →
+  masked-pool → head sequence, so a multiplexed session is bit-identical
+  to a solo run), and scattered back;
+- the extra slot (index ``capacity``) is the **padding lane**: micro-batch
+  lanes beyond the real request count point at it, so padded flushes need
+  no active-lane mask inside the step — padding writes land in state no
+  session reads ("dead slots don't pollute pooling" by construction);
+- per-slot **generation counters** guard reuse: ``free`` bumps the slot's
+  generation, so a :class:`SessionHandle` kept past ``free`` can never
+  read or advance a recycled slot (the stale-session bug class of every
+  slot-reuse cache; see the O(1)-cache serving papers in PAPERS.md).
+
+The step is compiled once per distinct batch size ``B``; the micro-batcher
+(:mod:`fmda_tpu.runtime.batcher`) quantises ``B`` to a few bucket sizes so
+XLA compiles a handful of programs and replays them forever
+(:attr:`SessionPool.compile_count` is the proof hook tests assert on).
+
+Scope: the unidirectional recurrent carriers (``cell="gru"``/``"lstm"``,
+any ``n_layers`` — the pure O(1)-per-tick cores).  Bidirectional or attn
+serving re-encodes a window per tick; multiplex those through the
+window-re-scan :class:`~fmda_tpu.serve.predictor.Predictor` instead.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fmda_tpu.config import ModelConfig
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.serve.streaming import (
+    _recurrent_cell_ops,
+    advance_cells,
+    pooled_head_logits,
+)
+
+log = logging.getLogger("fmda_tpu.runtime")
+
+
+class PoolExhausted(Exception):
+    """alloc() on a pool with no free slots (admission control reacts)."""
+
+
+class StaleSessionError(Exception):
+    """A SessionHandle used after its slot was freed (or re-allocated)."""
+
+
+@dataclass(frozen=True)
+class SessionHandle:
+    """A claim on one pool slot, valid for exactly one generation."""
+
+    session_id: str
+    slot: int
+    generation: int
+
+
+class SessionPool:
+    """Fixed-capacity pool of carried streaming states (one jitted step).
+
+    ``alloc``/``free``/``reset`` manage slots host-side, off the hot
+    path (each functional ``.at[slot].set`` update copies its
+    (capacity+1, ...) array, so slot churn costs O(capacity) per call —
+    fine at serving-session churn rates; a donate-based fused reset is
+    the known optimisation if admission ever becomes hot).  ``step`` is
+    the hot path — one fused jit call advancing every session named in
+    ``slots`` by one tick.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        *,
+        capacity: int,
+        window: int,
+    ) -> None:
+        gate_step, _, self._n_carry, _ = _recurrent_cell_ops(cfg.cell)
+        if cfg.bidirectional:
+            raise ValueError(
+                "SessionPool multiplexes the unidirectional carried-state "
+                "cores (O(1)/tick); serve bidirectional models through the "
+                "window-re-scan Predictor."
+            )
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.cfg = cfg
+        self.capacity = capacity
+        self.window = window
+        #: The padding lane every padded micro-batch points its unused
+        #: lanes at — state no session is ever allocated.
+        self.padding_slot = capacity
+        self._dtype = jnp.dtype(cfg.dtype)
+        dtype = self._dtype
+        self._params = jax.tree.map(
+            lambda a: jnp.asarray(a).astype(dtype), params)
+
+        n_slots = capacity + 1
+        hidden = cfg.hidden_size
+        feats = cfg.n_features
+        self._carry = tuple(
+            tuple(jnp.zeros((n_slots, hidden), dtype)
+                  for _ in range(self._n_carry))
+            for _ in range(cfg.n_layers))
+        self._ring = jnp.zeros((n_slots, window, hidden), dtype)
+        self._pos = jnp.zeros((n_slots,), jnp.int32)
+        # per-slot normalization (sessions serve different tickers with
+        # different price scales), gathered alongside the state
+        self._x_min = jnp.zeros((n_slots, feats), jnp.float32)
+        self._x_range = jnp.ones((n_slots, feats), jnp.float32)
+
+        # host-side slot bookkeeping
+        self._generations = [0] * capacity
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._by_id: Dict[str, SessionHandle] = {}
+        # fallback compile accounting for compile_count (distinct batch
+        # sizes dispatched == programs compiled, since everything else
+        # in the step signature is shape-stable)
+        self._batch_sizes_seen: set = set()
+
+        w = window
+
+        def step(params, carry, ring, pos, x_min, x_range, slots, rows):
+            """Advance the sessions in ``slots`` by one row each.
+
+            Gather → the solo carrier's per-tick math
+            (:func:`~fmda_tpu.serve.streaming.advance_cells` +
+            :func:`~fmda_tpu.serve.streaming.pooled_head_logits`, shared
+            code, not a copy) on a (B, ...) slice → scatter.  ``slots``
+            must be duplicate-free over *live* slots (the batcher
+            guarantees one row per session per flush); the padding lane
+            may repeat freely — its scattered writes collide only with
+            each other, in state nothing reads.
+            """
+            x = ((rows - x_min[slots]) / x_range[slots]).astype(dtype)
+            pos_b = pos[slots]
+            carry_b = tuple(
+                tuple(c[slots] for c in layer) for layer in carry)
+            h_new, carry_new = advance_cells(params, cfg, gate_step, x,
+                                             carry_b)
+            ring = ring.at[slots, pos_b % w].set(h_new)
+            ring_b = ring[slots]
+            # per-session valid trailing window: n_valid is (B, 1) here,
+            # a scalar in the solo carrier — same head either way
+            n_valid = jnp.minimum(pos_b + 1, w)[:, None]
+            logits = pooled_head_logits(params, h_new, ring_b, n_valid)
+            carry_out = tuple(
+                tuple(c.at[slots].set(cb)
+                      for c, cb in zip(carry[layer], carry_new[layer]))
+                for layer in range(cfg.n_layers))
+            pos = pos.at[slots].set(pos_b + 1)
+            return jax.nn.sigmoid(logits), carry_out, ring, pos
+
+        self._step = jax.jit(step)
+
+    # -- slot lifecycle (host-side, off the hot path) -----------------------
+
+    def alloc(
+        self, session_id: str, norm: Optional[NormParams] = None
+    ) -> SessionHandle:
+        """Claim a free slot for ``session_id``: zeroed state, the
+        session's own normalization stats, a fresh generation."""
+        if session_id in self._by_id:
+            raise ValueError(f"session {session_id!r} already allocated")
+        if not self._free:
+            raise PoolExhausted(
+                f"all {self.capacity} slots in use ({len(self._by_id)} "
+                "sessions); free one or raise RuntimeConfig.capacity")
+        slot = self._free.pop()
+        self._reset_slot(slot)
+        if norm is not None:
+            x_min = np.asarray(norm.x_min, np.float32)
+            x_range = np.asarray(norm.x_max, np.float32) - x_min
+            self._x_min = self._x_min.at[slot].set(x_min)
+            self._x_range = self._x_range.at[slot].set(x_range)
+        else:
+            self._x_min = self._x_min.at[slot].set(0.0)
+            self._x_range = self._x_range.at[slot].set(1.0)
+        handle = SessionHandle(session_id, slot, self._generations[slot])
+        self._by_id[session_id] = handle
+        return handle
+
+    def free(self, handle: SessionHandle) -> None:
+        """Release the slot.  The generation bump invalidates every copy
+        of ``handle`` — a later ``step``/``check`` with it raises instead
+        of touching whichever session re-used the slot."""
+        self.check(handle)
+        self._generations[handle.slot] += 1
+        del self._by_id[handle.session_id]
+        self._free.append(handle.slot)
+
+    def reset(self, handle: SessionHandle) -> None:
+        """Zero the session's carried state in place (same slot, same
+        generation — for a client restarting its stream)."""
+        self.check(handle)
+        self._reset_slot(handle.slot)
+
+    def _reset_slot(self, slot: int) -> None:
+        self._carry = tuple(
+            tuple(c.at[slot].set(0.0) for c in layer)
+            for layer in self._carry)
+        self._ring = self._ring.at[slot].set(0.0)
+        self._pos = self._pos.at[slot].set(0)
+
+    def is_live(self, handle: SessionHandle) -> bool:
+        return (
+            0 <= handle.slot < self.capacity
+            and self._generations[handle.slot] == handle.generation
+            and self._by_id.get(handle.session_id) == handle
+        )
+
+    def check(self, handle: SessionHandle) -> None:
+        if not self.is_live(handle):
+            reallocated = any(
+                h.slot == handle.slot for h in self._by_id.values())
+            raise StaleSessionError(
+                f"handle for session {handle.session_id!r} (slot "
+                f"{handle.slot}, generation {handle.generation}) is no "
+                "longer live — the slot was freed"
+                + (" and re-allocated to another session"
+                   if reallocated else ""))
+
+    def handle_for(self, session_id: str) -> Optional[SessionHandle]:
+        return self._by_id.get(session_id)
+
+    def ticks_seen(self, handle: SessionHandle) -> int:
+        self.check(handle)
+        return int(self._pos[handle.slot])
+
+    @property
+    def n_active(self) -> int:
+        return len(self._by_id)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_mask(self) -> np.ndarray:
+        """(capacity,) bool — which slots currently carry a live session."""
+        mask = np.zeros(self.capacity, bool)
+        for h in self._by_id.values():
+            mask[h.slot] = True
+        return mask
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct compiled programs behind the jitted step — one per
+        micro-batch bucket size.  Tests assert this stays equal to the
+        number of buckets actually dispatched (no per-request recompiles).
+
+        Probes jax's jit cache directly when the (private) hook exists —
+        the honest measurement; falls back to counting distinct dispatched
+        batch sizes (equivalent here: batch size is the only varying
+        shape in the step signature) if a jax upgrade removes it.
+        """
+        cache_size = getattr(self._step, "_cache_size", None)
+        if cache_size is not None:
+            return cache_size()
+        return len(self._batch_sizes_seen)
+
+    # -- the hot path -------------------------------------------------------
+
+    def step(self, slots: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """One fused flush: advance ``slots[i]`` by ``rows[i]``.
+
+        ``slots`` (B,) int32 — pool slots, padded lanes = ``padding_slot``;
+        ``rows`` (B, F) float32.  Returns (B, n_classes) sigmoid
+        probabilities (padding lanes carry garbage; callers slice them
+        off).  Caller contract: at most one lane per live slot, handles
+        already validated (the gateway/batcher do both).
+        """
+        slots = jnp.asarray(slots, jnp.int32)
+        rows = jnp.asarray(rows, jnp.float32)
+        self._batch_sizes_seen.add(int(slots.shape[0]))
+        probs, self._carry, self._ring, self._pos = self._step(
+            self._params, self._carry, self._ring, self._pos,
+            self._x_min, self._x_range, slots, rows,
+        )
+        return np.asarray(probs)

@@ -39,6 +39,18 @@ from fmda_tpu.utils.timeutils import get_timezone, parse_ts
 log = logging.getLogger("fmda_tpu.serve")
 
 
+def labels_over_threshold(
+    probs, threshold: float, y_fields: Sequence[str]
+) -> Tuple[Tuple[int, ...], Tuple[str, ...]]:
+    """The one threshold decision every serving path shares (strict ``>``,
+    ref predict.py:186-190): (label_indices, labels) for probabilities
+    over ``threshold``.  Used by the window-re-scan Predictor, the
+    streaming predictor, and the fleet gateway — change the semantics
+    here, not per path."""
+    idx = tuple(int(i) for i in np.where(np.asarray(probs) > threshold)[0])
+    return idx, tuple(y_fields[i] for i in idx)
+
+
 @dataclass(frozen=True)
 class Prediction:
     timestamp: str
@@ -150,8 +162,8 @@ class Predictor:
         ids = range(row_id - self.window + 1, row_id + 1)
         x = self.warehouse.fetch(ids)[None, ...]  # (1, window, F)
         probs = np.asarray(self._forward(self._params, jnp.asarray(x)))
-        idx = tuple(int(i) for i in np.where(probs > self.threshold)[0])
-        labels = tuple(self.y_fields[i] for i in idx)
+        idx, labels = labels_over_threshold(probs, self.threshold,
+                                            self.y_fields)
         pred = Prediction(
             timestamp=ts_str,
             probabilities=tuple(float(p) for p in probs),

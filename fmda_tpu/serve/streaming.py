@@ -49,6 +49,7 @@ import numpy as np
 
 from fmda_tpu.config import ModelConfig, TARGET_COLUMNS
 from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.serve.predictor import labels_over_threshold
 from fmda_tpu.ops.gru import GRUWeights, gru_gates, gru_scan
 from fmda_tpu.ops.lstm import LSTMWeights, lstm_gates, lstm_scan
 
@@ -106,6 +107,48 @@ def _recurrent_cell_ops(cell: str):
     )
 
 
+def advance_cells(params, cfg, gate_step, x, carries):
+    """One tick through the stacked unidirectional cells: layer l's input
+    at tick t is layer l-1's hidden output at tick t (no window
+    dependence).  ``carries`` is a per-layer tuple of cell-carry tuples
+    of (B, H) arrays; returns (last layer's h_new, new carries).
+
+    Shared by the solo carrier and the fleet session pool
+    (fmda_tpu/runtime/session_pool.py) so the per-tick math exists ONCE —
+    the pool differs only in gathering/scattering its (B, H) slices from
+    the pooled state tree.
+    """
+    layer_in = x
+    new_carries = []
+    h_new = None
+    for layer in range(cfg.n_layers):
+        w = _layer_weights(params, reverse=False, cell=cfg.cell,
+                           layer=layer)
+        xp = layer_in @ w.w_ih.T + w.b_ih
+        h_new, carry_new = gate_step(xp, carries[layer], w)
+        new_carries.append(carry_new)
+        layer_in = h_new
+    return h_new, tuple(new_carries)
+
+
+def pooled_head_logits(params, h_last, ring, n_valid):
+    """The trailing-window pooled head (biGRU_model.py:108-137 semantics)
+    over a ring of per-step hidden outputs: masked max/mean pools of the
+    valid window + last hidden, through the linear head.
+
+    ``ring`` is (B, window, H); ``n_valid`` is a scalar (solo carrier,
+    all lanes in lockstep) or (B, 1) (fleet pool, per-session tick
+    counts) — the same broadcasting covers both, so the head exists once.
+    """
+    window = ring.shape[1]
+    valid = (jnp.arange(window) < n_valid)[..., None]  # (W,1) or (B,W,1)
+    neg = jnp.finfo(ring.dtype).min
+    max_pool = jnp.max(jnp.where(valid, ring, neg), axis=1)
+    avg_pool = jnp.sum(jnp.where(valid, ring, 0.0), axis=1) / n_valid
+    concat = jnp.concatenate([h_last, max_pool, avg_pool], axis=-1)
+    return concat @ params["linear"]["kernel"] + params["linear"]["bias"]
+
+
 class StreamingBiGRU:
     """Carried-state streaming inference core for unidirectional models.
 
@@ -139,44 +182,30 @@ class StreamingBiGRU:
         # but the serving path is latency-critical)
         self._params = jax.tree.map(
             lambda a: jnp.asarray(a).astype(dtype), params)
-        x_min = jnp.asarray(norm.x_min)
-        x_range = jnp.asarray(norm.x_max - norm.x_min)
+        # norm stats are jit *arguments*, not closure constants: XLA
+        # compiles a constant denominator differently from a traced one
+        # (ulp-level), and the fleet runtime's session pool necessarily
+        # passes per-slot norms as data — argument-passing here keeps a
+        # solo carrier bit-identical to a multiplexed one
+        # (tests/test_runtime.py), and lets live norm updates reuse the
+        # compiled step.
+        self._x_min = jnp.asarray(norm.x_min)
+        self._x_range = jnp.asarray(norm.x_max - norm.x_min)
 
-        def step(params, carry, ring, ring_pos, row):
+        def step(params, x_min, x_range, carry, ring, ring_pos, row):
             """One tick: row (B, F) -> (logits, new_carry, new_ring, pos).
 
             ``carry`` is a per-layer tuple of cell-carry tuples — stacked
-            layers stay O(1)/tick because layer l's input at tick t is
-            just layer l-1's hidden output at tick t (unidirectional
-            stacking has no window dependence; the ring pools the LAST
+            layers stay O(1)/tick (advance_cells; the ring pools the LAST
             layer's outputs, models/bigru.py:148-150)."""
             x = ((row - x_min) / x_range).astype(dtype)
-            layer_in = x
-            carry_new = []
-            h_new = None
-            for layer in range(cfg.n_layers):
-                w = _layer_weights(params, reverse=False, cell=cfg.cell,
-                                   layer=layer)
-                xp = layer_in @ w.w_ih.T + w.b_ih
-                h_new, c_new = gate_step(xp, carry[layer], w)
-                carry_new.append(c_new)
-                layer_in = h_new
-            carry_new = tuple(carry_new)
+            h_new, carry_new = advance_cells(params, cfg, gate_step, x,
+                                             carry)
             ring = jax.lax.dynamic_update_index_in_dim(
                 ring, h_new, ring_pos % self.window, axis=1
             )
-            # pooled head over the trailing window of hidden outputs
-            # (biGRU_model.py:108-137 semantics; last_hidden == h_new here)
             n_valid = jnp.minimum(ring_pos + 1, self.window)
-            steps = jnp.arange(self.window)
-            valid = (steps < n_valid)[None, :, None]
-            neg = jnp.finfo(ring.dtype).min
-            max_pool = jnp.max(jnp.where(valid, ring, neg), axis=1)
-            avg_pool = jnp.sum(jnp.where(valid, ring, 0.0), axis=1) / n_valid
-            concat = jnp.concatenate([h_new, max_pool, avg_pool], axis=-1)
-            logits = (
-                concat @ params["linear"]["kernel"] + params["linear"]["bias"]
-            )
+            logits = pooled_head_logits(params, h_new, ring, n_valid)
             return logits, carry_new, ring, ring_pos + 1
 
         self._step = jax.jit(step)
@@ -203,7 +232,8 @@ class StreamingBiGRU:
         if row.ndim == 1:
             row = row[None, :]
         logits, self._h, self._ring, self._pos = self._step(
-            self._params, self._h, self._ring, self._pos, row
+            self._params, self._x_min, self._x_range, self._h, self._ring,
+            self._pos, row
         )
         return np.asarray(jax.nn.sigmoid(logits))
 
@@ -390,15 +420,15 @@ class StreamingPredictor:
                 for x in self.warehouse.fetch(range(lo, hi + 1)):
                     probs = self.core.step(x)[0]
             self._last_row_id = row_id
-            idx = np.where(probs > self.threshold)[0]
-            labels = tuple(self.y_fields[i] for i in idx)
+            idx, labels = labels_over_threshold(
+                probs, self.threshold, self.y_fields)
             self.bus.publish(
                 self.prediction_topic,
                 {
                     "timestamp": ts,
                     "probabilities": [float(p) for p in probs],
                     "prob_threshold": self.threshold,
-                    "pred_indices": [int(i) for i in idx],
+                    "pred_indices": list(idx),
                     "pred_labels": list(labels),
                 },
             )

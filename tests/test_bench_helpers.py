@@ -66,6 +66,7 @@ class TestPhaseRegistry:
             "multiticker", "serving", "torch",
             "tpu_export",
             "replay",
+            "runtime_fleet_smoke",
         }
         assert expected == set(bench._PHASES)
 

@@ -26,6 +26,7 @@ def test_topic_layout():
         "deep",
         "predict_timestamp",
         "prediction",
+        "fleet_prediction",
     )
 
 

@@ -106,9 +106,12 @@ def test_rate_limit_transports_share_per_host_state():
         t3.get("https://shared.example/c")
         assert sleeps == [2.0]
     finally:
-        from fmda_tpu.ingest import transport as _tr
+        # don't leak fake-clock entries into other tests' real-clock
+        # transports (the map is process-global by design)
+        RateLimitTransport._reset_shared_state()
+    from fmda_tpu.ingest import transport as _tr
 
-        _tr._SHARED_LAST.clear()  # don't leak fake-clock entries
+    assert _tr._SHARED_LAST == {}
 
 
 def test_live_transport_is_wired_retry_over_ratelimit():

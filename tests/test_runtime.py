@@ -1,0 +1,546 @@
+"""fmda_tpu.runtime — the dynamic micro-batching serving runtime.
+
+Covers the ISSUE-1 acceptance surface: slot alloc/free/reuse under
+generation guards, deadline vs batch-full flushing, padded-bucket compile
+stability (no per-request recompilation, asserted via the jit cache-size
+hook), visible load-shedding under overload, and the numerical contract —
+a multiplexed session is bit-identical to a solo
+:class:`~fmda_tpu.serve.streaming.StreamingBiGRU` run at bucket size 1,
+and within float32 ulp noise (the same 1e-6 the seed's lockstep-batched
+test uses) for batched buckets, where XLA's B>1 matmul codegen differs
+from B=1 in reduction order.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    ModelConfig,
+    TOPIC_FLEET_PREDICTION,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.runtime import (
+    BatcherConfig,
+    FleetGateway,
+    FleetLoadConfig,
+    MicroBatcher,
+    PoolExhausted,
+    SessionPool,
+    StaleSessionError,
+    Tick,
+    run_fleet_load,
+)
+from fmda_tpu.runtime.metrics import LatencyHistogram
+from fmda_tpu.serve.streaming import StreamingBiGRU
+from fmda_tpu.stream import InProcessBus
+
+
+def _setup(feats=6, hidden=5, window=4, seed=0, cell="gru"):
+    cfg = ModelConfig(hidden_size=hidden, n_features=feats, output_size=4,
+                      dropout=0.0, bidirectional=False, use_pallas=False,
+                      cell=cell)
+    from fmda_tpu.models import build_model
+
+    model = build_model(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        jnp.zeros((1, window, feats)))["params"]
+    return cfg, params
+
+
+def _norms(n, feats, seed=0):
+    rng = np.random.default_rng(seed)
+    mins = rng.normal(size=(n, feats)).astype(np.float32)
+    maxs = mins + rng.uniform(1.0, 5.0, size=(n, feats)).astype(np.float32)
+    return [NormParams(mins[i], maxs[i]) for i in range(n)]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# session pool: slot lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_pool_alloc_free_reuse_with_generation_guard():
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=2, window=4)
+    a = pool.alloc("a")
+    b = pool.alloc("b")
+    assert pool.n_active == 2 and pool.n_free == 0
+    assert pool.active_mask.sum() == 2
+    with pytest.raises(PoolExhausted):
+        pool.alloc("c")
+
+    pool.free(a)
+    assert pool.n_active == 1 and pool.n_free == 1
+    assert not pool.is_live(a)
+    # the freed handle is dead for every API, even after slot reuse
+    with pytest.raises(StaleSessionError):
+        pool.ticks_seen(a)
+    c = pool.alloc("c")
+    assert c.slot == a.slot  # slot recycled...
+    assert c.generation == a.generation + 1  # ...under a new generation
+    assert pool.is_live(c) and not pool.is_live(a)
+    with pytest.raises(StaleSessionError):
+        pool.free(a)
+    # double-alloc of a live id is an error, not a silent second slot
+    with pytest.raises(ValueError, match="already allocated"):
+        pool.alloc("b")
+    pool.free(b)
+    pool.free(c)
+    assert pool.n_active == 0 and pool.n_free == 2
+
+
+def test_pool_slot_reuse_carries_no_stale_state():
+    """A freed-and-reused slot must serve the new session from zeroed
+    state: the recycled slot's output stream equals a fresh solo core's,
+    bit for bit (bucket size 1)."""
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=1, window=4)
+    rng = np.random.default_rng(2)
+    rows = rng.normal(size=(5, cfg.n_features)).astype(np.float32)
+
+    a = pool.alloc("a")
+    for k in range(3):  # dirty the slot
+        pool.step(np.array([a.slot], np.int32), rows[k][None])
+    assert pool.ticks_seen(a) == 3
+    pool.free(a)
+
+    b = pool.alloc("b")
+    solo = StreamingBiGRU(
+        cfg, params,
+        NormParams(np.zeros(cfg.n_features, np.float32),
+                   np.ones(cfg.n_features, np.float32)),
+        window=4)
+    for k in range(5):
+        got = pool.step(np.array([b.slot], np.int32), rows[k][None])[0]
+        want = solo.step(rows[k])[0]
+        np.testing.assert_array_equal(got, want)
+    assert pool.ticks_seen(b) == 5
+
+
+def test_pool_rejects_bidirectional():
+    cfg = ModelConfig(hidden_size=4, n_features=3, output_size=4,
+                      bidirectional=True)
+    with pytest.raises(ValueError, match="Predictor"):
+        SessionPool(cfg, {}, capacity=2, window=4)
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher: flush decisions + ordering
+# ---------------------------------------------------------------------------
+
+
+def _tick(slot, gen=0, t=0.0, seq=0, sid="s"):
+    from fmda_tpu.runtime.session_pool import SessionHandle
+
+    return Tick(handle=SessionHandle(f"{sid}{slot}", slot, gen),
+                row=np.zeros(3, np.float32), t_enqueue=t, seq=seq)
+
+
+def test_batcher_flushes_on_batch_full():
+    clock = FakeClock()
+    b = MicroBatcher(BatcherConfig(bucket_sizes=(2, 4), max_linger_s=10.0),
+                     clock=clock)
+    b.add(_tick(0))
+    b.add(_tick(1))
+    b.add(_tick(2))
+    assert not b.ready()  # 3 distinct < largest bucket (4), no linger yet
+    b.add(_tick(3))
+    assert b.ready()  # distinct sessions fill the largest bucket
+    assert [t.handle.slot for t in b.take_batch()] == [0, 1, 2, 3]
+    assert len(b) == 0
+
+
+def test_batcher_flushes_on_deadline():
+    clock = FakeClock()
+    b = MicroBatcher(BatcherConfig(bucket_sizes=(8,), max_linger_s=0.005),
+                     clock=clock)
+    b.add(_tick(0, t=clock()))
+    assert not b.ready()  # neither full nor lingered
+    clock.advance(0.004)
+    assert not b.ready()
+    clock.advance(0.002)  # oldest now 6ms > 5ms budget
+    assert b.ready()
+    assert len(b.take_batch()) == 1
+
+
+def test_batcher_one_row_per_session_per_flush():
+    """Two rows of one session advance a recurrence — they can never
+    share a flush; per-session FIFO order survives the deferral."""
+    b = MicroBatcher(BatcherConfig(bucket_sizes=(4,), max_linger_s=0.0))
+    b.add(_tick(0, seq=0))
+    b.add(_tick(1, seq=0))
+    b.add(_tick(0, seq=1))
+    b.add(_tick(0, seq=2))
+    assert b.distinct_sessions == 2
+    first = b.take_batch()
+    assert [(t.handle.slot, t.seq) for t in first] == [(0, 0), (1, 0)]
+    second = b.take_batch()
+    assert [(t.handle.slot, t.seq) for t in second] == [(0, 1)]
+    third = b.take_batch()
+    assert [(t.handle.slot, t.seq) for t in third] == [(0, 2)]
+
+
+def test_batcher_bucket_selection():
+    b = MicroBatcher(BatcherConfig(bucket_sizes=(2, 8, 32)))
+    assert b.bucket_for(1) == 2
+    assert b.bucket_for(2) == 2
+    assert b.bucket_for(3) == 8
+    assert b.bucket_for(32) == 32
+    with pytest.raises(ValueError, match="largest bucket"):
+        b.bucket_for(33)
+    with pytest.raises(ValueError, match="ascending"):
+        BatcherConfig(bucket_sizes=(8, 2))
+
+
+# ---------------------------------------------------------------------------
+# compile stability: padded buckets, no per-request recompilation
+# ---------------------------------------------------------------------------
+
+
+def test_padded_buckets_avoid_recompilation():
+    """Ragged flush sizes 1..8 over many flushes compile exactly one
+    program per configured bucket actually used — never one per request
+    size (the compiled-once/dispatch-many contract)."""
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=8, window=4)
+    gw = FleetGateway(
+        pool,
+        batcher_config=BatcherConfig(bucket_sizes=(4, 8), max_linger_s=0.0))
+    for i in range(8):
+        gw.open_session(f"T{i}")
+    rng = np.random.default_rng(0)
+    assert pool.compile_count == 0
+    buckets_seen = set()
+    for round_ in range(12):
+        n = 1 + round_ % 8  # flush sizes 1..8
+        for i in range(n):
+            gw.submit(f"T{i}", rng.normal(size=cfg.n_features))
+        res = gw.drain()
+        assert len(res) == n
+        buckets_seen.add(gw.batcher.bucket_for(n))
+    assert buckets_seen == {4, 8}
+    assert pool.compile_count == 2  # one program per bucket, ever
+    counters = gw.metrics.counters
+    assert counters["flushes_bucket_4"] + counters["flushes_bucket_8"] == 12
+
+
+# ---------------------------------------------------------------------------
+# overload: backpressure + visible shedding, no deadlock, no unbounded queue
+# ---------------------------------------------------------------------------
+
+
+def test_small_fleet_flushes_without_linger_wait():
+    """A fleet smaller than the largest bucket must not pay max_linger on
+    every steady-state flush: once every active session is pending, the
+    flush cannot grow, so pump() fires immediately (full_target)."""
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=5, window=4)
+    clock = FakeClock()
+    gw = FleetGateway(
+        pool,
+        batcher_config=BatcherConfig(bucket_sizes=(8, 128),
+                                     max_linger_s=99.0),
+        clock=clock)
+    for i in range(5):
+        gw.open_session(f"T{i}")
+    rng = np.random.default_rng(3)
+    for i in range(5):
+        gw.submit(f"T{i}", rng.normal(size=cfg.n_features))
+    # zero clock advance, linger budget untouched: all 5 pending == all
+    # 5 active -> batch-full semantics, one padded bucket-8 flush
+    res = gw.pump()
+    assert len(res) == 5
+    assert gw.metrics.counters["flushes_bucket_8"] == 1
+    # a PARTIAL round (3 of 5) still waits for the deadline
+    for i in range(3):
+        gw.submit(f"T{i}", rng.normal(size=cfg.n_features))
+    assert gw.pump() == []
+    clock.advance(100.0)
+    assert len(gw.pump()) == 3
+
+
+def test_loadgen_respects_backpressure_beyond_queue_bound():
+    """Fleets larger than queue_bound drain on saturation instead of
+    racing the shedder: every submitted tick is served, none shed."""
+    cfg, params = _setup(feats=4, hidden=4, window=3)
+    pool = SessionPool(cfg, params, capacity=40, window=3)
+    gw = FleetGateway(
+        pool,
+        batcher_config=BatcherConfig(bucket_sizes=(16,), max_linger_s=99.0),
+        queue_bound=10)
+    out = run_fleet_load(
+        gw, FleetLoadConfig(n_sessions=40, n_ticks=3, duty=1.0, seed=0))
+    assert out["ticks_submitted"] == 120
+    assert out["ticks_served"] == 120
+    assert out["counters"].get("shed_oldest", 0) == 0
+
+
+def test_overload_sheds_oldest_with_counters():
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=4, window=4)
+    clock = FakeClock()
+    gw = FleetGateway(
+        pool,
+        batcher_config=BatcherConfig(bucket_sizes=(4,), max_linger_s=99.0),
+        queue_bound=6, clock=clock)
+    for i in range(4):
+        gw.open_session(f"T{i}")
+    rng = np.random.default_rng(1)
+    # 20 submits, never pumped: the queue must stay bounded and the
+    # overflow must be counted, not silently vanish
+    for k in range(20):
+        gw.submit(f"T{k % 4}", rng.normal(size=cfg.n_features))
+    assert len(gw.batcher) == 6
+    assert gw.saturated
+    assert gw.metrics.counters["shed_oldest"] == 14
+    assert gw.metrics.gauges["queue_depth_peak"] == 6
+    # the survivors are the NEWEST ticks (oldest-drop policy) and drain
+    # without deadlock: 6 queued ticks over 4 sessions -> 2 flushes
+    res = gw.drain()
+    assert len(res) == 6
+    # submits 14..19 survive: (T2,3) (T3,3) (T0,4) (T1,4) (T2,4) (T3,4)
+    assert sorted((r.session_id, r.seq) for r in res) == [
+        ("T0", 4), ("T1", 4), ("T2", 3), ("T2", 4), ("T3", 3), ("T3", 4)]
+    assert gw.metrics.counters["ticks_served"] == 6
+    assert len(gw.batcher) == 0 and not gw.saturated
+
+
+def test_session_close_drops_queued_ticks_visibly():
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=2, window=4)
+    gw = FleetGateway(
+        pool, batcher_config=BatcherConfig(bucket_sizes=(2,),
+                                           max_linger_s=99.0))
+    gw.open_session("a")
+    gw.open_session("b")
+    gw.submit("a", np.zeros(cfg.n_features, np.float32))
+    gw.submit("b", np.zeros(cfg.n_features, np.float32))
+    gw.close_session("a")  # frees the slot while a's tick is queued
+    res = gw.drain()
+    assert [r.session_id for r in res] == ["b"]
+    assert gw.metrics.counters["stale_dropped"] == 1
+    with pytest.raises(KeyError):
+        gw.submit("a", np.zeros(cfg.n_features, np.float32))
+
+
+def test_submit_copies_caller_row_buffer():
+    """A queued tick must not alias the caller's buffer: callers (e.g.
+    the load generator's random walk) mutate their row arrays in place
+    between submit and flush."""
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=1, window=4)
+    gw = FleetGateway(
+        pool, batcher_config=BatcherConfig(bucket_sizes=(1,),
+                                           max_linger_s=99.0))
+    gw.open_session("a")
+    solo = StreamingBiGRU(
+        cfg, params,
+        NormParams(np.zeros(cfg.n_features, np.float32),
+                   np.ones(cfg.n_features, np.float32)),
+        window=4)
+    row = np.random.default_rng(0).normal(
+        size=cfg.n_features).astype(np.float32)
+    want = solo.step(row)[0]
+    gw.submit("a", row)
+    row[:] = 1e6  # caller reuses its buffer while the tick is queued
+    res = gw.drain()
+    np.testing.assert_array_equal(res[0].probabilities, want)
+
+
+def test_submit_rejects_malformed_row_at_the_submitter():
+    """A wrong-shape row must fail at submit(), not blow up a later
+    flush and take the batch's other sessions' ticks with it."""
+    cfg, params = _setup()  # 6 features
+    pool = SessionPool(cfg, params, capacity=2, window=4)
+    gw = FleetGateway(
+        pool, batcher_config=BatcherConfig(bucket_sizes=(2,),
+                                           max_linger_s=99.0))
+    gw.open_session("good")
+    gw.open_session("bad")
+    gw.submit("good", np.zeros(cfg.n_features, np.float32))
+    with pytest.raises(ValueError, match="row shape"):
+        gw.submit("bad", np.zeros(cfg.n_features + 2, np.float32))
+    res = gw.drain()  # the valid tick is unaffected
+    assert [r.session_id for r in res] == ["good"]
+
+
+def test_gateway_rejects_bus_without_fleet_topic():
+    """A pre-PR-1 config with an explicit topic list must fail at
+    construction, not with a mid-flush KeyError after state advanced."""
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=1, window=4)
+    legacy_bus = InProcessBus(("prediction",))
+    with pytest.raises(ValueError, match="fleet_prediction"):
+        FleetGateway(pool, legacy_bus)
+
+
+def test_admission_rejection_is_counted():
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=1, window=4)
+    gw = FleetGateway(pool)
+    gw.open_session("a")
+    with pytest.raises(PoolExhausted):
+        gw.open_session("b")
+    assert gw.metrics.counters["rejected_sessions"] == 1
+    assert gw.metrics.gauges["active_sessions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# numerics: multiplexed == solo
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_multiplexed_bucket1_bit_identical_to_solo(cell):
+    """The multiplexing machinery itself — slot gather/scatter, per-slot
+    ring positions, generation bookkeeping, interleaving with OTHER
+    sessions' flushes — adds exactly zero numerical change: at bucket
+    size 1 every multiplexed output is bit-identical to a solo
+    StreamingBiGRU run of the same tick stream."""
+    feats, window, n = 6, 4, 3
+    cfg, params = _setup(feats=feats, cell=cell)
+    pool = SessionPool(cfg, params, capacity=n, window=window)
+    gw = FleetGateway(
+        pool, batcher_config=BatcherConfig(bucket_sizes=(1,),
+                                           max_linger_s=0.0))
+    norms = _norms(n, feats)
+    solos = [StreamingBiGRU(cfg, params, norms[i], window=window)
+             for i in range(n)]
+    for i in range(n):
+        gw.open_session(f"T{i}", norms[i])
+    rng = np.random.default_rng(4)
+    rows = rng.normal(size=(6, n, feats)).astype(np.float32)
+    for k in range(6):
+        for i in range(n):
+            gw.submit(f"T{i}", rows[k, i])
+        res = gw.drain()  # n single-lane flushes, interleaved sessions
+        assert len(res) == n
+        by_sid = {r.session_id: r.probabilities for r in res}
+        for i in range(n):
+            np.testing.assert_array_equal(
+                by_sid[f"T{i}"], solos[i].step(rows[k, i])[0])
+    assert pool.compile_count == 1
+
+
+def test_multiplexed_batched_matches_solo_within_ulp():
+    """Batched buckets with ragged per-session duty cycles: every served
+    tick matches the solo carrier to float32 ulp noise (1e-6 — the same
+    tolerance the seed's lockstep-batched test uses; XLA's B>1 matmul
+    reduction order differs from B=1 at the last bit)."""
+    feats, window, n = 6, 4, 5
+    cfg, params = _setup(feats=feats)
+    pool = SessionPool(cfg, params, capacity=n, window=window)
+    gw = FleetGateway(
+        pool, batcher_config=BatcherConfig(bucket_sizes=(2, 8),
+                                           max_linger_s=0.0))
+    norms = _norms(n, feats, seed=5)
+    solos = [StreamingBiGRU(cfg, params, norms[i], window=window)
+             for i in range(n)]
+    for i in range(n):
+        gw.open_session(f"T{i}", norms[i])
+    rng = np.random.default_rng(6)
+    for _ in range(10):
+        ticking = np.flatnonzero(rng.random(n) < 0.7)
+        rows = rng.normal(size=(n, feats)).astype(np.float32)
+        for i in ticking:
+            gw.submit(f"T{i}", rows[i])
+        res = gw.drain()
+        assert len(res) == len(ticking)
+        by_sid = {r.session_id: r.probabilities for r in res}
+        for i in ticking:
+            np.testing.assert_allclose(
+                by_sid[f"T{i}"], solos[i].step(rows[i])[0], atol=1e-6)
+    assert pool.compile_count <= 2
+
+
+def test_64_sessions_through_one_compiled_step():
+    """The acceptance headline: >= 64 concurrent sessions, every round
+    served by ONE fused batched step (single bucket, compile_count 1)."""
+    n, feats, window = 64, 4, 3
+    cfg, params = _setup(feats=feats, hidden=4, window=window)
+    pool = SessionPool(cfg, params, capacity=n, window=window)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    gw = FleetGateway(
+        pool, bus, batcher_config=BatcherConfig(bucket_sizes=(64,),
+                                                max_linger_s=0.0))
+    for i in range(n):
+        gw.open_session(f"T{i:03d}")
+    rng = np.random.default_rng(7)
+    rounds = 3
+    for _ in range(rounds):
+        rows = rng.normal(size=(n, feats)).astype(np.float32)
+        for i in range(n):
+            gw.submit(f"T{i:03d}", rows[i])
+        assert len(gw.pump()) == n  # batch-full -> one flush serves all
+    assert pool.compile_count == 1
+    assert gw.metrics.counters["flushes"] == rounds
+    assert gw.metrics.counters["ticks_served"] == n * rounds
+    # per-session results ride the shared bus topic, keyed by session
+    msgs = bus.consumer(TOPIC_FLEET_PREDICTION).poll()
+    assert len(msgs) == n * rounds
+    per_session = {}
+    for m in msgs:
+        per_session.setdefault(m.value["session"], []).append(m.value["seq"])
+    assert len(per_session) == n
+    assert all(seqs == [0, 1, 2] for seqs in per_session.values())
+
+
+# ---------------------------------------------------------------------------
+# load generator + metrics + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_run_fleet_load_end_to_end():
+    cfg, params = _setup(feats=5, hidden=4, window=3)
+    pool = SessionPool(cfg, params, capacity=16, window=3)
+    gw = FleetGateway(
+        pool, batcher_config=BatcherConfig(bucket_sizes=(16,),
+                                           max_linger_s=0.0))
+    out = run_fleet_load(
+        gw, FleetLoadConfig(n_sessions=16, n_ticks=5, duty=0.8, seed=0))
+    assert out["ticks_served"] == out["ticks_submitted"] > 0
+    assert out["compile_count"] == 1
+    assert out["latency"]["total"]["count"] == out["ticks_served"]
+    assert set(out["latency"]) >= {"enqueue_to_dispatch", "device", "total"}
+    assert out["ticks_per_s"] > 0
+
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for ms in (1, 1, 1, 1, 1, 1, 1, 1, 1, 100):  # p50 ~1ms, p99+ ~100ms
+        h.observe(ms / 1e3)
+    s = h.summary()
+    assert s["count"] == 10
+    assert 0.8 <= s["p50_ms"] <= 1.3  # bin-edge accuracy: ~1 bin width
+    assert 80 <= s["max_ms"] <= 101 and 80 <= s["p99_ms"] <= 130
+    assert h.percentile(50) <= h.percentile(99)
+
+
+def test_serve_fleet_cli(capsys):
+    from fmda_tpu.cli import main
+
+    assert main(["serve-fleet", "--sessions", "8", "--ticks", "4",
+                 "--hidden", "4", "--window", "3",
+                 "--bucket-sizes", "8", "--seed", "0"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["sessions"] == 8
+    assert out["ticks_served"] == out["ticks_submitted"] == 32
+    assert out["compile_count"] == 1
+    assert out["counters"]["ticks_served"] == 32
