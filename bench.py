@@ -1191,6 +1191,71 @@ def phase_runtime_fleet() -> dict:
     }
 
 
+def phase_obs_overhead() -> dict:
+    """Observability-plane cost on the engine.step hot loop: the same
+    synthetic replay driven twice per repetition — once with the obs
+    registry fully wired (per-step histogram, bus publish/consume
+    counters, warehouse write timing, scrape-time collectors
+    registered), once bare — interleaved, min-of-reps, overhead as a
+    percentage.  The plane's contract is <2% (docs/observability.md);
+    ``ok`` asserts it."""
+    import time as _time
+
+    from fmda_tpu.config import DEFAULT_TOPICS, FeatureConfig
+    from fmda_tpu.data.synthetic import (
+        SyntheticMarketConfig, synthetic_session_messages)
+    from fmda_tpu.obs import MetricsRegistry, engine_families
+    from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
+    from fmda_tpu.stream.warehouse import WarehouseConfig
+
+    fc = FeatureConfig()
+    n_days, reps = 80, 3
+    msgs = list(synthetic_session_messages(
+        fc, SyntheticMarketConfig(seed=5, n_days=n_days)))
+    # many small steps (not one bulk step): the per-step instrumentation
+    # is what this phase prices
+    chunk = max(1, len(msgs) // 400)
+
+    def run_once(instrumented: bool) -> float:
+        bus = InProcessBus(DEFAULT_TOPICS, capacity=1 << 18)
+        wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+        reg = MetricsRegistry() if instrumented else None
+        eng = StreamEngine(bus, wh, fc, metrics=reg)
+        if reg is not None:
+            reg.register_collector(
+                "engine", lambda eng=eng: engine_families(eng))
+            bus.bind_metrics(reg)
+            wh.bind_metrics(reg)
+        t0 = _time.monotonic()
+        for i in range(0, len(msgs), chunk):
+            for topic, m in msgs[i:i + chunk]:
+                bus.publish(topic, m)
+            eng.step()
+        elapsed = _time.monotonic() - t0
+        if reg is not None:
+            # a scrape mid-load must not distort the loop measurably
+            reg.snapshot()
+        return elapsed
+
+    run_once(False)  # warm caches (sqlite pages, numpy, parser paths)
+    bare, wired = [], []
+    for _ in range(reps):
+        bare.append(run_once(False))
+        wired.append(run_once(True))
+    base, inst = min(bare), min(wired)
+    overhead_pct = (inst - base) / base * 100.0
+    return {
+        "n_messages": len(msgs),
+        "steps": (len(msgs) + chunk - 1) // chunk,
+        "reps": reps,
+        "bare_wall_s": round(base, 3),
+        "instrumented_wall_s": round(inst, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "budget_pct": 2.0,
+        "ok": overhead_pct < 2.0,
+    }
+
+
 _PHASES = {
     "flagship_pallas": lambda: phase_flagship(use_pallas=True),
     "flagship_scan": lambda: phase_flagship(use_pallas=False),
@@ -1211,6 +1276,7 @@ _PHASES = {
     "replay": phase_replay,
     "longctx_sp": phase_longctx_sp,
     "runtime_fleet_smoke": phase_runtime_fleet,
+    "obs_overhead": phase_obs_overhead,
 }
 
 
@@ -1637,6 +1703,7 @@ def main() -> None:
         ("multiticker", 420.0),
         ("serving", 300.0),
         ("runtime_fleet_smoke", 240.0),
+        ("obs_overhead", 300.0),
         ("flagship_bf16", 300.0),
         ("flagship_wide", 300.0),
         ("train_e2e", 600.0),

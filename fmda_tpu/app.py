@@ -54,7 +54,14 @@ class Application:
         warehouse: Optional[Warehouse] = None,
         engine_checkpoint: Optional[str] = None,
     ) -> None:
+        from fmda_tpu.obs import Observability
+
         self.config = config or FrameworkConfig()
+        #: The app's observability plane (fmda_tpu.obs): metrics registry,
+        #: event log, health checks, optional scrape endpoint.  Feeds
+        #: :attr:`stats` / :attr:`stage_timings` and docs/observability.md.
+        self.observability = Observability(self.config.observability)
+        reg = self.observability.registry
         self.bus = bus if bus is not None else default_bus(self.config)
         self.warehouse = (
             warehouse
@@ -72,9 +79,14 @@ class Application:
             ),
             checkpoint_every=ec.checkpoint_every,
             join_backend=ec.join_backend,
+            metrics=reg if reg.enabled else None,
         )
         self.session = None
         self.predictors: List = []
+        self.fleet = None
+        self.observability.track_app(self)
+        if self.config.observability.endpoint_enabled:
+            self.observability.start_server()
 
     # -- L1: acquisition ------------------------------------------------------
 
@@ -137,6 +149,7 @@ class Application:
         gateway_kwargs.setdefault(
             "threshold", self.config.train.prob_threshold)
         self.fleet = FleetGateway(pool, self.bus, **gateway_kwargs)
+        self.observability.track_fleet(self.fleet)
         return self.fleet
 
     # -- the loop -------------------------------------------------------------
@@ -150,6 +163,7 @@ class Application:
         served = 0
         for predictor in self.predictors:
             served += len(predictor.poll())
+        self.observability.tick()
         return {"emitted": emitted, "served": served}
 
     def run_ticks(self, n: int) -> Dict[str, int]:
@@ -207,8 +221,12 @@ class Application:
                 self.run_tick()
                 failures = 0
                 sleep_fn(interval_s)
-            except Exception:
+            except Exception as e:
                 failures += 1
+                self.observability.events.emit(
+                    "app.tick_error", error=repr(e)[:500],
+                    consecutive=failures,
+                )
                 log.exception(
                     "tick failed (%d consecutive); %s",
                     failures,
@@ -218,12 +236,33 @@ class Application:
                     raise
                 sleep_fn(min(interval_s * (2**failures), 60.0))
 
+    def close(self) -> None:
+        """Release the observability plane (scrape endpoint thread, the
+        events JSONL file handle).  The bus/warehouse are left to their
+        owners — they may be injected and shared; ``warehouse.close()``
+        is explicit for the common single-owner case."""
+        self.observability.close()
+
     @property
-    def stats(self) -> Dict[str, int]:
-        return {**self.engine.stats, "warehouse_rows": len(self.warehouse)}
+    def stats(self) -> Dict[str, object]:
+        """Engine + warehouse counters, plus the attached fleet's runtime
+        metrics when one exists (counters/gauges/latency summaries were
+        previously reachable only through the gateway object itself)."""
+        s: Dict[str, object] = {
+            **self.engine.stats, "warehouse_rows": len(self.warehouse)
+        }
+        if self.fleet is not None:
+            s["fleet"] = self.fleet.metrics.summary()
+        return s
 
     @property
     def stage_timings(self) -> Dict[str, Dict[str, float]]:
-        """Host-side wall clock per engine stage (ingest/join/land/signal)
-        — the observability the reference never had (SURVEY.md §5)."""
-        return self.engine.timer.summary()
+        """Host-side wall clock per pipeline stage — the engine's
+        ingest/join/land/signal stages, plus the fleet gateway's
+        device/publish stages (prefixed ``fleet.``) when one is attached
+        (SURVEY.md §5: the observability the reference never had)."""
+        timings = dict(self.engine.timer.summary())
+        if self.fleet is not None:
+            for name, stats in self.fleet.metrics.timer.summary().items():
+                timings[f"fleet.{name}"] = stats
+        return timings

@@ -10,7 +10,10 @@ operations are subcommands over one file-backed warehouse:
 - ``ingest``    replay or live-feed a session into a warehouse file;
 - ``train``     chunked training over a warehouse file → Orbax checkpoint;
 - ``backtest``  serving-equivalent scoring + signal-quality table;
-- ``serve``     the prediction daemon (push-triggered, no sleep-15).
+- ``serve``     the prediction daemon (push-triggered, no sleep-15);
+- ``status``    pretty-print an observability snapshot (metrics registry
+                + health checks), either from a locally built app or
+                scraped from a running ``/snapshot`` endpoint.
 
 Every command is a thin composition of the public library API — anything
 the CLI does is one import away in a notebook.
@@ -387,12 +390,108 @@ def cmd_serve_fleet(args) -> int:
         jnp.zeros((1, cfg.runtime.window, model_cfg.n_features)))["params"]
 
     gateway = app.attach_fleet(model_cfg, params)
+    if args.metrics_port is not None:
+        server = app.observability.start_server(port=args.metrics_port)
+        print(f"metrics endpoint: {server.url}/metrics "
+              f"(healthz, snapshot, events)", file=sys.stderr)
     out = run_fleet_load(gateway, FleetLoadConfig(
         n_sessions=args.sessions,
         n_ticks=args.ticks, duty=args.duty, seed=args.seed))
     out["backend"] = jax.default_backend()
     print(json.dumps(out, indent=2))
+    if args.metrics_port is not None and args.metrics_hold_s > 0:
+        # keep the endpoint scrapeable after the load (curl/promtool
+        # demos; the load itself is finite)
+        import time
+
+        print(f"holding metrics endpoint for {args.metrics_hold_s:.0f}s",
+              file=sys.stderr)
+        time.sleep(args.metrics_hold_s)
     return 0
+
+
+def _print_status(snapshot: dict, health: dict) -> None:
+    """Human-readable registry snapshot + health verdict."""
+
+    def key(s):
+        labels = ",".join(f"{k}={v}" for k, v in
+                          sorted(s.get("labels", {}).items()))
+        return f"{s['name']}{{{labels}}}" if labels else s["name"]
+
+    print(f"status: {health['status']}")
+    for name, check in sorted(health.get("checks", {}).items()):
+        mark = "ok  " if check["ok"] else "FAIL"
+        print(f"  {mark} {name:<14} {check['detail']}")
+    for kind in ("counters", "gauges"):
+        samples = sorted(snapshot.get(kind, []), key=key)
+        if samples:
+            print(f"{kind}:")
+            for s in samples:
+                v = s["value"]
+                v = int(v) if float(v) == int(v) else round(float(v), 6)
+                print(f"  {key(s):<52} {v}")
+    hists = sorted(snapshot.get("histograms", []), key=key)
+    if hists:
+        print("latency:")
+        print(f"  {'series':<52} {'count':>8} {'p50_ms':>9} "
+              f"{'p99_ms':>9} {'mean_ms':>9}")
+        for s in hists:
+            n = s["count"]
+            mean_ms = (s["sum_s"] / n * 1e3) if n else 0.0
+            print(f"  {key(s):<52} {n:>8} {s['p50_s'] * 1e3:>9.3f} "
+                  f"{s['p99_s'] * 1e3:>9.3f} {mean_ms:>9.3f}")
+
+
+def cmd_status(args) -> int:
+    """Observability snapshot: local (build the app, sample its registry)
+    or remote (GET /snapshot + /healthz off a running endpoint)."""
+    if args.endpoint:
+        import urllib.error
+        import urllib.request
+
+        base = (args.endpoint if "://" in args.endpoint
+                else f"http://{args.endpoint}").rstrip("/")
+        try:
+            with urllib.request.urlopen(base + "/snapshot", timeout=10) as r:
+                snapshot = json.loads(r.read())
+            try:
+                with urllib.request.urlopen(
+                        base + "/healthz", timeout=10) as r:
+                    health = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                # 503 = degraded; the body still carries the check detail
+                health = json.loads(e.read())
+        except (urllib.error.URLError, OSError,
+                json.JSONDecodeError) as e:
+            # a down daemon is the most common reason to run this probe
+            # — report it cleanly, don't traceback
+            print(f"cannot scrape {base}: {e}", file=sys.stderr)
+            return 2
+    else:
+        import dataclasses
+
+        from fmda_tpu.app import Application
+
+        cfg = _config(args)
+        if args.warehouse:
+            cfg = dataclasses.replace(
+                cfg,
+                warehouse=dataclasses.replace(
+                    cfg.warehouse, path=args.warehouse),
+            )
+        # never bind the scrape port here: a config with
+        # endpoint_enabled=true belongs to the daemon this command is
+        # most likely being run to inspect (use --endpoint for that)
+        cfg = dataclasses.replace(
+            cfg,
+            observability=dataclasses.replace(
+                cfg.observability, endpoint_enabled=False),
+        )
+        app = Application(cfg)
+        snapshot = app.observability.snapshot()
+        health = app.observability.health()
+    _print_status(snapshot, health)
+    return 0 if health.get("status") == "ok" else 1
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -499,7 +598,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-bound", type=int, default=None,
                    help="override config runtime.queue_bound")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve /metrics + /healthz + /snapshot on this "
+                        "port during the run (0 = ephemeral)")
+    p.add_argument("--metrics-hold-s", type=float, default=0.0,
+                   help="keep the metrics endpoint up this long after "
+                        "the load finishes (curl/promtool demos)")
     p.set_defaults(fn=cmd_serve_fleet)
+
+    p = sub.add_parser(
+        "status", parents=[common],
+        help="pretty-print an observability snapshot + health verdict")
+    p.add_argument("--endpoint", default=None, metavar="HOST:PORT",
+                   help="scrape a running endpoint's /snapshot + /healthz "
+                        "instead of building a local app")
+    p.add_argument("--warehouse", default=None,
+                   help="warehouse file for the local snapshot (default: "
+                        "config's path)")
+    p.set_defaults(fn=cmd_status)
     return parser
 
 

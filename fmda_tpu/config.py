@@ -460,6 +460,39 @@ class RuntimeConfig:
 
 
 @dataclass(frozen=True)
+class ObservabilityConfig:
+    """Observability-plane knobs (fmda_tpu.obs; docs/observability.md).
+
+    Net-new vs the reference (its only "telemetry" is print statements):
+    one process-wide metrics registry + JSONL event ring, with an
+    optional Prometheus scrape endpoint.
+    """
+
+    #: Switch for the app's plane: False hands out no-op instruments to
+    #: the engine/bus/warehouse, registers no collectors, and starts no
+    #: endpoint — those hot paths keep only one attribute call.
+    #: Module-level instrumentation with no Application handle (ingest
+    #: transports, trainer step timings) reports to the process-default
+    #: registry regardless; its cost is one lock-guarded update per
+    #: event, measured inside the noise floor (bench obs_overhead).
+    enabled: bool = True
+    #: Serve ``/metrics``+``/healthz``+``/snapshot`` over HTTP.  Off by
+    #: default so tests and one-shot CLI runs never bind a port; daemons
+    #: opt in (or pass ``serve-fleet --metrics-port``).
+    endpoint_enabled: bool = False
+    host: str = "127.0.0.1"
+    #: 0 = ephemeral (the bound port is logged and on the handle).
+    port: int = 9100
+    #: Bounded event-ring capacity (oldest events fall off).
+    events_capacity: int = 2048
+    #: Mirror events to this JSONL file; None = ring only.
+    events_path: Optional[str] = None
+    #: ``/healthz`` turns degraded when the newest completed app tick is
+    #: older than this (startup grace: healthy until the first tick).
+    max_tick_age_s: float = 900.0
+
+
+@dataclass(frozen=True)
 class SessionConfig:
     """Ingestion-session driver knobs (ref: producer.py:257-263)."""
 
@@ -485,6 +518,8 @@ class FrameworkConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     session: SessionConfig = field(default_factory=SessionConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    observability: ObservabilityConfig = field(
+        default_factory=ObservabilityConfig)
 
     def __post_init__(self) -> None:
         if self.model.n_features is None:
@@ -514,6 +549,7 @@ _SECTIONS = {
     "mesh": MeshConfig,
     "session": SessionConfig,
     "runtime": RuntimeConfig,
+    "observability": ObservabilityConfig,
 }
 
 
@@ -562,6 +598,7 @@ def save_config(cfg: FrameworkConfig, path: str) -> str:
 
     with open(path, "w") as fh:
         json.dump(config_to_dict(cfg), fh, indent=2)
+        fh.write("\n")
     return path
 
 

@@ -15,9 +15,24 @@ import logging
 import os
 import re
 import threading
+import time as _time
 from typing import Dict, List, Optional, Protocol
 
+from fmda_tpu.obs.registry import default_registry
+
 log = logging.getLogger("fmda_tpu.ingest")
+
+#: The ingest-layer metric vocabulary, in one place so the scrape
+#: surface can pre-declare every series at zero (Observability.track_app
+#: iterates these; a transport adding a metric must add its name here).
+INGEST_COUNTER_NAMES = (
+    "ingest_requests_total",
+    "ingest_request_failures_total",
+    "ingest_retries_total",
+    "ingest_ratelimit_waits_total",
+    "ingest_ratelimit_wait_seconds_total",
+)
+INGEST_HISTOGRAM_NAMES = ("ingest_request_seconds",)
 
 
 class TransportError(Exception):
@@ -31,11 +46,26 @@ class Transport(Protocol):
 
 
 class UrllibTransport:
-    """Live stdlib transport (no third-party HTTP dependency)."""
+    """Live stdlib transport (no third-party HTTP dependency).
 
-    def __init__(self, timeout_s: float = 20.0, user_agent: str = "fmda-tpu/0.1"):
+    Every request reports through the observability plane: request
+    latency histogram + request/failure counters (``metrics`` overrides
+    the process-default registry — tests isolate with their own).
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 20.0,
+        user_agent: str = "fmda-tpu/0.1",
+        *,
+        metrics=None,
+    ):
         self.timeout_s = timeout_s
         self.user_agent = user_agent
+        reg = metrics if metrics is not None else default_registry()
+        self._m_requests = reg.counter("ingest_requests_total")
+        self._m_failures = reg.counter("ingest_request_failures_total")
+        self._m_latency = reg.histogram("ingest_request_seconds")
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         import urllib.error
@@ -45,11 +75,22 @@ class UrllibTransport:
         if headers:
             req_headers.update(headers)
         request = urllib.request.Request(url, headers=req_headers)
+        self._m_requests.inc()
+        t0 = _time.perf_counter()
         try:
             with urllib.request.urlopen(request, timeout=self.timeout_s) as resp:
                 return resp.read()
         except urllib.error.URLError as e:  # pragma: no cover - live only
+            self._m_failures.inc()
             raise TransportError(f"GET {url} failed: {e}") from e
+        except Exception:  # pragma: no cover - live only (e.g. a body
+            # read dying mid-stream raises IncompleteRead, not URLError;
+            # count it so failure-rate dashboards see the outage, but
+            # keep the exception itself untranslated as before)
+            self._m_failures.inc()
+            raise
+        finally:
+            self._m_latency.observe(_time.perf_counter() - t0)
 
 
 class ReplayTransport:
@@ -148,6 +189,8 @@ class RetryTransport:
         attempts: int = 3,
         backoff_s: float = 1.0,
         sleep_fn=None,
+        *,
+        metrics=None,
     ) -> None:
         import time
 
@@ -155,6 +198,8 @@ class RetryTransport:
         self.attempts = attempts
         self.backoff_s = backoff_s
         self.sleep_fn = sleep_fn or time.sleep
+        reg = metrics if metrics is not None else default_registry()
+        self._m_retries = reg.counter("ingest_retries_total")
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         last: Optional[Exception] = None
@@ -169,6 +214,7 @@ class RetryTransport:
                         "GET %s failed (attempt %d/%d): %s; retrying in %.1fs",
                         url, attempt + 1, self.attempts, e, delay,
                     )
+                    self._m_retries.inc()
                     self.sleep_fn(delay)
         raise TransportError(
             f"GET {url} failed after {self.attempts} attempts"
@@ -223,6 +269,7 @@ class RateLimitTransport:
         clock=None,
         sleep_fn=None,
         shared: Optional[bool] = None,
+        metrics=None,
     ) -> None:
         import time
 
@@ -232,6 +279,9 @@ class RateLimitTransport:
             shared = clock is None
         self.clock = clock or time.monotonic
         self.sleep_fn = sleep_fn or time.sleep
+        reg = metrics if metrics is not None else default_registry()
+        self._m_waits = reg.counter("ingest_ratelimit_waits_total")
+        self._m_wait_s = reg.counter("ingest_ratelimit_wait_seconds_total")
         if shared:
             self._last = _SHARED_LAST
             self._lock = _SHARED_LAST_LOCK
@@ -264,6 +314,8 @@ class RateLimitTransport:
                 if wait <= 0:
                     self._last[host] = now
                     break
+            self._m_waits.inc()
+            self._m_wait_s.inc(wait)
             self.sleep_fn(wait)
         else:
             with self._lock:

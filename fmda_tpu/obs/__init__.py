@@ -1,0 +1,52 @@
+"""fmda_tpu.obs — the unified observability plane.
+
+One metrics vocabulary and one export surface for the whole pipeline
+(ROADMAP: the latency-SLO gate needs per-stage telemetry an operator can
+scrape).  The pieces:
+
+- :mod:`~fmda_tpu.obs.registry`     — :class:`MetricsRegistry` (counters,
+  gauges, :class:`LatencyHistogram` with ``snapshot()``/``merge()``),
+  scrape-time collectors, a process-default registry for module-level
+  instrumentation;
+- :mod:`~fmda_tpu.obs.prometheus`   — text-exposition renderer;
+- :mod:`~fmda_tpu.obs.events`       — bounded JSONL event ring;
+- :mod:`~fmda_tpu.obs.server`       — stdlib HTTP thread serving
+  ``/metrics``, ``/healthz``, ``/snapshot``, ``/events``;
+- :mod:`~fmda_tpu.obs.observability` — the :class:`Observability` handle
+  an :class:`~fmda_tpu.app.Application` owns (collectors + health checks
+  + endpoint lifecycle).
+
+Architecture and metric vocabulary: docs/observability.md.
+"""
+
+from fmda_tpu.obs.events import EventLog
+from fmda_tpu.obs.observability import (
+    Observability,
+    engine_families,
+    runtime_families,
+    stage_timer_families,
+)
+from fmda_tpu.obs.prometheus import render_prometheus
+from fmda_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    LatencyHistogram,
+    MetricsRegistry,
+    default_registry,
+)
+from fmda_tpu.obs.server import MetricsServer
+
+__all__ = [
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "LatencyHistogram",
+    "MetricsRegistry",
+    "MetricsServer",
+    "Observability",
+    "default_registry",
+    "engine_families",
+    "render_prometheus",
+    "runtime_families",
+    "stage_timer_families",
+]

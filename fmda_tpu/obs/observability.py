@@ -1,0 +1,294 @@
+"""The application-facing observability handle.
+
+One :class:`Observability` object per :class:`~fmda_tpu.app.Application`
+owns the app's :class:`~fmda_tpu.obs.registry.MetricsRegistry`, its
+:class:`~fmda_tpu.obs.events.EventLog`, the optional scrape endpoint
+(:class:`~fmda_tpu.obs.server.MetricsServer`), and the health checks the
+endpoint's ``/healthz`` answers from:
+
+- ``bus``          — the bus answers (topics reachable);
+- ``warehouse``    — the warehouse accepts work (probe query commits);
+- ``last_tick``    — wall-clock age of the newest completed app tick is
+  under ``max_tick_age_s`` (startup grace: healthy until the first tick);
+- ``fleet_queue``  — the attached fleet gateway (if any) is not
+  saturated (its next submit would shed).
+
+``track_app``/``track_fleet`` register scrape-time collectors that
+translate the engine's counters/lag/watermark stats, the engine
+:class:`~fmda_tpu.utils.tracing.StageTimer`, and the fleet's
+:class:`~fmda_tpu.runtime.metrics.RuntimeMetrics` into registry samples
+— zero hot-loop cost, sampled only when someone looks.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from fmda_tpu.obs.events import EventLog
+from fmda_tpu.obs.registry import (
+    MetricsRegistry,
+    Sample,
+    Snapshot,
+    default_registry,
+)
+
+#: A health check: () -> (ok, detail).  Exceptions count as failures.
+HealthCheck = Callable[[], Tuple[bool, object]]
+
+
+def stage_timer_families(prefix: str, timer) -> Snapshot:
+    """:class:`StageTimer` summary -> registry samples
+    (``<prefix>_seconds_total{stage=...}`` + ``<prefix>_count{stage=...}``)."""
+    counters = []
+    for stage, s in timer.summary().items():
+        counters.append({
+            "name": f"{prefix}_seconds_total",
+            "labels": {"stage": stage},
+            "value": s["total_s"],
+        })
+        counters.append({
+            "name": f"{prefix}_count",
+            "labels": {"stage": stage},
+            "value": s["count"],
+        })
+    return {"counters": counters}
+
+
+def runtime_families(metrics) -> Snapshot:
+    """:class:`RuntimeMetrics` -> registry samples under the ``runtime_``
+    prefix: per-stage latency summaries, every counter as a ``_total``,
+    every gauge verbatim, the host StageTimer as stage counters."""
+    histograms = []
+    for stage, h in metrics.histograms.items():
+        if not h.n:
+            continue
+        s: Sample = h.sample()
+        s["name"] = "runtime_latency_seconds"
+        s["labels"] = {"stage": stage}
+        histograms.append(s)
+    # dict() first: the gateway hot path inserts keys (count()/gauge()
+    # create on first touch) while this runs on the scrape thread, and a
+    # bare .items() iteration racing an insert raises RuntimeError.  The
+    # C-level copy is atomic under the GIL; the histograms dict is
+    # fixed-key from construction, so it needs no copy.
+    counters = [
+        {"name": f"runtime_{name}_total", "labels": {}, "value": value}
+        for name, value in dict(metrics.counters).items()
+    ]
+    gauges = [
+        {"name": f"runtime_{name}", "labels": {}, "value": value}
+        for name, value in dict(metrics.gauges).items()
+    ]
+    out = stage_timer_families("runtime_stage", metrics.timer)
+    out["counters"] = counters + out.get("counters", [])
+    out["gauges"] = gauges
+    out["histograms"] = histograms
+    return out
+
+
+def engine_families(engine) -> Snapshot:
+    """:class:`StreamEngine` stats + StageTimer -> registry samples."""
+    st = engine.stats
+    counters = [
+        {"name": "engine_emitted_total", "labels": {},
+         "value": st["emitted"]},
+        {"name": "engine_dropped_total", "labels": {},
+         "value": st["dropped"]},
+    ]
+    gauges = [
+        {"name": "engine_pending_joins", "labels": {},
+         "value": st["pending"]},
+    ]
+    for topic, lag in st["consumer_lag"].items():
+        gauges.append({
+            "name": "engine_consumer_lag",
+            "labels": {"topic": topic},
+            "value": lag,
+        })
+    for topic, age in st["watermark_age_s"].items():
+        if age is not None:
+            gauges.append({
+                "name": "engine_watermark_age_seconds",
+                "labels": {"stream": topic},
+                "value": age,
+            })
+    out = stage_timer_families("engine_stage", engine.timer)
+    out["counters"] = counters + out.get("counters", [])
+    out["gauges"] = gauges
+    return out
+
+
+class Observability:
+    """Registry + events + health + scrape endpoint for one application."""
+
+    def __init__(
+        self,
+        config=None,
+        *,
+        registry: Optional[MetricsRegistry] = None,
+        clock=time.monotonic,
+    ) -> None:
+        # deferred import: config imports nothing from obs, but keep the
+        # dependency one-way regardless
+        from fmda_tpu.config import ObservabilityConfig
+
+        self.config = config or ObservabilityConfig()
+        enabled = self.config.enabled
+        self.registry = (
+            registry if registry is not None
+            else MetricsRegistry(enabled=enabled)
+        )
+        if enabled:
+            # module-level instrumentation (ingest transports, trainer)
+            # reports to the process-default registry; fold it in so one
+            # scrape covers the whole process
+            self.registry.include(default_registry())
+        self.events = EventLog(
+            capacity=self.config.events_capacity,
+            path=self.config.events_path,
+        )
+        self.clock = clock
+        self.checks: Dict[str, HealthCheck] = {}
+        self.server = None
+        self._last_tick: Optional[float] = None
+
+    # -- wiring ---------------------------------------------------------------
+
+    def track_app(self, app) -> None:
+        """Register collectors + health checks for an Application's bus,
+        engine, and warehouse (called by the Application itself)."""
+        if not self.registry.enabled:
+            return
+        # pre-declare the module-level vocabulary (ingest transports,
+        # trainer) in the process-default registry: a scrape must show
+        # the full series set at zero, not grow names as code paths run
+        from fmda_tpu.ingest.transport import (
+            INGEST_COUNTER_NAMES,
+            INGEST_HISTOGRAM_NAMES,
+        )
+
+        dreg = default_registry()
+        for name in INGEST_COUNTER_NAMES:
+            dreg.counter(name)
+        for name in INGEST_HISTOGRAM_NAMES:
+            dreg.histogram(name)
+        engine, warehouse, bus = app.engine, app.warehouse, app.bus
+        self.registry.register_collector(
+            "engine", lambda: engine_families(engine))
+        self.registry.register_collector(
+            "warehouse",
+            lambda: {"gauges": [{
+                "name": "warehouse_rows",
+                "labels": {},
+                "value": len(warehouse),
+            }]},
+        )
+        bind = getattr(bus, "bind_metrics", None)
+        if bind is not None:  # NativeBus/KafkaBus have no host counters
+            bind(self.registry)
+        bind_wh = getattr(warehouse, "bind_metrics", None)
+        if bind_wh is not None:
+            bind_wh(self.registry)
+
+        def check_bus() -> Tuple[bool, object]:
+            topics = bus.topics()
+            return bool(topics), f"{len(topics)} topics"
+
+        def check_warehouse() -> Tuple[bool, object]:
+            healthy = getattr(warehouse, "healthy", None)
+            if healthy is not None:
+                return bool(healthy()), "probe write"
+            return True, "no probe (non-sqlite backend)"
+
+        self.checks["bus"] = check_bus
+        self.checks["warehouse"] = check_warehouse
+        self.checks["last_tick"] = self._check_last_tick
+
+    def track_fleet(self, gateway) -> None:
+        """Register the fleet gateway's RuntimeMetrics + saturation check
+        (called by ``Application.attach_fleet``; re-attaching replaces)."""
+        if not self.registry.enabled:
+            return
+        metrics = gateway.metrics
+        self.registry.register_collector(
+            "runtime", lambda: runtime_families(metrics))
+
+        def check_fleet() -> Tuple[bool, object]:
+            depth = len(gateway.batcher)
+            return (not gateway.saturated,
+                    f"queue depth {depth}/{gateway.queue_bound}")
+
+        self.checks["fleet_queue"] = check_fleet
+        self.events.emit(
+            "fleet.attached",
+            capacity=gateway.pool.capacity,
+            queue_bound=gateway.queue_bound,
+        )
+
+    # -- ticks / health -------------------------------------------------------
+
+    def tick(self) -> None:
+        """Stamp a completed application tick (drives ``last_tick``)."""
+        self._last_tick = self.clock()
+
+    def _check_last_tick(self) -> Tuple[bool, object]:
+        if self._last_tick is None:
+            return True, "no ticks yet"
+        age = self.clock() - self._last_tick
+        return (age <= self.config.max_tick_age_s,
+                f"age {age:.1f}s (max {self.config.max_tick_age_s:.0f}s)")
+
+    def health(self) -> dict:
+        """Run every check; ``status`` is ``"ok"`` iff all pass.  A check
+        raising counts as failed (a health probe must never take the
+        endpoint down with it)."""
+        checks = {}
+        ok = True
+        for name, fn in sorted(self.checks.items()):
+            try:
+                passed, detail = fn()
+            except Exception as e:  # noqa: BLE001 — failure IS the signal
+                passed, detail = False, f"check raised: {e!r}"
+            checks[name] = {"ok": bool(passed), "detail": str(detail)}
+            ok = ok and passed
+        return {"status": "ok" if ok else "degraded", "checks": checks}
+
+    # -- export ---------------------------------------------------------------
+
+    def snapshot(self) -> Snapshot:
+        return self.registry.snapshot()
+
+    def start_server(
+        self, *, host: Optional[str] = None, port: Optional[int] = None
+    ):
+        """Start (or return the already-running) scrape endpoint."""
+        import logging
+
+        from fmda_tpu.obs.server import MetricsServer
+
+        if self.server is not None:
+            requested = port if port is not None else self.config.port
+            if port is not None and requested != self.server.port:
+                logging.getLogger("fmda_tpu.obs").warning(
+                    "metrics endpoint already serving on %s; ignoring "
+                    "requested port %d", self.server.url, requested)
+            return self.server
+        self.server = MetricsServer(
+            self.registry,
+            host=host if host is not None else self.config.host,
+            port=port if port is not None else self.config.port,
+            health_fn=self.health,
+            events=self.events,
+        ).start()
+        self.events.emit("obs.server_started", url=self.server.url)
+        return self.server
+
+    def stop_server(self) -> None:
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+    def close(self) -> None:
+        self.stop_server()
+        self.events.close()

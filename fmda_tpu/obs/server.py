@@ -1,0 +1,136 @@
+"""Scrape endpoint: a stdlib ``http.server`` thread serving the plane.
+
+No third-party web framework — four fixed routes on a daemonised
+:class:`~http.server.ThreadingHTTPServer`:
+
+- ``/metrics``  — Prometheus text exposition of the registry snapshot;
+- ``/healthz``  — JSON health verdict; HTTP 200 when every check passes,
+  503 when any fails (the form load balancers and ``kubelet`` probes
+  expect);
+- ``/snapshot`` — the raw registry snapshot as JSON (what
+  ``python -m fmda_tpu status --endpoint`` consumes);
+- ``/events``   — the event ring as JSONL (newest last).
+
+Bind with ``port=0`` for an ephemeral port (tests); :attr:`port` reports
+the bound one.  Request logging goes to the ``fmda_tpu.obs`` logger at
+DEBUG, never to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from fmda_tpu.obs.events import EventLog
+from fmda_tpu.obs.prometheus import render_prometheus
+from fmda_tpu.obs.registry import MetricsRegistry
+
+log = logging.getLogger("fmda_tpu.obs")
+
+
+class MetricsServer:
+    """Background scrape server over a registry (+ health fn + events)."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        health_fn: Optional[Callable[[], dict]] = None,
+        events: Optional[EventLog] = None,
+    ) -> None:
+        self.registry = registry
+        self.health_fn = health_fn
+        self.events = events
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(
+                self, status: int, body: bytes, content_type: str
+            ) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = render_prometheus(
+                            server.registry.snapshot()).encode()
+                        self._send(
+                            200, body,
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif path == "/healthz":
+                        health = (
+                            server.health_fn()
+                            if server.health_fn is not None
+                            else {"status": "ok", "checks": {}}
+                        )
+                        status = 200 if health.get("status") == "ok" else 503
+                        self._send(
+                            status,
+                            json.dumps(health, indent=2).encode(),
+                            "application/json",
+                        )
+                    elif path == "/snapshot":
+                        self._send(
+                            200,
+                            json.dumps(server.registry.snapshot()).encode(),
+                            "application/json",
+                        )
+                    elif path == "/events" and server.events is not None:
+                        self._send(
+                            200, server.events.to_jsonl().encode(),
+                            "application/x-ndjson")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except Exception:  # noqa: BLE001 — a broken scrape must
+                    # never kill the serving thread
+                    log.exception("scrape handler failed for %s", self.path)
+                    try:
+                        self._send(500, b"internal error\n", "text/plain")
+                    except Exception:  # noqa: BLE001 — client went away
+                        pass
+
+            def log_message(self, fmt: str, *args) -> None:
+                log.debug("%s %s", self.address_string(), fmt % args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._httpd.server_address[0]
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="fmda-obs-server",
+            daemon=True,
+        )
+        self._thread.start()
+        log.info("observability endpoint serving on %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._httpd.shutdown()
+        self._thread.join(timeout=5.0)
+        self._httpd.server_close()
+        self._thread = None

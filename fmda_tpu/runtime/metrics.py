@@ -6,15 +6,24 @@ histograms are first-class state, not log lines.  Host-side stage wall
 clock rides the same :class:`~fmda_tpu.utils.tracing.StageTimer` the
 stream engine uses, so ``serve-fleet`` and ``engine.step`` report through
 one vocabulary.
+
+:class:`LatencyHistogram` itself lives in the process-wide observability
+plane (:mod:`fmda_tpu.obs.registry` — thread-safe, with
+``snapshot()``/``merge()`` for cross-thread aggregation) and is
+re-exported here; :func:`fmda_tpu.obs.runtime_families` translates a
+whole :class:`RuntimeMetrics` into registry samples, which is how the
+fleet shows up on a ``/metrics`` scrape.
 """
 
 from __future__ import annotations
 
-import math
 from collections import defaultdict
 from typing import Dict, Tuple
 
+from fmda_tpu.obs.registry import LatencyHistogram
 from fmda_tpu.utils.tracing import StageTimer
+
+__all__ = ["LatencyHistogram", "RuntimeMetrics", "STAGES"]
 
 #: The pipeline stages every tick moves through (gateway.submit →
 #: batcher flush → device step → bus publish).  Keys of
@@ -25,64 +34,6 @@ STAGES: Tuple[str, ...] = (
     "publish",              # per-flush bus publish fan-out
     "total",                # submit -> result published
 )
-
-
-class LatencyHistogram:
-    """Fixed log-spaced latency histogram (1 µs .. ~100 s).
-
-    O(1) observe, percentile estimates from bin edges — accurate to one
-    bin width (10 bins/decade), which is plenty for p50/p99 serving
-    dashboards and costs no per-observation allocation.
-    """
-
-    #: 10 bins per decade over 8 decades starting at 1 µs.
-    BINS_PER_DECADE = 10
-    N_BINS = 8 * BINS_PER_DECADE
-    _LO_EXP = -6  # 1e-6 s
-
-    def __init__(self) -> None:
-        self.counts = [0] * self.N_BINS
-        self.n = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
-
-    def _bin(self, seconds: float) -> int:
-        if seconds <= 1e-6:
-            return 0
-        b = int((math.log10(seconds) - self._LO_EXP) * self.BINS_PER_DECADE)
-        return min(max(b, 0), self.N_BINS - 1)
-
-    def observe(self, seconds: float) -> None:
-        self.counts[self._bin(seconds)] += 1
-        self.n += 1
-        self.total_s += seconds
-        if seconds > self.max_s:
-            self.max_s = seconds
-
-    def percentile(self, p: float) -> float:
-        """Upper edge of the bin holding the p-th percentile (seconds),
-        clamped to the true observed max (the top bin's edge can
-        otherwise overshoot it)."""
-        if self.n == 0:
-            return 0.0
-        target = p / 100.0 * self.n
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= target:
-                edge = 10.0 ** (
-                    self._LO_EXP + (i + 1) / self.BINS_PER_DECADE)
-                return min(edge, self.max_s)
-        return self.max_s
-
-    def summary(self) -> Dict[str, float]:
-        return {
-            "count": self.n,
-            "mean_ms": round(self.total_s / self.n * 1e3, 4) if self.n else 0.0,
-            "p50_ms": round(self.percentile(50) * 1e3, 4),
-            "p99_ms": round(self.percentile(99) * 1e3, 4),
-            "max_ms": round(self.max_s * 1e3, 4),
-        }
 
 
 class RuntimeMetrics:
@@ -98,7 +49,7 @@ class RuntimeMetrics:
 
     def __init__(self) -> None:
         self.histograms: Dict[str, LatencyHistogram] = {
-            s: LatencyHistogram() for s in STAGES
+            s: LatencyHistogram(s) for s in STAGES
         }
         self.counters: Dict[str, int] = defaultdict(int)
         self.gauges: Dict[str, float] = {}

@@ -46,6 +46,11 @@ class Consumer:
         records = self._bus.read(self.topic, self.offset, max_records)
         if records:
             self.offset = records[-1].offset + 1
+            # consume accounting on bound backends (fmda_tpu.obs); the
+            # getattr only runs when something was actually read
+            consumed = getattr(self._bus, "_consumed_cb", None)
+            if consumed is not None:
+                consumed(self.topic, len(records))
         return records
 
     def seek(self, offset: int) -> None:
@@ -91,6 +96,27 @@ class InProcessBus:
         self._logs: Dict[str, List[Record]] = {t: [] for t in topics}
         self._base: Dict[str, int] = {t: 0 for t in self._logs}
         self._next: Dict[str, int] = {t: 0 for t in self._logs}
+        #: per-topic publish counters + consume callback, populated by
+        #: :meth:`bind_metrics` (fmda_tpu.obs); None = uninstrumented
+        self._publish_counters = None
+        self._consumed_cb = None
+
+    def bind_metrics(self, registry) -> None:
+        """Report publish/consume totals per topic through a
+        :class:`~fmda_tpu.obs.registry.MetricsRegistry`.  Counters are
+        created once here, so the publish hot path pays one dict lookup
+        and one lock-guarded increment."""
+        self._publish_counters = {
+            t: registry.counter("bus_published_total", topic=t)
+            for t in self._logs
+        }
+        consume_counters = {
+            t: registry.counter("bus_consumed_total", topic=t)
+            for t in self._logs
+        }
+        self._consumed_cb = (
+            lambda topic, n: consume_counters[topic].inc(n)
+        )
 
     def _check_topic(self, topic: str) -> None:
         if topic not in self._logs:
@@ -112,7 +138,9 @@ class InProcessBus:
                 drop = len(log) - self._capacity
                 del log[:drop]
                 self._base[topic] += drop
-            return offset
+        if self._publish_counters is not None:
+            self._publish_counters[topic].inc()
+        return offset
 
     def read(
         self, topic: str, offset: int, max_records: Optional[int] = None

@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -254,6 +255,7 @@ class StreamEngine:
         from_end: bool = False,
         checkpoint_every: int = 1,
         join_backend: str = "python",
+        metrics=None,
     ) -> None:
         self.bus = bus
         self.warehouse = warehouse
@@ -345,6 +347,13 @@ class StreamEngine:
         #: per-stage wall-clock accounting (SURVEY.md §5: the reference has
         #: no tracing; here every step exposes ingest/join/land/signal time)
         self.timer = StageTimer()
+        #: optional fmda_tpu.obs registry: one end-to-end latency
+        #: histogram per step (the lag/watermark/StageTimer detail is
+        #: sampled scrape-time by obs.engine_families — zero cost here)
+        self._obs_step_hist = (
+            metrics.histogram("engine_step_seconds")
+            if metrics is not None else None
+        )
         if checkpoint_path and os.path.exists(checkpoint_path):
             self.restore()
 
@@ -409,6 +418,15 @@ class StreamEngine:
 
         Returns the number of rows emitted this step.
         """
+        if self._obs_step_hist is None:
+            return self._step()
+        t0 = _time.perf_counter()
+        try:
+            return self._step()
+        finally:
+            self._obs_step_hist.observe(_time.perf_counter() - t0)
+
+    def _step(self) -> int:
         fc = self.features
         with self.timer.stage("ingest"):
             polled_any = self._ingest()

@@ -91,6 +91,26 @@ class NativeBus:
             if tid < 0:
                 raise NativeBusUnavailable(f"rb_topic({name!r}) failed")
             self._topic_ids[name] = tid
+        #: host-side publish/consume accounting (fmda_tpu.obs), populated
+        #: by :meth:`bind_metrics`; the C++ log itself is uninstrumented
+        self._publish_counters = None
+        self._consumed_cb = None
+
+    def bind_metrics(self, registry) -> None:
+        """Same per-topic publish/consume counters as
+        :meth:`InProcessBus.bind_metrics` — counted in the Python wrapper,
+        so cross-process writers bypassing this handle are not seen."""
+        self._publish_counters = {
+            t: registry.counter("bus_published_total", topic=t)
+            for t in self._topic_ids
+        }
+        consume_counters = {
+            t: registry.counter("bus_consumed_total", topic=t)
+            for t in self._topic_ids
+        }
+        self._consumed_cb = (
+            lambda topic, n: consume_counters[topic].inc(n)
+        )
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
@@ -125,6 +145,8 @@ class NativeBus:
                 f"publish to {topic!r} failed (record {len(payload)}B too "
                 "large for the arena?)"
             )
+        if self._publish_counters is not None:
+            self._publish_counters[topic].inc()
         return offset
 
     def read(

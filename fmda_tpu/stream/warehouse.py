@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import sqlite3
 import threading
+import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -72,6 +73,31 @@ class Warehouse:
             c: np.empty(0, np.float64) for c in self.features.derived_columns()
         }
         self._targets = np.empty((0, len(TARGET_COLUMNS)), np.float64)
+        # fmda_tpu.obs instruments, populated by bind_metrics; None =
+        # uninstrumented (direct constructions pay nothing)
+        self._obs_write_hist = None
+        self._obs_query_hist = None
+        self._obs_rows_counter = None
+
+    def bind_metrics(self, registry) -> None:
+        """Report write/query latency + rows landed through a
+        :class:`~fmda_tpu.obs.registry.MetricsRegistry`."""
+        self._obs_write_hist = registry.histogram("warehouse_write_seconds")
+        self._obs_query_hist = registry.histogram("warehouse_query_seconds")
+        self._obs_rows_counter = registry.counter(
+            "warehouse_rows_written_total")
+
+    def healthy(self) -> bool:
+        """Probe that the store still accepts work: take (and release) a
+        write lock.  False the moment the connection is closed or the
+        file went read-only — the ``/healthz`` warehouse check."""
+        try:
+            with self._lock:
+                self._conn.execute("BEGIN IMMEDIATE")
+                self._conn.execute("ROLLBACK")
+            return True
+        except Exception:  # noqa: BLE001 — any failure IS the signal
+            return False
 
     # -- DDL (config -> schema codegen) -------------------------------------
 
@@ -114,12 +140,16 @@ class Warehouse:
                 [get("Timestamp")]
                 + [float(get(c) or 0.0) for c in cols]
             )
+        t0 = _time.perf_counter() if self._obs_write_hist is not None else 0.0
         with self._lock:
             self._conn.executemany(
                 f"INSERT INTO {self.table} ({col_list}) VALUES ({placeholders})",
                 values,
             )
             self._conn.commit()
+        if self._obs_write_hist is not None:
+            self._obs_write_hist.observe(_time.perf_counter() - t0)
+            self._obs_rows_counter.inc(len(values))
         return len(values)
 
     # -- raw reads -----------------------------------------------------------
@@ -341,6 +371,14 @@ class Warehouse:
     def fetch(self, ids: Sequence[int]) -> np.ndarray:
         """Feature rows (1-based positions) with NaN->0 (IFNULL parity,
         sql_pytorch_dataloader.py:219)."""
+        t0 = _time.perf_counter() if self._obs_query_hist is not None else 0.0
+        try:
+            return self._fetch(ids)
+        finally:
+            if self._obs_query_hist is not None:
+                self._obs_query_hist.observe(_time.perf_counter() - t0)
+
+    def _fetch(self, ids: Sequence[int]) -> np.ndarray:
         with self._lock:
             self._refresh_derived()
             idx = self._positions(ids)

@@ -269,8 +269,18 @@ class Trainer:
         rng: Optional[jax.Array],
         train: bool,
     ) -> Tuple[TrainState, EpochMetrics, np.ndarray]:
+        import time as _time
+
+        from fmda_tpu.obs.registry import default_registry
         from fmda_tpu.utils.tracing import step_annotation
 
+        phase = "train" if train else "eval"
+        # observability: host-side step dispatch wall clock (steps are
+        # async — this measures trace+dispatch, not device compute; the
+        # first step's compile dominates its bin, by design visible)
+        reg = default_registry()
+        step_hist = reg.histogram("train_step_seconds", phase=phase)
+        step_counter = reg.counter("train_steps_total", phase=phase)
         # Per-batch results are folded into running on-device accumulators
         # (async adds) — the host never blocks mid-pass and memory stays
         # O(1) instead of holding every batch's arrays live across an
@@ -281,12 +291,15 @@ class Trainer:
             for batch in batches:
                 # marks each step in a device profile when one is being
                 # captured (utils.tracing.device_trace); free otherwise
-                with step_annotation("train" if train else "eval", step_no):
+                t0 = _time.perf_counter()
+                with step_annotation(phase, step_no):
                     if train:
                         state, loss, metrics = self._train_step(
                             state, batch, rng)
                     else:
                         loss, metrics = self._eval_step(state.params, batch)
+                step_hist.observe(_time.perf_counter() - t0)
+                step_counter.inc()
                 step_no += 1
                 vals = (loss, metrics.accuracy, metrics.hamming,
                         metrics.fbeta, metrics.confusion)
@@ -369,7 +382,15 @@ class Trainer:
         if initial_state is not None:
             self._warn_if_norm_drifted(dataset)
         history: Dict[str, List[EpochMetrics]] = {"train": [], "val": []}
+        from fmda_tpu.obs.registry import default_registry
+
+        reg = default_registry()
+        epoch_hist = reg.histogram("train_epoch_seconds")
+        epoch_counter = reg.counter("train_epochs_total")
+        import time as _time
+
         for epoch in range(epochs if epochs is not None else tc.epochs):
+            t_epoch = _time.perf_counter()
             state, train_metrics, _ = self._run_chunks(
                 state, dataset, train_chunks, step_rng, train=True
             )
@@ -378,6 +399,8 @@ class Trainer:
                 state, dataset, val_chunks, None, train=False
             )
             history["val"].append(val_metrics)
+            epoch_hist.observe(_time.perf_counter() - t_epoch)
+            epoch_counter.inc()
             log.info(
                 "epoch %d: train loss=%.4f acc=%.4f hamming=%.4f | "
                 "val acc=%.4f hamming=%.4f",

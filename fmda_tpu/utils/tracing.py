@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Iterator
@@ -18,11 +19,21 @@ log = logging.getLogger("fmda_tpu")
 
 
 class StageTimer:
-    """Accumulates wall-clock per named stage; cheap enough for hot loops."""
+    """Accumulates wall-clock per named stage; cheap enough for hot loops.
+
+    Thread-safe: one lock around the accumulator writes and the summary
+    read.  A timer is shared between writers and readers (the fleet
+    gateway's flush path observes stages while ``/metrics`` scrapes and
+    ``Application.stage_timings`` read the summary), and a bare
+    ``defaultdict`` mutation racing a concurrent ``summary()`` iteration
+    is a RuntimeError waiting for load.  The stage body itself runs
+    outside the lock — only the two dict updates are serialised.
+    """
 
     def __init__(self) -> None:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str) -> Iterator[None]:
@@ -31,18 +42,27 @@ class StageTimer:
             yield
         finally:
             elapsed = time.perf_counter() - start
-            self.totals[name] += elapsed
+            with self._lock:
+                self.totals[name] += elapsed
+                self.counts[name] += 1
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record an already-measured duration (callers that time with
+        their own clock, e.g. the gateway's multi-point flush path)."""
+        with self._lock:
+            self.totals[name] += seconds
             self.counts[name] += 1
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        return {
-            name: {
-                "total_s": self.totals[name],
-                "count": self.counts[name],
-                "mean_s": self.totals[name] / max(self.counts[name], 1),
+        with self._lock:
+            return {
+                name: {
+                    "total_s": total,
+                    "count": self.counts[name],
+                    "mean_s": total / max(self.counts[name], 1),
+                }
+                for name, total in self.totals.items()
             }
-            for name in self.totals
-        }
 
     def log_summary(self, level: int = logging.INFO) -> None:
         for name, stats in sorted(self.summary().items()):

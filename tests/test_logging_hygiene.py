@@ -1,0 +1,86 @@
+"""Logging hygiene, enforced statically (ISSUE 2 satellite).
+
+Library code must report through the observability plane or the
+``fmda_tpu`` logger hierarchy — never ``print()`` (invisible to any
+operator collecting logs, corrupts CLI JSON output) and never a logger
+outside the ``fmda_tpu`` namespace (escapes the hierarchy operators
+configure).  This is an AST walk over every module in the package, so a
+violation fails tier-1 the commit it appears.
+
+Allowlist: ``cli.py`` (stdout IS its interface) and ``utils/env.py``
+(prints inside a generated subprocess probe script).
+"""
+
+import ast
+import pathlib
+
+import fmda_tpu
+
+PACKAGE_DIR = pathlib.Path(fmda_tpu.__file__).parent
+
+#: modules whose prints are their contract, relative to the package root
+ALLOWLIST = {"cli.py", "utils/env.py"}
+
+LOGGER_NAMESPACE = "fmda_tpu"
+
+
+def _module_files():
+    return sorted(
+        p for p in PACKAGE_DIR.rglob("*.py")
+        if str(p.relative_to(PACKAGE_DIR)) not in ALLOWLIST
+    )
+
+
+def _violations(path: pathlib.Path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = path.relative_to(PACKAGE_DIR)
+    found = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            found.append(f"{rel}:{node.lineno}: print() call")
+        is_get_logger = (
+            isinstance(fn, ast.Attribute) and fn.attr == "getLogger"
+        ) or (isinstance(fn, ast.Name) and fn.id == "getLogger")
+        if is_get_logger:
+            if not node.args:
+                found.append(
+                    f"{rel}:{node.lineno}: getLogger() with no name "
+                    "(the root logger is not ours to configure)")
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+                if name != LOGGER_NAMESPACE and not name.startswith(
+                        LOGGER_NAMESPACE + "."):
+                    found.append(
+                        f"{rel}:{node.lineno}: logger {name!r} outside "
+                        f"the {LOGGER_NAMESPACE!r} namespace")
+            elif isinstance(arg, ast.Name) and arg.id == "__name__":
+                pass  # module __name__ always resolves under fmda_tpu.*
+            else:
+                found.append(
+                    f"{rel}:{node.lineno}: getLogger() with a dynamic "
+                    "name — use a literal 'fmda_tpu.*' name")
+    return found
+
+
+def test_no_prints_or_foreign_loggers_in_library_code():
+    files = _module_files()
+    assert len(files) > 50  # the walk actually covers the package
+    violations = []
+    for path in files:
+        violations.extend(_violations(path))
+    assert not violations, (
+        "logging hygiene violations (report via the fmda_tpu logger "
+        "hierarchy or the obs plane):\n" + "\n".join(violations)
+    )
+
+
+def test_allowlisted_modules_exist():
+    # a refactor that moves/renames an allowlisted module must shrink the
+    # allowlist, not silently stop checking a path that no longer exists
+    for rel in ALLOWLIST:
+        assert (PACKAGE_DIR / rel).is_file(), f"stale allowlist entry {rel}"
