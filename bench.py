@@ -313,8 +313,11 @@ def phase_flagship_wide() -> dict:
     if jax.default_backend() == "cpu":
         # guard in the phase itself (not just main's plan): the capture
         # path can race a dying tunnel, and a CPU H=1024 step would just
-        # burn the whole subprocess timeout
-        return {"error": "skipped (cpu backend; MXU probe needs an accelerator)"}
+        # burn the whole subprocess timeout.  "skipped", not "error": an
+        # accelerator-only probe sitting out a CPU round is the designed
+        # degradation, not a failure (BENCH_r05 listed these under
+        # phases_error and they read as breakage)
+        return {"skipped": "cpu backend; MXU probe needs an accelerator"}
     # use_pallas=True here is the *auto* path: at H=1024 the kernel's
     # VMEM working set fails fmda_tpu.ops.pallas_gru.kernel_supported, so
     # select_scan_fn picks lax.scan — whose per-step (B,H)x(H,3H) matmul
@@ -562,8 +565,8 @@ def phase_kernel_sweep() -> dict:
     from fmda_tpu.ops.pallas_gru import gru_scan_pallas, kernel_supported
 
     if not pallas_scan_available():
-        return {"error": "skipped (Mosaic kernel unavailable on backend "
-                         f"'{jax.default_backend()}')"}
+        return {"skipped": "Mosaic kernel unavailable on backend "
+                           f"'{jax.default_backend()}'"}
 
     shapes = [
         # (batch, seq, hidden): the flagship + longctx protocol shapes...
@@ -643,8 +646,8 @@ def phase_attn_sweep() -> dict:
     from fmda_tpu.ops.pallas_attention import flash_attention, flash_supported
 
     if not flash_available():
-        return {"error": "skipped (flash kernel unavailable on backend "
-                         f"'{jax.default_backend()}')"}
+        return {"skipped": "flash kernel unavailable on backend "
+                           f"'{jax.default_backend()}'"}
 
     # (B, N, T, D): longctx protocol head shapes (H=32, 4 heads -> D=8)
     # at the ring-step ladder T=128..1024; plus a D=64 row for the
@@ -1135,12 +1138,21 @@ def phase_replay() -> dict:
 
 
 def phase_runtime_fleet() -> dict:
-    """Fleet-serving smoke: the dynamic micro-batching runtime
-    (fmda_tpu.runtime, docs/runtime.md) vs a synthetic 64-session
+    """Fleet-serving smoke + latency-SLO gate: the dynamic micro-batching
+    runtime (fmda_tpu.runtime, docs/runtime.md) vs a synthetic 64-session
     multi-ticker load on the flagship feature width — p50/p99 tick
     latency + throughput, the serving-trajectory baseline later PRs
     regress against.  CPU-friendly by design (one small batched GRU step
-    per flush)."""
+    per flush).
+
+    The SLO gate (ROADMAP open item): total (submit→publish) p99 must
+    stay under ``FMDA_FLEET_SLO_P99_MS`` (default 50 — ~6x quiet-host
+    headroom over the measured ~7.5ms, tight enough to catch an
+    order-of-magnitude serving regression).  Violations on a quiet host
+    put an ``error`` in the phase result (→ ``phases_error``, the CI
+    signal); a loaded host (1-min loadavg over half the cores) or
+    ``--slo-soft`` / ``FMDA_FLEET_SLO_SOFT=1`` downgrades the verdict to
+    a reported-but-non-failing ``slo_ok: false``."""
     import jax
     import jax.numpy as jnp
 
@@ -1172,23 +1184,44 @@ def phase_runtime_fleet() -> dict:
     out = run_fleet_load(gateway, FleetLoadConfig(
         n_sessions=sessions, n_ticks=rounds, duty=0.9, seed=0))
     lat = out["latency"]
-    return {
+    p99_ms = lat["total"]["p99_ms"]
+    slo_ms = float(os.environ.get("FMDA_FLEET_SLO_P99_MS", "50"))
+    soft = os.environ.get("FMDA_FLEET_SLO_SOFT", "") == "1"
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = None
+    quiet = load1 is not None and load1 < 0.5 * (os.cpu_count() or 1)
+    result = {
         "sessions": sessions,
         "rounds": rounds,
         "ticks_served": out["ticks_served"],
         "ticks_per_s": out["ticks_per_s"],
         "tick_p50_ms": lat["total"]["p50_ms"],
-        "tick_p99_ms": lat["total"]["p99_ms"],
+        "tick_p99_ms": p99_ms,
         "device_p50_ms": lat["device"]["p50_ms"],
+        "dispatch_p50_ms": lat["dispatch"]["p50_ms"],
+        "overlapped_flushes": out["counters"].get("overlapped_flushes", 0),
         "compile_count": out["compile_count"],
         "shed": out["counters"].get("shed_oldest", 0),
         "bucket_sizes": list(buckets),
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
+        "slo_p99_ms": slo_ms,
+        "slo_ok": p99_ms <= slo_ms,
+        "slo_quiet_host": quiet,
         "timing_note": "total = submit->published per tick (incl. "
-                       "micro-batch linger); device = batched jit step "
-                       "per flush; buckets precompiled, so steady-state",
+                       "micro-batch linger); dispatch = assembly + async "
+                       "step enqueue; device = host-transfer block in "
+                       "completion (overlapped work hides elsewhere); "
+                       "buckets precompiled, so steady-state",
     }
+    if p99_ms > slo_ms and quiet and not soft:
+        result["error"] = (
+            f"latency SLO violated: total p99 {p99_ms}ms > {slo_ms}ms "
+            "bound on a quiet host (FMDA_FLEET_SLO_P99_MS to retune, "
+            "--slo-soft / FMDA_FLEET_SLO_SOFT=1 to report-only)")
+    return result
 
 
 def phase_obs_overhead() -> dict:
@@ -1722,12 +1755,14 @@ def main() -> None:
     for name, budget in plan:
         if name in ("flagship_wide", "kernel_sweep", "attn_sweep") and on_cpu:
             # accelerator-only probes (the phases self-skip too, but the
-            # inline guard saves the subprocess spawn + jax import)
-            phases[name] = {"error": "skipped (no accelerator backend)"}
+            # inline guard saves the subprocess spawn + jax import);
+            # "skipped" keeps them out of phases_error — sitting out a
+            # CPU round is the designed degradation, not breakage
+            phases[name] = {"skipped": "no accelerator backend"}
             continue
         remaining = deadline - time.monotonic()
         if remaining < 60.0:
-            phases[name] = {"error": "skipped (global budget exhausted)"}
+            phases[name] = {"skipped": "global budget exhausted"}
             continue
         phase_env = special_envs[name]() if name in special_envs else env
         t0 = time.monotonic()
@@ -1800,7 +1835,10 @@ def main() -> None:
     compact["detail"] = "BENCH_DETAIL.json" if detail_path else "(unwritable)"
     compact["phases_ok"] = sorted(
         n for n, p in phases.items()
-        if isinstance(p, dict) and "error" not in p)
+        if isinstance(p, dict) and "error" not in p and "skipped" not in p)
+    compact["phases_skipped"] = sorted(
+        n for n, p in phases.items()
+        if isinstance(p, dict) and "skipped" in p and "error" not in p)
     compact["phases_error"] = sorted(
         n for n, p in phases.items()
         if not isinstance(p, dict) or "error" in p)
@@ -1815,7 +1853,15 @@ if __name__ == "__main__":
                              "appears, then capture on-TPU evidence")
     parser.add_argument("--probe-interval", type=float, default=600.0)
     parser.add_argument("--wait-budget", type=float, default=10 * 3600.0)
+    parser.add_argument("--slo-soft", action="store_true",
+                        help="report runtime_fleet_smoke's latency-SLO "
+                             "verdict without failing the phase "
+                             "(loaded-host escape hatch; also "
+                             "FMDA_FLEET_SLO_SOFT=1)")
     args = parser.parse_args()
+    if args.slo_soft:
+        # phases run in subprocesses that inherit our env
+        os.environ["FMDA_FLEET_SLO_SOFT"] = "1"
     if args.phase:
         print(json.dumps(_PHASES[args.phase]()))
     elif args.wait_for_tpu:

@@ -137,13 +137,23 @@ class Application:
         from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
 
         rc = self.config.runtime
+        mesh = None
+        if rc.shard_pool:
+            # slot axis sharded over the dp axis of the configured mesh;
+            # a 1-device mesh degrades to the (bit-identical) unsharded
+            # pool inside SessionPool
+            from fmda_tpu.parallel.mesh import build_mesh
+
+            mesh = build_mesh(self.config.mesh)
         pool = SessionPool(
-            model_cfg, params, capacity=rc.capacity, window=rc.window)
+            model_cfg, params, capacity=rc.capacity, window=rc.window,
+            mesh=mesh, shard_axis=self.config.mesh.dp_axis)
         gateway_kwargs.setdefault(
             "batcher_config",
             BatcherConfig(bucket_sizes=tuple(rc.bucket_sizes),
                           max_linger_s=rc.max_linger_ms / 1e3))
         gateway_kwargs.setdefault("queue_bound", rc.queue_bound)
+        gateway_kwargs.setdefault("pipeline_depth", rc.pipeline_depth)
         # same decision threshold as the solo serving paths (cmd_serve
         # wires train.prob_threshold into Predictor/StreamingPredictor)
         gateway_kwargs.setdefault(

@@ -368,6 +368,9 @@ def cmd_serve_fleet(args) -> int:
             window=args.window,
             bucket_sizes=(tuple(int(b) for b in args.bucket_sizes.split(","))
                           if args.bucket_sizes else None),
+            pipeline_depth=(0 if args.serial else None),
+            shard_pool=args.shard_pool,
+            slo_p99_ms=args.slo_p99_ms,
         ).items() if v is not None
     }
     cfg = dataclasses.replace(
@@ -398,15 +401,36 @@ def cmd_serve_fleet(args) -> int:
         n_sessions=args.sessions,
         n_ticks=args.ticks, duty=args.duty, seed=args.seed))
     out["backend"] = jax.default_backend()
+    slo_ok = True
+    # args.slo_p99_ms already merged into cfg.runtime via `overrides`
+    slo_ms = cfg.runtime.slo_p99_ms
+    if slo_ms is not None:
+        p99 = out.get("latency", {}).get("total", {}).get("p99_ms")
+        slo_ok = p99 is not None and p99 <= slo_ms
+        out["slo"] = {
+            "p99_ms_bound": slo_ms,
+            "p99_ms": p99,
+            "ok": slo_ok,
+            "soft": bool(args.slo_soft),
+        }
     print(json.dumps(out, indent=2))
     if args.metrics_port is not None and args.metrics_hold_s > 0:
         # keep the endpoint scrapeable after the load (curl/promtool
-        # demos; the load itself is finite)
+        # demos; the load itself is finite) — BEFORE the SLO verdict
+        # exits, so a violating run's histograms stay inspectable
         import time
 
         print(f"holding metrics endpoint for {args.metrics_hold_s:.0f}s",
               file=sys.stderr)
         time.sleep(args.metrics_hold_s)
+    if slo_ms is not None and not slo_ok and not args.slo_soft:
+        p99 = out["slo"]["p99_ms"]
+        print("SLO gate failed: "
+              + (f"total p99 {p99}ms > {slo_ms}ms bound"
+                 if p99 is not None else
+                 "no latency data collected (zero ticks served)")
+              + " (--slo-soft reports without failing)", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -598,6 +622,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-bound", type=int, default=None,
                    help="override config runtime.queue_bound")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--serial", action="store_true", default=None,
+                   help="disable the one-deep flush overlap pipeline "
+                        "(runtime.pipeline_depth=0; bit-identical A/B "
+                        "reference for the default overlapped path)")
+    p.add_argument("--shard-pool", action="store_true", default=None,
+                   help="shard the session pool's slot axis across the "
+                        "configured device mesh (runtime.shard_pool; "
+                        "1-device meshes degrade to the unsharded pool)")
+    p.add_argument("--slo-p99-ms", type=float, default=None,
+                   help="latency-SLO gate: exit 1 unless p99 of "
+                        "submit->publish stays under this bound "
+                        "(overrides config runtime.slo_p99_ms)")
+    p.add_argument("--slo-soft", action="store_true",
+                   help="report the SLO verdict in the JSON but never "
+                        "fail the run (loaded-host escape hatch)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve /metrics + /healthz + /snapshot on this "
                         "port during the run (0 = ephemeral)")

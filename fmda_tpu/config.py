@@ -457,6 +457,22 @@ class RuntimeConfig:
     queue_bound: int = DEFAULT_QUEUE_BOUND
     #: Pooled-head trailing window of the carried streaming state.
     window: int = 30
+    #: Flush pipelining in the gateway: 1 = one-deep overlap (flush k's
+    #: host transfer + publish run while flush k+1 dispatches — the
+    #: default hot path), 0 = strictly serial flushes (the A/B reference;
+    #: results are bit-identical either way, tests assert it).
+    pipeline_depth: int = 1
+    #: Shard the slot axis of the pool's state tree across the dp axis of
+    #: the device mesh (config.mesh) so fleet capacity scales with chip
+    #: count.  Off by default: on one device the unsharded path is taken
+    #: regardless (bit-identical), and multi-chip serving is an explicit
+    #: deployment decision.
+    shard_pool: bool = False
+    #: Latency-SLO gate for `serve-fleet` and the `runtime_fleet_smoke`
+    #: bench phase: p99 of the submit→publish ("total") histogram must
+    #: stay under this bound (ms) on a quiet host.  None disables the
+    #: gate; `--slo-soft` reports the verdict without failing.
+    slo_p99_ms: Optional[float] = None
 
 
 @dataclass(frozen=True)

@@ -82,3 +82,12 @@ def sequence_sharding(
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def slot_sharding(mesh: Mesh, dp_axis: str = "dp") -> NamedSharding:
+    """Shard the leading *slot* axis of the fleet pool's state tree over
+    dp (``fmda_tpu.runtime.session_pool`` — serving capacity scales with
+    device count; each chip holds an equal block of sessions' state).
+    Structurally :func:`batch_sharding`; named separately because slots
+    are persistent state, not a per-step batch."""
+    return NamedSharding(mesh, PartitionSpec(dp_axis))

@@ -143,6 +143,15 @@ class MicroBatcher:
         the largest bucket.  Later rows of the same session stay queued
         (their recurrence needs this flush's result first)."""
         cap = self.config.bucket_sizes[-1]
+        # fast path for the common lockstep flush: when no session has a
+        # second row queued and everything fits one flush, the whole
+        # queue is the batch — no per-tick set hashing or re-queueing
+        if (len(self._pending) <= cap
+                and len(self._per_session) == len(self._pending)):
+            taken = list(self._pending)
+            self._pending.clear()
+            self._per_session.clear()
+            return taken
         taken: List[Tick] = []
         seen = set()
         leftover: List[Tick] = []

@@ -15,9 +15,27 @@ One :class:`FleetGateway` owns the serving loop for a fleet of sessions:
   consumption) — the same transport every other stage of the framework
   already speaks.
 
+**The overlap pipeline** (ISSUE 3): dispatching a flush and consuming
+its results are split into :meth:`FleetGateway._dispatch` (stale filter,
+staging-buffer assembly, async ``SessionPool.step_device``) and
+:meth:`FleetGateway._complete` (host transfer, label thresholding, one
+batched bus publish).  ``pump`` runs them one flush apart — while flush
+k's probabilities cross the host boundary and fan out to the bus, flush
+k+1 is already assembled and enqueued on the device.  The pipeline is
+one deep and strictly local to each ``pump`` call: every result a call
+flushed is returned by that call, so the external contract (and the
+numbers) are identical to the serial path — ``pipeline_depth=0`` forces
+serial for A/B tests.  Batch assembly writes into pre-allocated
+per-bucket staging buffers (double-buffered, because a one-deep pipeline
+has at most one prior flush whose dispatch may still read its staging),
+killing the two per-flush array allocations.
+
 Every tick's journey is measured (enqueue→dispatch→device→publish
 histograms in :class:`~fmda_tpu.runtime.metrics.RuntimeMetrics`); every
-loss path is a counter, never a silent drop.
+loss path is a counter, never a silent drop.  Under overlap, ``device``
+measures the time ``_complete`` spends *blocked* on the transfer —
+overlapped device work hides inside the preceding ``dispatch``/
+``publish`` wall clock, which is the point.
 """
 
 from __future__ import annotations
@@ -57,6 +75,16 @@ class FleetResult:
     labels: Tuple[str, ...]
 
 
+@dataclass
+class _InFlight:
+    """A dispatched-but-unconsumed flush: the device handle to its
+    probabilities plus everything ``_complete`` needs to publish them."""
+
+    live: List[Tick]
+    probs_dev: object  # (bucket, n_classes) device array
+    bucket: int
+
+
 class FleetGateway:
     """Multiplexes many ticker sessions onto one batched serving step."""
 
@@ -76,9 +104,14 @@ class FleetGateway:
         prediction_topic: str = TOPIC_FLEET_PREDICTION,
         threshold: float = 0.5,
         y_fields: Tuple[str, ...] = TARGET_COLUMNS,
+        pipeline_depth: int = 1,
     ) -> None:
         if queue_bound < 1:
             raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
+        if pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"pipeline_depth must be 0 (serial) or 1 (one-deep "
+                f"overlap), got {pipeline_depth}")
         if bus is not None and prediction_topic not in bus.topics():
             # fail at construction, not mid-flush: a publish KeyError
             # after pool.step would lose results whose state advance is
@@ -96,8 +129,21 @@ class FleetGateway:
         self.prediction_topic = prediction_topic
         self.threshold = threshold
         self.y_fields = tuple(y_fields)
+        #: 1 = one-deep overlap pipeline (default); 0 = serial flushes
+        #: (the A/B reference the bit-identity tests compare against).
+        self.pipeline_depth = pipeline_depth
         self.batcher = MicroBatcher(batcher_config, clock=clock)
         self._seq: Dict[str, int] = {}
+        # pre-allocated per-bucket staging for batch assembly, two
+        # (slots, rows) pairs per bucket: with a one-deep pipeline at
+        # most one earlier flush's dispatch can still be reading its
+        # staging (jax may alias host numpy on CPU), and its completion
+        # — which always precedes reusing the same parity — forces that
+        # read to have finished
+        self._staging: Dict[int, list] = {}
+        self._staging_idx: Dict[int, int] = {}
+        self._publish_many = (
+            getattr(bus, "publish_many", None) if bus is not None else None)
 
     # -- admission ----------------------------------------------------------
 
@@ -176,27 +222,94 @@ class FleetGateway:
     def pump(self, *, force: bool = False) -> List[FleetResult]:
         """Flush ready micro-batches (all pending ones when ``force`` —
         the drain path).  Returns every result served this call; each is
-        also published on the bus when one is attached."""
+        also published on the bus when one is attached.
+
+        Consecutive flushes run through the one-deep overlap pipeline:
+        flush k+1 is assembled and dispatched *before* flush k's
+        probabilities are pulled to the host and published, so the
+        device computes k+1 while the host finishes k.  The pipeline
+        never outlives the call — the final in-flight flush is completed
+        before returning, so callers see exactly the serial contract.
+        """
         results: List[FleetResult] = []
-        while True:
-            if force:
-                if not len(self.batcher):
+        inflight: Optional[_InFlight] = None
+        try:
+            while True:
+                if force:
+                    if not len(self.batcher):
+                        break
+                elif not self.batcher.ready(self.clock()):
                     break
-            elif not self.batcher.ready(self.clock()):
-                break
-            ticks = self.batcher.take_batch()
-            if not ticks:
-                break
-            results.extend(self._flush(ticks))
-        self.metrics.gauge("queue_depth", len(self.batcher))
+                ticks = self.batcher.take_batch()
+                if not ticks:
+                    break
+                nxt = self._dispatch(ticks)
+                # hand the previous flush off BEFORE completing it, so a
+                # completion failure can never strand the just-dispatched
+                # one (its state advance is already irreversible)
+                prev, inflight = inflight, nxt
+                if prev is not None:
+                    if nxt is not None:
+                        self.metrics.count("overlapped_flushes")
+                    results.extend(self._complete_counted(prev))
+                if self.pipeline_depth == 0 and inflight is not None:
+                    prev, inflight = inflight, None
+                    results.extend(self._complete_counted(prev))
+            if inflight is not None:  # drain the trailing in-flight flush
+                prev, inflight = inflight, None
+                results.extend(self._complete_counted(prev))
+        finally:
+            # reached with a live in-flight only when unwinding an
+            # exception: the flush's pool-state advance already happened,
+            # so its results must still be published (consumers stay
+            # consistent with the recurrence) — and if even that fails,
+            # _complete_counted made the loss a counter, never silence
+            if inflight is not None:
+                try:
+                    results.extend(self._complete_counted(inflight))
+                except Exception:  # noqa: BLE001 — don't mask the unwind
+                    log.exception(
+                        "in-flight flush lost while unwinding pump failure")
+            self.metrics.gauge("queue_depth", len(self.batcher))
         return results
+
+    def _complete_counted(self, inflight: _InFlight) -> List[FleetResult]:
+        """:meth:`_complete` with the loss path counted: a completion
+        failure (bus publish error, transfer failure) marks its ticks
+        ``flush_results_lost`` before propagating — the state advance
+        behind them is irreversible, so the loss must be visible."""
+        try:
+            return self._complete(inflight)
+        except Exception:
+            self.metrics.count("flush_results_lost", len(inflight.live))
+            raise
 
     def drain(self) -> List[FleetResult]:
         """Serve everything still queued, deadline or not (shutdown/end
         of load)."""
         return self.pump(force=True)
 
-    def _flush(self, ticks: List[Tick]) -> List[FleetResult]:
+    def _staging_for(self, bucket: int):
+        """The next (slots, rows) staging pair for ``bucket`` —
+        pre-allocated once per bucket, alternating between two parities
+        (see the constructor comment for why two suffice)."""
+        bufs = self._staging.get(bucket)
+        if bufs is None:
+            bufs = [
+                (np.full(bucket, self.pool.padding_slot, np.int32),
+                 np.zeros((bucket, self.pool.cfg.n_features), np.float32))
+                for _ in range(2)
+            ]
+            self._staging[bucket] = bufs
+            self._staging_idx[bucket] = 0
+        idx = self._staging_idx[bucket]
+        self._staging_idx[bucket] = 1 - idx
+        return bufs[idx]
+
+    def _dispatch(self, ticks: List[Tick]) -> Optional[_InFlight]:
+        """Stage 1 of a flush: stale-filter, assemble into the bucket's
+        staging buffers, enqueue the pool step on the device.  Returns
+        the in-flight record (None if every tick went stale in queue)."""
         t_dispatch = self.clock()
         live = []
         for tick in ticks:
@@ -206,47 +319,69 @@ class FleetGateway:
             else:
                 self.metrics.count("stale_dropped")
         if not live:
-            return []
+            return None
         bucket = self.batcher.bucket_for(len(live))
-        slots = np.full(bucket, self.pool.padding_slot, np.int32)
-        rows = np.zeros((bucket, self.pool.cfg.n_features), np.float32)
+        slots, rows = self._staging_for(bucket)
         for i, tick in enumerate(live):
             slots[i] = tick.handle.slot
             rows[i] = tick.row
-        # "device" measures ONLY the jit step (+ its host transfer), not
-        # the stale filter or batch assembly above — those land between
-        # enqueue_to_dispatch and device, and always inside "total"
-        t_assembled = self.clock()
+        # lanes past len(live) keep stale rows from the buffer's last
+        # use — harmless by construction (they compute into the padding
+        # slot, state nothing reads) — but their slot entries MUST be
+        # re-pointed at the padding lane
+        slots[len(live):] = self.pool.padding_slot
+        with self.metrics.timer.stage("dispatch"):
+            probs_dev = self.pool.step_device(slots, rows)  # async enqueue
+        t_dispatched = self.clock()
+
+        m = self.metrics
+        m.count("flushes")
+        m.count(f"flushes_bucket_{bucket}")
+        m.count("padded_lanes", bucket - len(live))
+        m.observe("dispatch", t_dispatched - t_dispatch)
+        for tick in live:
+            m.observe("enqueue_to_dispatch", t_dispatch - tick.t_enqueue)
+        return _InFlight(live=live, probs_dev=probs_dev, bucket=bucket)
+
+    def _complete(self, inflight: _InFlight) -> List[FleetResult]:
+        """Stage 2 of a flush: force the host transfer, threshold labels,
+        publish the whole flush in one batched bus call."""
+        t_synced = self.clock()
         with self.metrics.timer.stage("device"):
-            probs = self.pool.step(slots, rows)  # blocks: host np array
+            probs = np.asarray(inflight.probs_dev)  # blocks: host array
         t_device = self.clock()
 
         results = []
+        messages = [] if self.bus is not None else None
         with self.metrics.timer.stage("publish"):
-            for i, tick in enumerate(live):
+            for i, tick in enumerate(inflight.live):
                 p = probs[i]
                 _, labels = labels_over_threshold(
                     p, self.threshold, self.y_fields)
                 results.append(FleetResult(
                     tick.handle.session_id, tick.seq, p, labels))
-                if self.bus is not None:
-                    self.bus.publish(self.prediction_topic, {
+                if messages is not None:
+                    messages.append({
                         "session": tick.handle.session_id,
                         "seq": tick.seq,
                         "probabilities": [float(v) for v in p],
                         "pred_labels": list(labels),
                         "prob_threshold": self.threshold,
                     })
+            if messages:
+                # one batched publish per flush: one lock acquisition /
+                # native call sequence instead of per-tick bus overhead
+                if self._publish_many is not None:
+                    self._publish_many(self.prediction_topic, messages)
+                else:
+                    for msg in messages:
+                        self.bus.publish(self.prediction_topic, msg)
         t_publish = self.clock()
 
         m = self.metrics
-        m.count("flushes")
-        m.count("ticks_served", len(live))
-        m.count(f"flushes_bucket_{bucket}")
-        m.count("padded_lanes", bucket - len(live))
-        m.observe("device", t_device - t_assembled)
+        m.count("ticks_served", len(inflight.live))
+        m.observe("device", t_device - t_synced)
         m.observe("publish", t_publish - t_device)
-        for tick in live:
-            m.observe("enqueue_to_dispatch", t_dispatch - tick.t_enqueue)
+        for tick in inflight.live:
             m.observe("total", t_publish - tick.t_enqueue)
         return results

@@ -30,8 +30,13 @@ __all__ = ["LatencyHistogram", "RuntimeMetrics", "STAGES"]
 #: :attr:`RuntimeMetrics.histograms`.
 STAGES: Tuple[str, ...] = (
     "enqueue_to_dispatch",  # time spent queued/lingering before a flush
-    "device",               # batched jit step incl. host transfer
-    "publish",              # per-flush bus publish fan-out
+    "dispatch",             # stale filter + staging assembly + async
+                            # enqueue of the batched jit step
+    "device",               # host transfer block in _complete; under the
+                            # overlap pipeline the device computes during
+                            # the previous flush's dispatch/publish, so
+                            # this is the *unhidden* remainder
+    "publish",              # per-flush batched bus publish
     "total",                # submit -> result published
 )
 

@@ -29,6 +29,29 @@ The step is compiled once per distinct batch size ``B``; the micro-batcher
 XLA compiles a handful of programs and replays them forever
 (:attr:`SessionPool.compile_count` is the proof hook tests assert on).
 
+Two serving-hot-path disciplines (ISSUE 3):
+
+- **Donation** — the jitted step donates the carry/ring/pos buffers
+  (``donate_argnums``), so XLA advances the pooled state *in place*
+  instead of allocating and copying the whole (capacity+1, ...) tree on
+  every flush.  The pool immediately rebinds its state attributes to the
+  step's outputs, so no caller can observe the consumed buffers.
+- **Async dispatch** — :meth:`step_device` returns the *device* array of
+  probabilities without forcing the host transfer; the gateway overlaps
+  flush k's transfer+publish with flush k+1's assembly+dispatch
+  (:mod:`fmda_tpu.runtime.gateway`, the one-deep in-flight pipeline).
+  :meth:`step` keeps the old blocking contract for direct callers.
+
+**Sharding** — pass ``mesh`` to shard the *slot* axis of the state tree
+across chips with :class:`~jax.sharding.NamedSharding` over the existing
+(dp, sp) mesh (:mod:`fmda_tpu.parallel.mesh`): fleet capacity then scales
+with device count (each chip holds ``n_slots / dp`` sessions' state; the
+gather/scatter crosses chips only for the lanes that live elsewhere).
+The slot count is padded up to a multiple of the dp axis so every shard
+is equal-sized; the extra lanes are permanent padding nothing ever
+allocates.  A ``mesh`` spanning **one** device (or ``mesh=None``) takes
+the exact unsharded code path — bit-identical to the pre-sharding pool.
+
 Scope: the unidirectional recurrent carriers (``cell="gru"``/``"lstm"``,
 any ``n_layers`` — the pure O(1)-per-tick cores).  Bidirectional or attn
 serving re-encodes a window per tick; multiplex those through the
@@ -80,9 +103,10 @@ class SessionPool:
     path (each functional ``.at[slot].set`` update copies its
     (capacity+1, ...) array, so slot churn costs O(capacity) per call —
     fine at serving-session churn rates; a donate-based fused reset is
-    the known optimisation if admission ever becomes hot).  ``step`` is
-    the hot path — one fused jit call advancing every session named in
-    ``slots`` by one tick.
+    the known optimisation if admission ever becomes hot).  ``step`` /
+    ``step_device`` are the hot path — one fused jit call advancing every
+    session named in ``slots`` by one tick, with the carry/ring/pos
+    buffers donated so the state advances in place.
     """
 
     def __init__(
@@ -92,6 +116,8 @@ class SessionPool:
         *,
         capacity: int,
         window: int,
+        mesh=None,
+        shard_axis: str = "dp",
     ) -> None:
         gate_step, _, self._n_carry, _ = _recurrent_cell_ops(cfg.cell)
         if cfg.bidirectional:
@@ -113,19 +139,54 @@ class SessionPool:
         self._params = jax.tree.map(
             lambda a: jnp.asarray(a).astype(dtype), params)
 
+        self.mesh = mesh
+        self.n_shards = int(mesh.shape[shard_axis]) if mesh is not None else 1
         n_slots = capacity + 1
+        if self.n_shards > 1:
+            # pad the slot axis to a multiple of the shard count so every
+            # chip holds an equal block; lanes past `capacity` are
+            # permanent padding (never in the free list, never indexed)
+            n_slots = -(-n_slots // self.n_shards) * self.n_shards
+        #: Leading-axis length of every state leaf (>= capacity + 1).
+        self.n_slots = n_slots
+        if self.n_shards > 1:
+            from fmda_tpu.parallel.mesh import (
+                replicated_sharding,
+                slot_sharding,
+            )
+
+            self._state_sharding = slot_sharding(mesh, shard_axis)
+            self._repl_sharding = replicated_sharding(mesh)
+            self._params = jax.tree.map(
+                lambda a: jax.device_put(a, self._repl_sharding),
+                self._params)
+
+            def place(a):
+                return jax.device_put(a, self._state_sharding)
+        else:
+            self._state_sharding = None
+            self._repl_sharding = None
+
+            def place(a):
+                return a
+
+        #: Re-pins a state leaf to the slot sharding after a host-side
+        #: functional update (alloc/reset), so the jitted step's donation
+        #: aliasing never sees a drifted layout.  Identity when unsharded.
+        self._place_state = place
+
         hidden = cfg.hidden_size
         feats = cfg.n_features
         self._carry = tuple(
-            tuple(jnp.zeros((n_slots, hidden), dtype)
+            tuple(place(jnp.zeros((n_slots, hidden), dtype))
                   for _ in range(self._n_carry))
             for _ in range(cfg.n_layers))
-        self._ring = jnp.zeros((n_slots, window, hidden), dtype)
-        self._pos = jnp.zeros((n_slots,), jnp.int32)
+        self._ring = place(jnp.zeros((n_slots, window, hidden), dtype))
+        self._pos = place(jnp.zeros((n_slots,), jnp.int32))
         # per-slot normalization (sessions serve different tickers with
         # different price scales), gathered alongside the state
-        self._x_min = jnp.zeros((n_slots, feats), jnp.float32)
-        self._x_range = jnp.ones((n_slots, feats), jnp.float32)
+        self._x_min = place(jnp.zeros((n_slots, feats), jnp.float32))
+        self._x_range = place(jnp.ones((n_slots, feats), jnp.float32))
 
         # host-side slot bookkeeping
         self._generations = [0] * capacity
@@ -169,7 +230,27 @@ class SessionPool:
             pos = pos.at[slots].set(pos_b + 1)
             return jax.nn.sigmoid(logits), carry_out, ring, pos
 
-        self._step = jax.jit(step)
+        # carry/ring/pos are DONATED: the step advances the pooled state
+        # in place (XLA aliases each donated input to its same-shape
+        # output) instead of copying the whole (n_slots, ...) tree per
+        # flush.  The attributes are rebound to the outputs immediately
+        # below in step_device, so the consumed buffers are unreachable.
+        donate = (1, 2, 3)
+        if self.n_shards > 1:
+            st, rp = self._state_sharding, self._repl_sharding
+            # explicit shardings (pytree prefixes): state tree sharded on
+            # the slot axis, params/norms-batch replicated — and the SAME
+            # specs on the outputs, so donation aliasing holds shard for
+            # shard.  slots/rows arrive replicated; XLA inserts the
+            # cross-chip gather/scatter for foreign lanes.
+            self._step = jax.jit(
+                step,
+                donate_argnums=donate,
+                in_shardings=(rp, st, st, st, st, st, rp, rp),
+                out_shardings=(rp, st, st, st),
+            )
+        else:
+            self._step = jax.jit(step, donate_argnums=donate)
 
     # -- slot lifecycle (host-side, off the hot path) -----------------------
 
@@ -189,11 +270,13 @@ class SessionPool:
         if norm is not None:
             x_min = np.asarray(norm.x_min, np.float32)
             x_range = np.asarray(norm.x_max, np.float32) - x_min
-            self._x_min = self._x_min.at[slot].set(x_min)
-            self._x_range = self._x_range.at[slot].set(x_range)
+            self._x_min = self._place_state(self._x_min.at[slot].set(x_min))
+            self._x_range = self._place_state(
+                self._x_range.at[slot].set(x_range))
         else:
-            self._x_min = self._x_min.at[slot].set(0.0)
-            self._x_range = self._x_range.at[slot].set(1.0)
+            self._x_min = self._place_state(self._x_min.at[slot].set(0.0))
+            self._x_range = self._place_state(
+                self._x_range.at[slot].set(1.0))
         handle = SessionHandle(session_id, slot, self._generations[slot])
         self._by_id[session_id] = handle
         return handle
@@ -214,11 +297,12 @@ class SessionPool:
         self._reset_slot(handle.slot)
 
     def _reset_slot(self, slot: int) -> None:
+        place = self._place_state
         self._carry = tuple(
-            tuple(c.at[slot].set(0.0) for c in layer)
+            tuple(place(c.at[slot].set(0.0)) for c in layer)
             for layer in self._carry)
-        self._ring = self._ring.at[slot].set(0.0)
-        self._pos = self._pos.at[slot].set(0)
+        self._ring = place(self._ring.at[slot].set(0.0))
+        self._pos = place(self._pos.at[slot].set(0))
 
     def is_live(self, handle: SessionHandle) -> bool:
         return (
@@ -279,20 +363,31 @@ class SessionPool:
 
     # -- the hot path -------------------------------------------------------
 
-    def step(self, slots: np.ndarray, rows: np.ndarray) -> np.ndarray:
-        """One fused flush: advance ``slots[i]`` by ``rows[i]``.
+    def step_device(self, slots: np.ndarray, rows: np.ndarray):
+        """One fused flush, asynchronously: advance ``slots[i]`` by
+        ``rows[i]`` and return the (B, n_classes) sigmoid probabilities
+        as a **device array** — no host transfer, no block.  The pool's
+        state advances in place (donated buffers) the moment the step is
+        enqueued; the caller forces the result whenever it actually needs
+        the numbers (the gateway does so one flush late, overlapping the
+        transfer with the next flush's dispatch).
 
         ``slots`` (B,) int32 — pool slots, padded lanes = ``padding_slot``;
-        ``rows`` (B, F) float32.  Returns (B, n_classes) sigmoid
-        probabilities (padding lanes carry garbage; callers slice them
-        off).  Caller contract: at most one lane per live slot, handles
-        already validated (the gateway/batcher do both).
+        ``rows`` (B, F) float32.  Padding lanes carry garbage; callers
+        slice them off.  Caller contract: at most one lane per live slot,
+        handles already validated (the gateway/batcher do both).
         """
-        slots = jnp.asarray(slots, jnp.int32)
-        rows = jnp.asarray(rows, jnp.float32)
+        slots = np.asarray(slots, np.int32)
+        rows = np.asarray(rows, np.float32)
         self._batch_sizes_seen.add(int(slots.shape[0]))
         probs, self._carry, self._ring, self._pos = self._step(
             self._params, self._carry, self._ring, self._pos,
             self._x_min, self._x_range, slots, rows,
         )
-        return np.asarray(probs)
+        return probs
+
+    def step(self, slots: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Blocking :meth:`step_device`: one fused flush, probabilities
+        as a host numpy array (the pre-pipeline contract, kept for direct
+        callers and tests)."""
+        return np.asarray(self.step_device(slots, rows))

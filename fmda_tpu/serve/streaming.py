@@ -208,7 +208,14 @@ class StreamingBiGRU:
             logits = pooled_head_logits(params, h_new, ring, n_valid)
             return logits, carry_new, ring, ring_pos + 1
 
-        self._step = jax.jit(step)
+        # ring + pos donated: the per-tick state advances in place (the
+        # ring is the core's big buffer — (B, window, H)).  The carry is
+        # deliberately NOT donated: aliasing it changes XLA CPU's fusion
+        # of the lstm gate math by one ulp, which would break the
+        # solo-vs-multiplexed bit-identical contract the session pool
+        # tests assert (the pool's own step donates its carry safely —
+        # its gather/scatter program fuses differently).
+        self._step = jax.jit(step, donate_argnums=(4, 5))
         self.reset()
 
     def reset(self) -> None:
@@ -331,7 +338,11 @@ class StreamingBiGRUBidirectional:
             logits = concat @ p["linear"]["kernel"] + p["linear"]["bias"]
             return logits, carry_new, hs_ring, xpb_ring, pos + 1
 
-        self._step = jax.jit(step)
+        # both rings + pos donated (in-place tick state advance; the
+        # xpb ring is (B, window, n_gates*H) — the big buffer).  The
+        # carry stays undonated for the same ulp-stability reason as
+        # StreamingBiGRU's.
+        self._step = jax.jit(step, donate_argnums=(2, 3, 4))
         self.reset()
 
     def reset(self) -> None:

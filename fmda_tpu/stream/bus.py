@@ -68,6 +68,15 @@ class MessageBus(Protocol):
         """Append a message; returns its offset."""
         ...
 
+    def publish_many(self, topic: str, values: Sequence[dict]) -> List[int]:
+        """Append a batch of messages in order; returns their offsets.
+
+        Semantically ``[publish(topic, v) for v in values]`` with the
+        per-call overhead (lock churn, native-call setup) paid once — the
+        fleet gateway publishes a whole flush through this.
+        """
+        ...
+
     def read(
         self, topic: str, offset: int, max_records: Optional[int] = None
     ) -> List[Record]:
@@ -141,6 +150,31 @@ class InProcessBus:
         if self._publish_counters is not None:
             self._publish_counters[topic].inc()
         return offset
+
+    def publish_many(self, topic: str, values) -> List[int]:
+        """Batched :meth:`publish`: one JSON round-trip and one lock
+        acquisition for the whole batch (the fleet gateway's per-flush
+        publish path)."""
+        values = json.loads(json.dumps(list(values)))
+        if not values:
+            return []
+        offsets: List[int] = []
+        with self._lock:
+            self._check_topic(topic)
+            log = self._logs[topic]
+            offset = self._next[topic]
+            for value in values:
+                log.append(Record(topic, offset, value))
+                offsets.append(offset)
+                offset += 1
+            self._next[topic] = offset
+            if len(log) > self._capacity:  # retention: drop oldest
+                drop = len(log) - self._capacity
+                del log[:drop]
+                self._base[topic] += drop
+        if self._publish_counters is not None:
+            self._publish_counters[topic].inc(len(offsets))
+        return offsets
 
     def read(
         self, topic: str, offset: int, max_records: Optional[int] = None

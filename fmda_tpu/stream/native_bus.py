@@ -127,7 +127,10 @@ class NativeBus:
 
     # -- MessageBus ----------------------------------------------------------
 
-    def publish(self, topic: str, value: dict) -> int:
+    def _publish_one(self, tid: int, topic: str, value: dict) -> int:
+        """Serialize + size-guard + rb_publish for one record (shared by
+        :meth:`publish` and :meth:`publish_many`; counter bumps stay with
+        the callers so a batch increments once)."""
         payload = json.dumps(value).encode()
         if len(payload) > self.READ_BUF_BYTES:
             # a record the read buffer can never return would wedge its
@@ -137,17 +140,29 @@ class NativeBus:
                 f"({self.READ_BUF_BYTES}B)"
             )
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-        offset = self._lib.rb_publish(
-            self._handle, self._tid(topic), buf, len(payload)
-        )
+        offset = self._lib.rb_publish(self._handle, tid, buf, len(payload))
         if offset < 0:
             raise RuntimeError(
                 f"publish to {topic!r} failed (record {len(payload)}B too "
                 "large for the arena?)"
             )
+        return offset
+
+    def publish(self, topic: str, value: dict) -> int:
+        offset = self._publish_one(self._tid(topic), topic, value)
         if self._publish_counters is not None:
             self._publish_counters[topic].inc()
         return offset
+
+    def publish_many(self, topic: str, values) -> List[int]:
+        """Batched :meth:`publish`: the topic id is resolved and the
+        metrics counter bumped once for the whole batch; records land in
+        the C++ log in order."""
+        tid = self._tid(topic)
+        offsets = [self._publish_one(tid, topic, v) for v in values]
+        if self._publish_counters is not None and offsets:
+            self._publish_counters[topic].inc(len(offsets))
+        return offsets
 
     def read(
         self, topic: str, offset: int, max_records: Optional[int] = None

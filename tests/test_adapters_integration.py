@@ -47,6 +47,8 @@ def test_kafka_bus_offsets_and_reads(kafka_env):
     assert [r.offset for r in recs] == [0, 1]
     assert [r.value["x"] for r in bus.read("a", 1)] == [2]
     assert bus.read("a", 0, max_records=1)[0].value["x"] == 1
+    assert bus.publish_many("b", [{"x": i} for i in range(3)]) == [0, 1, 2]
+    assert [r.value["x"] for r in bus.read("b", 0)] == [0, 1, 2]
     with pytest.raises(KeyError):
         bus.publish("nope", {})
 

@@ -33,6 +33,19 @@ def test_native_unknown_topic():
         bus.publish("nope", {})
 
 
+def test_native_publish_many_matches_serial_publishes():
+    bus = NativeBus(["a", "b"])
+    bus.publish("a", {"i": -1})
+    offsets = bus.publish_many("a", [{"i": i} for i in range(4)])
+    assert offsets == [1, 2, 3, 4]
+    c = bus.consumer("a")
+    assert [r.value["i"] for r in c.poll()] == [-1, 0, 1, 2, 3]
+    assert bus.publish_many("a", []) == []
+    assert bus.end_offset("b") == 0
+    with pytest.raises(KeyError):
+        bus.publish_many("nope", [{}])
+
+
 def test_native_record_retention():
     bus = NativeBus(["a"], max_records=4)
     for i in range(10):

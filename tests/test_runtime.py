@@ -503,6 +503,269 @@ def test_64_sessions_through_one_compiled_step():
 
 
 # ---------------------------------------------------------------------------
+# overlap pipeline + donation + sharding (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_pipeline_bit_identical_to_serial():
+    """The one-deep in-flight pipeline reorders WORK (flush k+1 dispatches
+    before flush k's results come home) but not RESULTS: over multi-flush
+    pumps, every probability and every bus message is bit-identical to
+    the strictly serial gateway."""
+    n, feats, window = 10, 6, 4
+    cfg, params = _setup(feats=feats)
+    norms = _norms(n, feats, seed=9)
+    gws = []
+    for depth in (0, 1):
+        pool = SessionPool(cfg, params, capacity=n, window=window)
+        bus = InProcessBus(DEFAULT_TOPICS)
+        gw = FleetGateway(
+            pool, bus,
+            batcher_config=BatcherConfig(bucket_sizes=(4,),
+                                         max_linger_s=0.0),
+            pipeline_depth=depth)
+        for i in range(n):
+            gw.open_session(f"T{i}", norms[i])
+        gws.append(gw)
+    rng = np.random.default_rng(10)
+    for _ in range(6):
+        ticking = np.flatnonzero(rng.random(n) < 0.8)
+        rows = rng.normal(size=(n, feats)).astype(np.float32)
+        outs = []
+        for gw in gws:
+            for i in ticking:
+                gw.submit(f"T{i}", rows[i])
+            # > bucket-size pending -> multiple flushes per drain: the
+            # overlapped gateway genuinely pipelines here
+            outs.append(gw.drain())
+        serial, overlapped = outs
+        assert [(r.session_id, r.seq) for r in serial] == \
+            [(r.session_id, r.seq) for r in overlapped]
+        for a, b in zip(serial, overlapped):
+            np.testing.assert_array_equal(a.probabilities, b.probabilities)
+            assert a.labels == b.labels
+    assert gws[1].metrics.counters["overlapped_flushes"] > 0
+    assert gws[0].metrics.counters.get("overlapped_flushes", 0) == 0
+    # the bus transcripts match message for message
+    msgs = [gw.bus.consumer(TOPIC_FLEET_PREDICTION).poll() for gw in gws]
+    assert [m.value for m in msgs[0]] == [m.value for m in msgs[1]]
+
+
+def test_pump_failure_never_strands_the_inflight_flush():
+    """A completion failure (bus publish error) mid-pump must not strand
+    the already-dispatched next flush — its pool-state advance is
+    irreversible, so its results are still published on unwind, and the
+    failed flush's ticks are counted (flush_results_lost), never silent."""
+    n, feats = 4, 6
+    cfg, params = _setup(feats=feats)
+
+    class FailOnceBus(InProcessBus):
+        def __init__(self, topics):
+            super().__init__(topics)
+            self.failed = False
+
+        def publish_many(self, topic, values):
+            if not self.failed:
+                self.failed = True
+                raise RuntimeError("transport hiccup")
+            return super().publish_many(topic, values)
+
+    pool = SessionPool(cfg, params, capacity=n, window=4)
+    bus = FailOnceBus(DEFAULT_TOPICS)
+    gw = FleetGateway(
+        pool, bus, batcher_config=BatcherConfig(bucket_sizes=(2,),
+                                                max_linger_s=0.0))
+    for i in range(n):
+        gw.open_session(f"T{i}")
+    rng = np.random.default_rng(15)
+    for i in range(n):
+        gw.submit(f"T{i}", rng.normal(size=feats).astype(np.float32))
+    # two bucket-2 flushes: flush 2 dispatches, then flush 1's publish
+    # blows up; flush 2 must still complete during the unwind
+    with pytest.raises(RuntimeError, match="transport hiccup"):
+        gw.drain()
+    assert gw.metrics.counters["flush_results_lost"] == 2
+    assert gw.metrics.counters["ticks_served"] == 2  # flush 2 landed
+    msgs = bus.consumer(TOPIC_FLEET_PREDICTION).poll()
+    assert [m.value["session"] for m in msgs] == ["T2", "T3"]
+    # the gateway stays serviceable and sequences continue
+    for i in range(n):
+        gw.submit(f"T{i}", rng.normal(size=feats).astype(np.float32))
+    res = gw.drain()
+    assert sorted((r.session_id, r.seq) for r in res) == [
+        (f"T{i}", 1) for i in range(n)]
+
+
+def test_pool_step_donates_state_in_place():
+    """The jitted step donates carry/ring/pos: after a flush the previous
+    buffers are consumed (no per-flush copy of the pooled tree), and the
+    pool stays fully usable through alloc/free/reset churn — no
+    use-after-donate anywhere in the slot lifecycle."""
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=2, window=4)
+    a = pool.alloc("a")
+    rows = np.random.default_rng(0).normal(
+        size=(4, cfg.n_features)).astype(np.float32)
+    old_ring, old_pos = pool._ring, pool._pos
+    old_carry_leaf = pool._carry[0][0]
+    pool.step(np.array([a.slot], np.int32), rows[0][None])
+    assert old_ring.is_deleted() and old_pos.is_deleted()
+    assert old_carry_leaf.is_deleted()
+    # post-donation state supports every host-side operation
+    b = pool.alloc("b")
+    pool.step(np.array([a.slot, b.slot], np.int32), rows[1:3])
+    pool.reset(a)
+    pool.free(b)
+    c = pool.alloc("c")
+    got = pool.step(np.array([c.slot], np.int32), rows[3][None])
+    assert np.isfinite(got).all()
+    assert pool.ticks_seen(a) == 0 and pool.ticks_seen(c) == 1
+
+
+def test_generation_guard_rejects_stale_mid_pipeline():
+    """A session closed while its ticks are queued across SEVERAL
+    pipelined flushes is dropped at each dispatch (counted), and the
+    surviving sessions' results stay correct (to the usual batched-bucket
+    float32 ulp tolerance — these are bucket-2 flushes)."""
+    n, feats, window = 6, 6, 4
+    cfg, params = _setup(feats=feats)
+    pool = SessionPool(cfg, params, capacity=n, window=window)
+    gw = FleetGateway(
+        pool, batcher_config=BatcherConfig(bucket_sizes=(2,),
+                                           max_linger_s=0.0))
+    solos = {}
+    for i in range(n):
+        gw.open_session(f"T{i}")
+        solos[f"T{i}"] = StreamingBiGRU(
+            cfg, params,
+            NormParams(np.zeros(feats, np.float32),
+                       np.ones(feats, np.float32)),
+            window=window)
+    rng = np.random.default_rng(11)
+    # two rounds queued for everyone -> 6 bucket-2 flushes in one drain
+    rows = rng.normal(size=(2, n, feats)).astype(np.float32)
+    for k in range(2):
+        for i in range(n):
+            gw.submit(f"T{i}", rows[k, i])
+    gw.close_session("T3")  # both queued ticks now stale
+    res = gw.drain()
+    assert gw.metrics.counters["stale_dropped"] == 2
+    assert not any(r.session_id == "T3" for r in res)
+    by_key = {(r.session_id, r.seq): r.probabilities for r in res}
+    assert len(by_key) == 2 * (n - 1)
+    for i in range(n):
+        if i == 3:
+            continue
+        for k in range(2):
+            np.testing.assert_allclose(
+                by_key[(f"T{i}", k)], solos[f"T{i}"].step(rows[k, i])[0],
+                atol=1e-6)
+
+
+def test_sharded_pool_matches_unsharded():
+    """The slot axis sharded over the test harness's 8 virtual CPU
+    devices: same outputs as the unsharded pool through alloc/free/reuse
+    churn, slot count padded to the shard count, same compile count."""
+    import jax as _jax
+    from fmda_tpu.config import MeshConfig
+    from fmda_tpu.parallel.mesh import build_mesh
+
+    if len(_jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU harness")
+    feats, window, cap = 6, 4, 5
+    cfg, params = _setup(feats=feats)
+    mesh = build_mesh(MeshConfig())
+    pool_s = SessionPool(cfg, params, capacity=cap, window=window, mesh=mesh)
+    pool_u = SessionPool(cfg, params, capacity=cap, window=window)
+    assert pool_s.n_shards == len(_jax.devices())
+    assert pool_s.n_slots % pool_s.n_shards == 0
+    assert pool_s.n_slots >= cap + 1
+    assert pool_u.n_slots == cap + 1
+    norms = _norms(cap, feats, seed=12)
+    for i in range(cap):
+        pool_s.alloc(f"T{i}", norms[i])
+        pool_u.alloc(f"T{i}", norms[i])
+    rng = np.random.default_rng(13)
+    for k in range(5):
+        nt = int(rng.integers(1, cap + 1))
+        slots = rng.permutation(cap)[:nt].astype(np.int32)
+        rows = rng.normal(size=(nt, feats)).astype(np.float32)
+        got = pool_s.step(slots, rows)
+        want = pool_u.step(slots, rows)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+    # churn: free + realloc behaves identically
+    hs = pool_s.handle_for("T0")
+    hu = pool_u.handle_for("T0")
+    pool_s.free(hs)
+    pool_u.free(hu)
+    hs = pool_s.alloc("T9", norms[0])
+    hu = pool_u.alloc("T9", norms[0])
+    assert hs.slot == hu.slot and hs.generation == hu.generation
+    row = rng.normal(size=(1, feats)).astype(np.float32)
+    np.testing.assert_allclose(
+        pool_s.step(np.array([hs.slot], np.int32), row),
+        pool_u.step(np.array([hu.slot], np.int32), row), atol=1e-6)
+    assert pool_s.compile_count == pool_u.compile_count
+
+
+def test_attach_fleet_wires_shard_pool_and_pipeline_config():
+    """RuntimeConfig.shard_pool/pipeline_depth flow through
+    Application.attach_fleet: the pool comes back sharded over the test
+    harness's virtual devices and the gateway serves through it."""
+    import dataclasses
+
+    import jax as _jax
+
+    from fmda_tpu.app import Application
+    from fmda_tpu.config import FrameworkConfig, RuntimeConfig
+
+    if len(_jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU harness")
+    cfg, params = _setup()
+    app_cfg = dataclasses.replace(
+        FrameworkConfig(),
+        runtime=RuntimeConfig(capacity=8, window=4, bucket_sizes=(8,),
+                              shard_pool=True, pipeline_depth=0))
+    app = Application(app_cfg)
+    try:
+        gw = app.attach_fleet(cfg, params)
+        assert gw.pool.n_shards == len(_jax.devices())
+        assert gw.pipeline_depth == 0
+        gw.open_session("a")
+        gw.submit("a", np.zeros(cfg.n_features, np.float32))
+        res = gw.drain()
+        assert [r.session_id for r in res] == ["a"]
+    finally:
+        app.close()
+
+
+def test_one_device_mesh_takes_unsharded_path_bitwise():
+    """A mesh spanning a single device must be indistinguishable from
+    mesh=None — same slot layout, bit-identical outputs (the acceptance
+    contract for the sharding change)."""
+    import jax as _jax
+    from fmda_tpu.config import MeshConfig
+    from fmda_tpu.parallel.mesh import build_mesh
+
+    feats, window, cap = 6, 4, 3
+    cfg, params = _setup(feats=feats)
+    mesh1 = build_mesh(MeshConfig(dp=1, sp=1),
+                       devices=_jax.devices()[:1])
+    pool_m = SessionPool(cfg, params, capacity=cap, window=window,
+                         mesh=mesh1)
+    pool_n = SessionPool(cfg, params, capacity=cap, window=window)
+    assert pool_m.n_shards == 1 and pool_m.n_slots == pool_n.n_slots
+    a_m = pool_m.alloc("a")
+    a_n = pool_n.alloc("a")
+    rng = np.random.default_rng(14)
+    for _ in range(4):
+        row = rng.normal(size=(1, feats)).astype(np.float32)
+        np.testing.assert_array_equal(
+            pool_m.step(np.array([a_m.slot], np.int32), row),
+            pool_n.step(np.array([a_n.slot], np.int32), row))
+
+
+# ---------------------------------------------------------------------------
 # load generator + metrics + CLI
 # ---------------------------------------------------------------------------
 
@@ -544,3 +807,43 @@ def test_serve_fleet_cli(capsys):
     assert out["ticks_served"] == out["ticks_submitted"] == 32
     assert out["compile_count"] == 1
     assert out["counters"]["ticks_served"] == 32
+
+
+def test_serve_fleet_cli_slo_gate(capsys):
+    """The latency-SLO gate: a generous bound passes (exit 0, verdict in
+    the JSON), an impossible bound fails with exit 1, and --slo-soft
+    downgrades the failure to a reported verdict."""
+    from fmda_tpu.cli import main
+
+    args = ["serve-fleet", "--sessions", "4", "--ticks", "2",
+            "--hidden", "4", "--window", "3", "--bucket-sizes", "4",
+            "--seed", "0"]
+    assert main(args + ["--slo-p99-ms", "1e9"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["slo"]["ok"] is True
+    assert out["slo"]["p99_ms_bound"] == 1e9
+
+    assert main(args + ["--slo-p99-ms", "1e-9"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["slo"]["ok"] is False
+
+    assert main(args + ["--slo-p99-ms", "1e-9", "--slo-soft"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["slo"] == {"p99_ms_bound": 1e-9, "p99_ms": out["slo"]["p99_ms"],
+                          "ok": False, "soft": True}
+
+
+def test_serve_fleet_cli_serial_matches_default(capsys):
+    """--serial (pipeline_depth=0) serves the same load to the same
+    counts — the CLI-level A/B knob the docs advertise."""
+    from fmda_tpu.cli import main
+
+    outs = []
+    for extra in ([], ["--serial"]):
+        assert main(["serve-fleet", "--sessions", "6", "--ticks", "3",
+                     "--hidden", "4", "--window", "3",
+                     "--bucket-sizes", "2", "--seed", "0"] + extra) == 0
+        outs.append(json.loads(capsys.readouterr().out))
+    assert outs[0]["ticks_served"] == outs[1]["ticks_served"] == 18
+    assert outs[0]["counters"].get("overlapped_flushes", 0) > 0
+    assert outs[1]["counters"].get("overlapped_flushes", 0) == 0

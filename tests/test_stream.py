@@ -66,6 +66,34 @@ def test_bus_values_decoupled():
     assert bus.read("a", 0)[0].value["nested"]["v"] == 1
 
 
+def test_bus_publish_many_matches_serial_publishes():
+    """publish_many == [publish(v) for v in values]: same offsets, same
+    records, same decoupling from caller mutation, same retention —
+    just one lock acquisition (the fleet gateway's per-flush path)."""
+    bus = InProcessBus(["a", "b"])
+    bus.publish("a", {"i": -1})
+    msgs = [{"i": i, "nested": {"v": i}} for i in range(4)]
+    offsets = bus.publish_many("a", msgs)
+    assert offsets == [1, 2, 3, 4]
+    msgs[0]["nested"]["v"] = 999  # caller mutation must not leak in
+    recs = bus.read("a", 0)
+    assert [r.value["i"] for r in recs] == [-1, 0, 1, 2, 3]
+    assert recs[1].value["nested"]["v"] == 0
+    assert bus.end_offset("a") == 5
+    assert bus.publish_many("a", []) == []
+    assert bus.end_offset("b") == 0  # topic isolation
+    with pytest.raises(KeyError):
+        bus.publish_many("nope", [{}])
+
+
+def test_bus_publish_many_retention():
+    bus = InProcessBus(["a"], capacity=3)
+    bus.publish_many("a", [{"i": i} for i in range(5)])
+    recs = bus.read("a", 0)
+    assert [r.value["i"] for r in recs] == [2, 3, 4]
+    assert recs[0].offset == 2
+
+
 # ---------------------------------------------------------------- warehouse
 
 
