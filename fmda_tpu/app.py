@@ -57,6 +57,16 @@ class Application:
         from fmda_tpu.obs import Observability
 
         self.config = config or FrameworkConfig()
+        tc = self.config.tracing
+        if tc.enabled:
+            # the process-default tracer is a singleton mutated in place,
+            # so components that captured it at import stay live; an app
+            # config never *disables* a tracer another component enabled
+            from fmda_tpu.obs.trace import configure_tracing
+
+            configure_tracing(
+                enabled=True, sample_rate=tc.sample_rate,
+                capacity=tc.max_spans)
         #: The app's observability plane (fmda_tpu.obs): metrics registry,
         #: event log, health checks, optional scrape endpoint.  Feeds
         #: :attr:`stats` / :attr:`stage_timings` and docs/observability.md.

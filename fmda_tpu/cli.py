@@ -13,7 +13,10 @@ operations are subcommands over one file-backed warehouse:
 - ``serve``     the prediction daemon (push-triggered, no sleep-15);
 - ``status``    pretty-print an observability snapshot (metrics registry
                 + health checks), either from a locally built app or
-                scraped from a running ``/snapshot`` endpoint.
+                scraped from a running ``/snapshot`` endpoint;
+- ``trace``     inspect recorded tick traces (per-stage latency
+                attribution) from a ``--trace-out`` file or a running
+                ``/trace`` endpoint.
 
 Every command is a thin composition of the public library API — anything
 the CLI does is one import away in a notebook.
@@ -375,6 +378,12 @@ def cmd_serve_fleet(args) -> int:
     }
     cfg = dataclasses.replace(
         cfg, runtime=dataclasses.replace(cfg.runtime, **overrides))
+    if args.trace or args.trace_out:
+        # enable BEFORE the Application builds, so every captured
+        # default-tracer handle (bus, gateway) sees the switch
+        from fmda_tpu.obs.trace import configure_tracing
+
+        configure_tracing(enabled=True, sample_rate=args.trace_sample)
     app = Application(cfg)
 
     # synthetic proof run: a randomly-initialised unidirectional carrier
@@ -396,11 +405,41 @@ def cmd_serve_fleet(args) -> int:
     if args.metrics_port is not None:
         server = app.observability.start_server(port=args.metrics_port)
         print(f"metrics endpoint: {server.url}/metrics "
-              f"(healthz, snapshot, events)", file=sys.stderr)
-    out = run_fleet_load(gateway, FleetLoadConfig(
+              f"(healthz, snapshot, events, trace)", file=sys.stderr)
+    load_cfg = FleetLoadConfig(
         n_sessions=args.sessions,
-        n_ticks=args.ticks, duty=args.duty, seed=args.seed))
+        n_ticks=args.ticks, duty=args.duty, seed=args.seed)
+    if args.jax_profile:
+        # device-side work joins the host spans: a TensorBoard/XProf
+        # capture of the whole load, pool flushes annotated as numbered
+        # StepTraceAnnotation steps
+        from fmda_tpu.utils.tracing import device_trace
+
+        gateway.annotate_device_steps = True
+        with device_trace(args.jax_profile):
+            out = run_fleet_load(gateway, load_cfg)
+        print(f"jax profile captured to {args.jax_profile} "
+              f"(tensorboard --logdir)", file=sys.stderr)
+    else:
+        out = run_fleet_load(gateway, load_cfg)
     out["backend"] = jax.default_backend()
+    if args.trace or args.trace_out:
+        from fmda_tpu.obs.trace import default_tracer
+
+        tracer = default_tracer()
+        out["tracing"] = {
+            "traces_finished": tracer.traces_finished,
+            "spans_buffered": len(tracer.spans()),
+            "e2e": tracer.e2e.summary(),
+        }
+        if args.trace_out:
+            with open(args.trace_out, "w") as fh:
+                json.dump(tracer.chrome(), fh)
+            out["tracing"]["file"] = args.trace_out
+            print(f"perfetto trace written to {args.trace_out} "
+                  f"(load at https://ui.perfetto.dev, or "
+                  f"`python -m fmda_tpu trace --input {args.trace_out}`)",
+                  file=sys.stderr)
     slo_ok = True
     # args.slo_p99_ms already merged into cfg.runtime via `overrides`
     slo_ms = cfg.runtime.slo_p99_ms
@@ -516,6 +555,56 @@ def cmd_status(args) -> int:
         health = app.observability.health()
     _print_status(snapshot, health)
     return 0 if health.get("status") == "ok" else 1
+
+
+def cmd_trace(args) -> int:
+    """Per-stage latency attribution for recorded tick traces — the
+    "where did tick T spend its 38 ms" tool (docs/OPERATIONS.md §4d).
+    Input is Chrome/Perfetto trace_event JSON: a ``serve-fleet
+    --trace-out`` file, or a running endpoint's ``/trace``."""
+    from fmda_tpu.obs.trace import format_trace, group_chrome_traces
+
+    if args.endpoint:
+        import urllib.error
+        import urllib.request
+
+        base = (args.endpoint if "://" in args.endpoint
+                else f"http://{args.endpoint}").rstrip("/")
+        try:
+            with urllib.request.urlopen(base + "/trace", timeout=10) as r:
+                doc = json.loads(r.read())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            print(f"cannot scrape {base}/trace: {e}", file=sys.stderr)
+            return 2
+    elif args.input:
+        try:
+            with open(args.input) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read {args.input}: {e}", file=sys.stderr)
+            return 2
+    else:
+        print("pass --input FILE (a serve-fleet --trace-out file) or "
+              "--endpoint HOST:PORT (a running /trace endpoint)",
+              file=sys.stderr)
+        return 2
+    traces = group_chrome_traces(doc)
+    if args.min_ms is not None:
+        traces = [t for t in traces if t["e2e_ms"] >= args.min_ms]
+    if args.slowest is not None:
+        traces = sorted(
+            traces, key=lambda t: t["e2e_ms"], reverse=True)[:args.slowest]
+    else:
+        traces = traces[-args.last:]
+    if not traces:
+        print("no traces matched (is tracing enabled and sampled?)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(traces, indent=2))
+    else:
+        print("\n".join(format_trace(t) for t in traces))
+    return 0
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -643,6 +732,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-hold-s", type=float, default=0.0,
                    help="keep the metrics endpoint up this long after "
                         "the load finishes (curl/promtool demos)")
+    p.add_argument("--trace", action="store_true",
+                   help="enable end-to-end tick tracing for the run "
+                        "(fmda_tpu.obs.trace; spans also served on "
+                        "/trace when --metrics-port is up)")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="trace sampling rate in [0,1] (default 1.0 — "
+                        "every tick; production fleets run ~0.01)")
+    p.add_argument("--trace-out", default=None, metavar="FILE",
+                   help="write the span ring as Chrome/Perfetto "
+                        "trace_event JSON after the load (implies "
+                        "--trace; inspect with `python -m fmda_tpu "
+                        "trace --input FILE` or ui.perfetto.dev)")
+    p.add_argument("--jax-profile", default=None, metavar="DIR",
+                   help="capture a jax device profile of the load "
+                        "(TensorBoard/XProf), pool flushes annotated "
+                        "as numbered steps")
     p.set_defaults(fn=cmd_serve_fleet)
 
     p = sub.add_parser(
@@ -655,6 +760,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="warehouse file for the local snapshot (default: "
                         "config's path)")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser(
+        "trace", parents=[common],
+        help="per-stage latency attribution for recorded tick traces")
+    p.add_argument("--input", default=None, metavar="FILE",
+                   help="Chrome/Perfetto trace_event JSON file "
+                        "(serve-fleet --trace-out)")
+    p.add_argument("--endpoint", default=None, metavar="HOST:PORT",
+                   help="scrape a running endpoint's /trace instead")
+    p.add_argument("--last", type=int, default=10,
+                   help="show the newest N traces (default 10)")
+    p.add_argument("--slowest", type=int, default=None, metavar="N",
+                   help="show the N slowest traces by e2e duration "
+                        "instead of the newest")
+    p.add_argument("--min-ms", type=float, default=None,
+                   help="only traces with e2e duration >= this (ms)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output (grouped trace dicts)")
+    p.set_defaults(fn=cmd_trace)
     return parser
 
 

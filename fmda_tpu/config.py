@@ -509,6 +509,30 @@ class ObservabilityConfig:
 
 
 @dataclass(frozen=True)
+class TracingConfig:
+    """End-to-end tick tracing knobs (fmda_tpu.obs.trace;
+    docs/observability.md "Tracing a tick").
+
+    Off by default: disabled tracing costs one branch on every hot path
+    (submit, flush, bus publish, engine step).  Enabled tracing records
+    spans into a bounded in-memory ring, exported as Chrome/Perfetto
+    trace_event JSON (``/trace``, ``python -m fmda_tpu trace``,
+    ``serve-fleet --trace-out``).
+    """
+
+    #: Master switch for the process tracer.
+    enabled: bool = False
+    #: Fraction of trace roots sampled in [0, 1].  1.0 traces every tick
+    #: (forensics runs); production fleets run ~0.01 — the
+    #: ``trace_overhead`` bench phase holds 1% sampling under the same
+    #: <2% hot-loop budget as the metrics plane.
+    sample_rate: float = 1.0
+    #: Span-ring capacity; overflow evicts the oldest spans, so a
+    #: long-running daemon keeps the newest traces and bounded memory.
+    max_spans: int = 16384
+
+
+@dataclass(frozen=True)
 class SessionConfig:
     """Ingestion-session driver knobs (ref: producer.py:257-263)."""
 
@@ -536,6 +560,7 @@ class FrameworkConfig:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
 
     def __post_init__(self) -> None:
         if self.model.n_features is None:
@@ -566,6 +591,7 @@ _SECTIONS = {
     "session": SessionConfig,
     "runtime": RuntimeConfig,
     "observability": ObservabilityConfig,
+    "tracing": TracingConfig,
 }
 
 

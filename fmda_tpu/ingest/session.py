@@ -32,6 +32,7 @@ from fmda_tpu.config import (
 )
 from fmda_tpu.ingest.clients import AlphaVantageClient, IEXClient, TradierCalendarClient
 from fmda_tpu.ingest.scrapers import COTScraper, EconomicCalendarScraper, VIXScraper
+from fmda_tpu.obs.trace import default_tracer
 from fmda_tpu.stream.bus import MessageBus
 from fmda_tpu.utils.timeutils import forex_market_hours, get_timezone, stock_market_hours
 
@@ -67,6 +68,7 @@ class SessionDriver:
         self.now_fn = now_fn or (lambda: _dt.datetime.now(tz).replace(tzinfo=None))
         self.sleep_fn = sleep_fn
         self.ticks = 0
+        self._tracer = default_tracer()
 
     # -- market gating (producer.py:212-243) ---------------------------------
 
@@ -88,7 +90,19 @@ class SessionDriver:
     # -- one tick (the intraday_data loop body, producer.py:111-150) ---------
 
     def run_tick(self) -> Dict[str, bool]:
-        """Fetch + publish every enabled feed once; returns per-feed success."""
+        """Fetch + publish every enabled feed once; returns per-feed success.
+
+        When tracing is enabled (and the tick is sampled), the whole tick
+        runs inside a ``session_tick`` root span: every transport GET
+        becomes a child span, and every feed message published here
+        carries the tick's trace context in-band — the engine, warehouse
+        land, and serving stitch their stages into the same trace
+        (docs/observability.md, "Tracing a tick").
+        """
+        with self._tracer.root("session_tick", "ingest"):
+            return self._run_tick()
+
+    def _run_tick(self) -> Dict[str, bool]:
         now = self.now_fn()
         results: Dict[str, bool] = {}
 

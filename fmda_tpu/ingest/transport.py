@@ -19,6 +19,7 @@ import time as _time
 from typing import Dict, List, Optional, Protocol
 
 from fmda_tpu.obs.registry import default_registry
+from fmda_tpu.obs.trace import default_tracer
 
 log = logging.getLogger("fmda_tpu.ingest")
 
@@ -66,6 +67,7 @@ class UrllibTransport:
         self._m_requests = reg.counter("ingest_requests_total")
         self._m_failures = reg.counter("ingest_request_failures_total")
         self._m_latency = reg.histogram("ingest_request_seconds")
+        self._tracer = default_tracer()
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         import urllib.error
@@ -78,8 +80,12 @@ class UrllibTransport:
         self._m_requests.inc()
         t0 = _time.perf_counter()
         try:
-            with urllib.request.urlopen(request, timeout=self.timeout_s) as resp:
-                return resp.read()
+            # span() is the shared no-op singleton when tracing is off or
+            # no trace is active (e.g. a one-shot fetch outside a tick)
+            with self._tracer.span("http_get", "ingest"):
+                with urllib.request.urlopen(
+                        request, timeout=self.timeout_s) as resp:
+                    return resp.read()
         except urllib.error.URLError as e:  # pragma: no cover - live only
             self._m_failures.inc()
             raise TransportError(f"GET {url} failed: {e}") from e

@@ -11,7 +11,9 @@ scrape).  The pieces:
 - :mod:`~fmda_tpu.obs.prometheus`   — text-exposition renderer;
 - :mod:`~fmda_tpu.obs.events`       — bounded JSONL event ring;
 - :mod:`~fmda_tpu.obs.server`       — stdlib HTTP thread serving
-  ``/metrics``, ``/healthz``, ``/snapshot``, ``/events``;
+  ``/metrics``, ``/healthz``, ``/snapshot``, ``/events``, ``/trace``;
+- :mod:`~fmda_tpu.obs.trace`        — end-to-end tick tracing
+  (:class:`Tracer`, in-band bus trace context, Perfetto export);
 - :mod:`~fmda_tpu.obs.observability` — the :class:`Observability` handle
   an :class:`~fmda_tpu.app.Application` owns (collectors + health checks
   + endpoint lifecycle).
@@ -35,6 +37,14 @@ from fmda_tpu.obs.registry import (
     default_registry,
 )
 from fmda_tpu.obs.server import MetricsServer
+from fmda_tpu.obs.trace import (
+    Span,
+    TraceRef,
+    Tracer,
+    configure_tracing,
+    default_tracer,
+    tracer_families,
+)
 
 __all__ = [
     "Counter",
@@ -44,9 +54,15 @@ __all__ = [
     "MetricsRegistry",
     "MetricsServer",
     "Observability",
+    "Span",
+    "TraceRef",
+    "Tracer",
+    "configure_tracing",
     "default_registry",
+    "default_tracer",
     "engine_families",
     "render_prometheus",
     "runtime_families",
     "stage_timer_families",
+    "tracer_families",
 ]

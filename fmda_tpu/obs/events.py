@@ -13,7 +13,10 @@ Event schema (one JSON object per line):
 
 ``kind`` is a short dot-separated identifier (``app.tick_error``,
 ``fleet.attached``, ``obs.server_started``); all other fields are
-caller-supplied and must be JSON-serialisable.
+caller-supplied and must be JSON-serialisable.  Events emitted while a
+trace span is active (:mod:`fmda_tpu.obs.trace`) are stamped with that
+span's ``trace_id``, so ``/events?trace_id=...`` correlates the event
+stream with a specific tick's trace.
 """
 
 from __future__ import annotations
@@ -23,6 +26,8 @@ import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from fmda_tpu.obs.trace import current_trace_id
 
 
 class EventLog:
@@ -50,6 +55,12 @@ class EventLog:
         ``emit`` still leaves the line on disk)."""
         event: Dict[str, object] = {"ts": self.clock(), "kind": kind}
         event.update(fields)
+        if "trace_id" not in event:
+            # one ContextVar read; only ever non-None while a tracer
+            # span is active on this thread/task
+            tid = current_trace_id()
+            if tid is not None:
+                event["trace_id"] = tid
         line = json.dumps(event)  # serialise outside the lock; also
         # rejects non-JSON payloads before they poison the ring
         with self._lock:
@@ -59,16 +70,25 @@ class EventLog:
                 self._fh.write(line + "\n")
         return event
 
-    def tail(self, n: Optional[int] = None) -> List[Dict[str, object]]:
-        """Newest-last copy of the ring (all of it, or the last ``n``)."""
+    def tail(
+        self,
+        n: Optional[int] = None,
+        *,
+        trace_id: Optional[str] = None,
+    ) -> List[Dict[str, object]]:
+        """Newest-last copy of the ring (all of it, or the last ``n``),
+        optionally filtered to one trace's events."""
         with self._lock:
             events = list(self._ring)
+        if trace_id is not None:
+            events = [e for e in events if e.get("trace_id") == trace_id]
         return events if n is None else events[-n:]
 
-    def to_jsonl(self) -> str:
+    def to_jsonl(self, *, trace_id: Optional[str] = None) -> str:
         """The ring as JSONL text (the ``/events`` wire form)."""
-        return "\n".join(json.dumps(e) for e in self.tail()) + (
-            "\n" if len(self._ring) else ""
+        events = self.tail(trace_id=trace_id)
+        return "\n".join(json.dumps(e) for e in events) + (
+            "\n" if events else ""
         )
 
     def close(self) -> None:

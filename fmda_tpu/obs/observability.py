@@ -148,6 +148,16 @@ class Observability:
             capacity=self.config.events_capacity,
             path=self.config.events_path,
         )
+        if self.registry.enabled:
+            # the tracer's e2e_tick_seconds histogram + per-stage
+            # attribution table ride every /snapshot and `status` (empty
+            # while tracing is disabled — the collector is scrape-time
+            # only, zero hot-loop cost)
+            from fmda_tpu.obs.trace import default_tracer, tracer_families
+
+            tracer = default_tracer()
+            self.registry.register_collector(
+                "tracing", lambda: tracer_families(tracer))
         self.clock = clock
         self.checks: Dict[str, HealthCheck] = {}
         self.server = None
@@ -266,6 +276,7 @@ class Observability:
         import logging
 
         from fmda_tpu.obs.server import MetricsServer
+        from fmda_tpu.obs.trace import default_tracer
 
         if self.server is not None:
             requested = port if port is not None else self.config.port
@@ -280,6 +291,7 @@ class Observability:
             port=port if port is not None else self.config.port,
             health_fn=self.health,
             events=self.events,
+            tracer=default_tracer(),
         ).start()
         self.events.emit("obs.server_started", url=self.server.url)
         return self.server

@@ -1,6 +1,6 @@
 """Scrape endpoint: a stdlib ``http.server`` thread serving the plane.
 
-No third-party web framework — four fixed routes on a daemonised
+No third-party web framework — five fixed routes on a daemonised
 :class:`~http.server.ThreadingHTTPServer`:
 
 - ``/metrics``  — Prometheus text exposition of the registry snapshot;
@@ -9,7 +9,14 @@ No third-party web framework — four fixed routes on a daemonised
   expect);
 - ``/snapshot`` — the raw registry snapshot as JSON (what
   ``python -m fmda_tpu status --endpoint`` consumes);
-- ``/events``   — the event ring as JSONL (newest last).
+- ``/events``   — the event ring as JSONL (newest last);
+  ``?trace_id=...`` narrows it to one trace's events;
+- ``/trace``    — the span ring as Chrome/Perfetto ``trace_event`` JSON
+  (load at https://ui.perfetto.dev, or feed
+  ``python -m fmda_tpu trace --endpoint``).
+
+A handler exception yields an HTTP 500 with a JSON ``{"error": ...}``
+body — never a half-written response — and the serving thread survives.
 
 Bind with ``port=0`` for an ephemeral port (tests); :attr:`port` reports
 the bound one.  Request logging goes to the ``fmda_tpu.obs`` logger at
@@ -23,10 +30,12 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+from urllib.parse import parse_qs
 
 from fmda_tpu.obs.events import EventLog
 from fmda_tpu.obs.prometheus import render_prometheus
 from fmda_tpu.obs.registry import MetricsRegistry
+from fmda_tpu.obs.trace import Tracer
 
 log = logging.getLogger("fmda_tpu.obs")
 
@@ -42,10 +51,12 @@ class MetricsServer:
         port: int = 0,
         health_fn: Optional[Callable[[], dict]] = None,
         events: Optional[EventLog] = None,
+        tracer: Optional[Tracer] = None,
     ) -> None:
         self.registry = registry
         self.health_fn = health_fn
         self.events = events
+        self.tracer = tracer
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -59,7 +70,7 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self) -> None:  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path == "/metrics":
                         body = render_prometheus(
@@ -86,16 +97,34 @@ class MetricsServer:
                             "application/json",
                         )
                     elif path == "/events" and server.events is not None:
+                        params = parse_qs(query)
+                        trace_id = params.get("trace_id", [None])[0]
                         self._send(
-                            200, server.events.to_jsonl().encode(),
+                            200,
+                            server.events.to_jsonl(
+                                trace_id=trace_id).encode(),
                             "application/x-ndjson")
+                    elif path == "/trace":
+                        doc = (
+                            server.tracer.chrome()
+                            if server.tracer is not None
+                            else {"traceEvents": []}
+                        )
+                        self._send(
+                            200, json.dumps(doc).encode(),
+                            "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
-                except Exception:  # noqa: BLE001 — a broken scrape must
-                    # never kill the serving thread
+                except Exception as e:  # noqa: BLE001 — a broken scrape
+                    # must never kill the serving thread; the client gets
+                    # a well-formed JSON error body (the body is built
+                    # BEFORE any byte is sent, so a collector blowing up
+                    # can never leave a half-written response on the wire)
                     log.exception("scrape handler failed for %s", self.path)
                     try:
-                        self._send(500, b"internal error\n", "text/plain")
+                        body = json.dumps(
+                            {"error": repr(e), "path": self.path}).encode()
+                        self._send(500, body, "application/json")
                     except Exception:  # noqa: BLE001 — client went away
                         pass
 

@@ -65,6 +65,9 @@ class Tick:
     row: np.ndarray
     t_enqueue: float
     seq: int = 0
+    #: sampled trace root (:class:`fmda_tpu.obs.trace.TraceRef`) begun at
+    #: submit; None when tracing is disabled or the tick was unsampled
+    trace: Optional[object] = None
 
 
 class MicroBatcher:

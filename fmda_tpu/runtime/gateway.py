@@ -15,20 +15,25 @@ One :class:`FleetGateway` owns the serving loop for a fleet of sessions:
   consumption) — the same transport every other stage of the framework
   already speaks.
 
-**The overlap pipeline** (ISSUE 3): dispatching a flush and consuming
-its results are split into :meth:`FleetGateway._dispatch` (stale filter,
-staging-buffer assembly, async ``SessionPool.step_device``) and
-:meth:`FleetGateway._complete` (host transfer, label thresholding, one
-batched bus publish).  ``pump`` runs them one flush apart — while flush
-k's probabilities cross the host boundary and fan out to the bus, flush
-k+1 is already assembled and enqueued on the device.  The pipeline is
-one deep and strictly local to each ``pump`` call: every result a call
-flushed is returned by that call, so the external contract (and the
-numbers) are identical to the serial path — ``pipeline_depth=0`` forces
-serial for A/B tests.  Batch assembly writes into pre-allocated
-per-bucket staging buffers (double-buffered, because a one-deep pipeline
-has at most one prior flush whose dispatch may still read its staging),
-killing the two per-flush array allocations.
+**The overlap pipeline** (ISSUE 3, persistence ISSUE 4): dispatching a
+flush and consuming its results are split into
+:meth:`FleetGateway._dispatch` (stale filter, staging-buffer assembly,
+async ``SessionPool.step_device``) and :meth:`FleetGateway._complete`
+(host transfer, label thresholding, one batched bus publish).  ``pump``
+runs them one flush apart — while flush k's probabilities cross the
+host boundary and fan out to the bus, flush k+1 is already assembled
+and enqueued on the device.  The one-deep pipeline **persists across
+``pump`` calls**: a flush dispatched by this call stays in flight so
+the *next* call's dispatch overlaps it — single-flush-per-pump traffic
+(the steady-state serving loop) overlaps too, not just multi-flush
+drains.  Consequently ``pump`` returns every result *completed* this
+call; the trailing flush's results arrive on the next ``pump`` (an idle
+pump — nothing new to dispatch — flushes the pipeline) or on
+:meth:`drain`.  ``pipeline_depth=0`` forces strictly serial same-call
+results, the bit-identical A/B reference.  Batch assembly writes into
+pre-allocated per-bucket staging buffers (double-buffered, because a
+one-deep pipeline has at most one prior flush whose dispatch may still
+read its staging), killing the two per-flush array allocations.
 
 Every tick's journey is measured (enqueue→dispatch→device→publish
 histograms in :class:`~fmda_tpu.runtime.metrics.RuntimeMetrics`); every
@@ -36,6 +41,12 @@ loss path is a counter, never a silent drop.  Under overlap, ``device``
 measures the time ``_complete`` spends *blocked* on the transfer —
 overlapped device work hides inside the preceding ``dispatch``/
 ``publish`` wall clock, which is the point.
+
+When the process tracer (:mod:`fmda_tpu.obs.trace`) is enabled, sampled
+ticks get a full trace: a root span begun at :meth:`submit` plus
+queued/dispatch/device/publish child spans that tile it exactly, and
+the result message carries the tick's ``trace`` context in-band.
+Disabled tracing costs one branch per submit and per flush.
 """
 
 from __future__ import annotations
@@ -53,6 +64,7 @@ from fmda_tpu.config import (
     TOPIC_FLEET_PREDICTION,
 )
 from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.obs.trace import default_tracer, now_ns
 from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
 from fmda_tpu.runtime.metrics import RuntimeMetrics
 from fmda_tpu.runtime.session_pool import (
@@ -83,6 +95,10 @@ class _InFlight:
     live: List[Tick]
     probs_dev: object  # (bucket, n_classes) device array
     bucket: int
+    #: perf_counter_ns stamps of the dispatch window (0 when untraced) —
+    #: the queued/dispatch span boundaries for this flush's traced ticks
+    t_dispatch_ns: int = 0
+    t_dispatched_ns: int = 0
 
 
 class FleetGateway:
@@ -144,6 +160,17 @@ class FleetGateway:
         self._staging_idx: Dict[int, int] = {}
         self._publish_many = (
             getattr(bus, "publish_many", None) if bus is not None else None)
+        #: the cross-pump in-flight flush (the persistent one-deep
+        #: pipeline; always None when pipeline_depth == 0)
+        self._inflight: Optional[_InFlight] = None
+        #: span recorder (fmda_tpu.obs.trace) — process-default tracer,
+        #: captured once; disabled = one branch per submit/flush
+        self._tracer = default_tracer()
+        #: opt-in jax.profiler.StepTraceAnnotation around each pool step
+        #: dispatch, so device-side work lands in a --jax-profile capture
+        #: as numbered pool_flush steps (serve-fleet --jax-profile DIR)
+        self.annotate_device_steps = False
+        self._flush_idx = 0
 
     # -- admission ----------------------------------------------------------
 
@@ -206,8 +233,12 @@ class FleetGateway:
                     self.queue_bound, shed.handle.session_id, shed.seq, n)
         seq = self._seq.get(session_id, 0)
         self._seq[session_id] = seq + 1
+        # one branch when tracing is off; when sampled, the returned ref
+        # is this tick's trace root, closed at publish in _complete
+        ref = self._tracer.maybe_trace()
         self.batcher.add(Tick(
-            handle=handle, row=row, t_enqueue=self.clock(), seq=seq))
+            handle=handle, row=row, t_enqueue=self.clock(), seq=seq,
+            trace=ref))
         self.metrics.gauge("queue_depth", len(self.batcher))
         return seq
 
@@ -221,18 +252,24 @@ class FleetGateway:
 
     def pump(self, *, force: bool = False) -> List[FleetResult]:
         """Flush ready micro-batches (all pending ones when ``force`` —
-        the drain path).  Returns every result served this call; each is
-        also published on the bus when one is attached.
+        the drain path).  Returns every result *completed* this call;
+        each is also published on the bus when one is attached.
 
         Consecutive flushes run through the one-deep overlap pipeline:
         flush k+1 is assembled and dispatched *before* flush k's
         probabilities are pulled to the host and published, so the
         device computes k+1 while the host finishes k.  The pipeline
-        never outlives the call — the final in-flight flush is completed
-        before returning, so callers see exactly the serial contract.
+        **persists across calls** (ROADMAP runtime follow-up): the last
+        flush this call dispatches stays in flight, to be completed
+        right after the *next* call's first dispatch — so steady-state
+        single-flush-per-pump traffic overlaps too.  A pump that
+        dispatches nothing completes the pending flush (result latency
+        stays bounded by the pump cadence), ``force`` completes
+        everything, and ``pipeline_depth=0`` keeps the strictly serial
+        same-call contract (the bit-identical A/B reference).
         """
         results: List[FleetResult] = []
-        inflight: Optional[_InFlight] = None
+        dispatched_any = False
         try:
             while True:
                 if force:
@@ -244,32 +281,39 @@ class FleetGateway:
                 if not ticks:
                     break
                 nxt = self._dispatch(ticks)
+                if nxt is not None:
+                    dispatched_any = True
                 # hand the previous flush off BEFORE completing it, so a
                 # completion failure can never strand the just-dispatched
                 # one (its state advance is already irreversible)
-                prev, inflight = inflight, nxt
+                prev, self._inflight = self._inflight, nxt
                 if prev is not None:
                     if nxt is not None:
                         self.metrics.count("overlapped_flushes")
                     results.extend(self._complete_counted(prev))
-                if self.pipeline_depth == 0 and inflight is not None:
-                    prev, inflight = inflight, None
+                if self.pipeline_depth == 0 and self._inflight is not None:
+                    prev, self._inflight = self._inflight, None
                     results.extend(self._complete_counted(prev))
-            if inflight is not None:  # drain the trailing in-flight flush
-                prev, inflight = inflight, None
+            if self._inflight is not None and (force or not dispatched_any):
+                # force-drain, or an idle pump with a leftover in-flight
+                # flush from a previous call: flush the pipeline now
+                prev, self._inflight = self._inflight, None
                 results.extend(self._complete_counted(prev))
-        finally:
-            # reached with a live in-flight only when unwinding an
-            # exception: the flush's pool-state advance already happened,
-            # so its results must still be published (consumers stay
-            # consistent with the recurrence) — and if even that fails,
-            # _complete_counted made the loss a counter, never silence
-            if inflight is not None:
+        except BaseException:
+            # unwinding an exception with a live in-flight flush: its
+            # pool-state advance already happened, so its results must
+            # still be published (consumers stay consistent with the
+            # recurrence) — and if even that fails, _complete_counted
+            # made the loss a counter, never silence
+            if self._inflight is not None:
+                prev, self._inflight = self._inflight, None
                 try:
-                    results.extend(self._complete_counted(inflight))
+                    self._complete_counted(prev)
                 except Exception:  # noqa: BLE001 — don't mask the unwind
                     log.exception(
                         "in-flight flush lost while unwinding pump failure")
+            raise
+        finally:
             self.metrics.gauge("queue_depth", len(self.batcher))
         return results
 
@@ -311,6 +355,8 @@ class FleetGateway:
         staging buffers, enqueue the pool step on the device.  Returns
         the in-flight record (None if every tick went stale in queue)."""
         t_dispatch = self.clock()
+        tracing = self._tracer.enabled
+        t_dispatch_ns = now_ns() if tracing else 0
         live = []
         for tick in ticks:
             # a session freed while its tick was queued: drop, visibly
@@ -330,9 +376,17 @@ class FleetGateway:
         # slot, state nothing reads) — but their slot entries MUST be
         # re-pointed at the padding lane
         slots[len(live):] = self.pool.padding_slot
+        self._flush_idx += 1
         with self.metrics.timer.stage("dispatch"):
-            probs_dev = self.pool.step_device(slots, rows)  # async enqueue
+            if self.annotate_device_steps:
+                from fmda_tpu.utils.tracing import step_annotation
+
+                with step_annotation("pool_flush", self._flush_idx):
+                    probs_dev = self.pool.step_device(slots, rows)
+            else:
+                probs_dev = self.pool.step_device(slots, rows)  # async
         t_dispatched = self.clock()
+        t_dispatched_ns = now_ns() if tracing else 0
 
         m = self.metrics
         m.count("flushes")
@@ -341,36 +395,57 @@ class FleetGateway:
         m.observe("dispatch", t_dispatched - t_dispatch)
         for tick in live:
             m.observe("enqueue_to_dispatch", t_dispatch - tick.t_enqueue)
-        return _InFlight(live=live, probs_dev=probs_dev, bucket=bucket)
+        return _InFlight(
+            live=live, probs_dev=probs_dev, bucket=bucket,
+            t_dispatch_ns=t_dispatch_ns, t_dispatched_ns=t_dispatched_ns)
 
     def _complete(self, inflight: _InFlight) -> List[FleetResult]:
         """Stage 2 of a flush: force the host transfer, threshold labels,
         publish the whole flush in one batched bus call."""
+        tracing = self._tracer.enabled
         t_synced = self.clock()
         with self.metrics.timer.stage("device"):
             probs = np.asarray(inflight.probs_dev)  # blocks: host array
         t_device = self.clock()
+        t_device_ns = now_ns() if tracing else 0
 
         results = []
         messages = [] if self.bus is not None else None
+        t_pub0_ns = 0
         with self.metrics.timer.stage("publish"):
             for i, tick in enumerate(inflight.live):
+                # the persistent pipeline lets close_session (and a
+                # same-id reopen, which restarts seq at 0) run between
+                # dispatch and completion — publishing the dead
+                # incarnation's result would interleave a colliding
+                # (session, seq) into the new stream.  Same "freed
+                # session's ticks drop, visibly" invariant as dispatch,
+                # at the completion boundary.
+                if not self.pool.is_live(tick.handle):
+                    self.metrics.count("stale_results_dropped")
+                    continue
                 p = probs[i]
                 _, labels = labels_over_threshold(
                     p, self.threshold, self.y_fields)
                 results.append(FleetResult(
                     tick.handle.session_id, tick.seq, p, labels))
                 if messages is not None:
-                    messages.append({
+                    msg = {
                         "session": tick.handle.session_id,
                         "seq": tick.seq,
                         "probabilities": [float(v) for v in p],
                         "pred_labels": list(labels),
                         "prob_threshold": self.threshold,
-                    })
+                    }
+                    if tick.trace is not None:
+                        # the tick's own context in-band, so downstream
+                        # consumers stitch into the same trace
+                        msg["trace"] = tick.trace.wire
+                    messages.append(msg)
             if messages:
                 # one batched publish per flush: one lock acquisition /
                 # native call sequence instead of per-tick bus overhead
+                t_pub0_ns = now_ns() if tracing else 0
                 if self._publish_many is not None:
                     self._publish_many(self.prediction_topic, messages)
                 else:
@@ -379,9 +454,46 @@ class FleetGateway:
         t_publish = self.clock()
 
         m = self.metrics
-        m.count("ticks_served", len(inflight.live))
+        m.count("ticks_served", len(results))
         m.observe("device", t_device - t_synced)
         m.observe("publish", t_publish - t_device)
         for tick in inflight.live:
             m.observe("total", t_publish - tick.t_enqueue)
+        if tracing:
+            self._record_flush_spans(inflight, t_device_ns, t_pub0_ns)
         return results
+
+    def _record_flush_spans(
+        self, inflight: _InFlight, t_device_ns: int, t_pub0_ns: int
+    ) -> None:
+        """Close the trace of every sampled tick in a completed flush.
+
+        The four children tile the root exactly — queued [submit →
+        dispatch start], dispatch [assembly + async enqueue], device
+        [enqueue return → results on host; under the persistent overlap
+        pipeline this is where the hidden device/pipeline wait lives],
+        publish [thresholding + batched bus publish] — so a trace's
+        stage breakdown sums to its e2e duration by construction
+        (`python -m fmda_tpu trace`, docs/OPERATIONS.md §4d).
+        """
+        if not inflight.t_dispatch_ns:
+            return  # dispatched before tracing was enabled: no timeline
+        tr = self._tracer
+        t_publish_ns = now_ns()
+        for tick in inflight.live:
+            ref = tick.trace
+            if ref is None:
+                continue
+            tid, root = ref.trace_id, ref.span_id
+            tr.add_span(tid, root, "queued", "gateway",
+                        ref.t0_ns, inflight.t_dispatch_ns)
+            tr.add_span(tid, root, "dispatch", "gateway",
+                        inflight.t_dispatch_ns, inflight.t_dispatched_ns)
+            tr.add_span(tid, root, "device", "engine",
+                        inflight.t_dispatched_ns, t_device_ns)
+            pub = tr.add_span(tid, root, "publish", "publish",
+                              t_device_ns, t_publish_ns)
+            if t_pub0_ns:
+                tr.add_span(tid, pub, "bus_publish", "bus",
+                            t_pub0_ns, t_publish_ns)
+            tr.finish_root(ref, "tick", "ingest", t_publish_ns)

@@ -32,6 +32,7 @@ import numpy as np
 from fmda_tpu.config import TARGET_COLUMNS, TOPIC_PREDICT_TIMESTAMP, TOPIC_PREDICTION, ModelConfig
 from fmda_tpu.data.normalize import NormParams
 from fmda_tpu.models import build_model
+from fmda_tpu.obs.trace import default_tracer, now_ns
 from fmda_tpu.stream.bus import MessageBus
 from fmda_tpu.stream.warehouse import Warehouse
 from fmda_tpu.utils.timeutils import get_timezone, parse_ts
@@ -146,9 +147,15 @@ class Predictor:
         age = (self.now_fn() - parse_ts(ts_str)).total_seconds()
         return age > self.max_staleness_s
 
-    def predict_for_timestamp(self, ts_str: str) -> Optional[Prediction]:
+    def predict_for_timestamp(
+        self, ts_str: str, trace: Optional[str] = None
+    ) -> Optional[Prediction]:
         """Run inference for one landed row; None if the row/window is not
-        servable (missing row or not enough history)."""
+        servable (missing row or not enough history).  ``trace`` is the
+        signal's in-band trace context: the serve stage is recorded as a
+        span on it and the prediction message carries it onward."""
+        tracer = default_tracer()
+        t0_ns = now_ns() if (trace is not None and tracer.enabled) else 0
         row_id = self.warehouse.id_for_timestamp(ts_str)
         if row_id is None:
             log.warning("no warehouse row for signal %s", ts_str)
@@ -171,16 +178,18 @@ class Predictor:
             labels=labels,
             label_indices=idx,
         )
-        self.bus.publish(
-            self.prediction_topic,
-            {
-                "timestamp": pred.timestamp,
-                "probabilities": list(pred.probabilities),
-                "prob_threshold": pred.threshold,
-                "pred_indices": list(pred.label_indices),
-                "pred_labels": list(pred.labels),
-            },
-        )
+        msg = {
+            "timestamp": pred.timestamp,
+            "probabilities": list(pred.probabilities),
+            "prob_threshold": pred.threshold,
+            "pred_indices": list(pred.label_indices),
+            "pred_labels": list(pred.labels),
+        }
+        if trace is not None:
+            msg["trace"] = trace
+        self.bus.publish(self.prediction_topic, msg)
+        if t0_ns:
+            tracer.add_span_wire(trace, "serve", "serve", t0_ns, now_ns())
         return pred
 
     def poll(self) -> List[Prediction]:
@@ -194,7 +203,8 @@ class Predictor:
             if self._is_stale(ts_str):
                 log.warning("dropping stale signal %s", ts_str)
                 continue
-            pred = self.predict_for_timestamp(ts_str)
+            pred = self.predict_for_timestamp(
+                ts_str, trace=rec.value.get("trace"))
             if pred is not None:
                 out.append(pred)
                 log.info(

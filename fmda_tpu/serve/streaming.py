@@ -408,13 +408,20 @@ class StreamingPredictor:
 
         Rows are consumed strictly in id order; if signals skipped rows
         (e.g. predictor started mid-session), the gap rows are fed through
-        the recurrence first so the carried state stays exact.
+        the recurrence first so the carried state stays exact.  A signal
+        carrying an in-band trace context gets a ``serve`` span recorded
+        on it and the context propagated onto the prediction message.
         """
+        from fmda_tpu.obs.trace import default_tracer, now_ns
+
+        tracer = default_tracer()
         out = []
         for rec in self._consumer.poll():
             ts = rec.value.get("Timestamp")
             if not ts:
                 continue
+            trace = rec.value.get("trace")
+            t0_ns = now_ns() if (trace is not None and tracer.enabled) else 0
             row_id = self.warehouse.id_for_timestamp(ts)
             if row_id is None or row_id <= self._last_row_id:
                 continue
@@ -433,15 +440,17 @@ class StreamingPredictor:
             self._last_row_id = row_id
             idx, labels = labels_over_threshold(
                 probs, self.threshold, self.y_fields)
-            self.bus.publish(
-                self.prediction_topic,
-                {
-                    "timestamp": ts,
-                    "probabilities": [float(p) for p in probs],
-                    "prob_threshold": self.threshold,
-                    "pred_indices": list(idx),
-                    "pred_labels": list(labels),
-                },
-            )
+            msg = {
+                "timestamp": ts,
+                "probabilities": [float(p) for p in probs],
+                "prob_threshold": self.threshold,
+                "pred_indices": list(idx),
+                "pred_labels": list(labels),
+            }
+            if trace is not None:
+                msg["trace"] = trace
+            self.bus.publish(self.prediction_topic, msg)
+            if t0_ns:
+                tracer.add_span_wire(trace, "serve", "serve", t0_ns, now_ns())
             out.append((ts, probs, labels))
         return out

@@ -15,6 +15,12 @@ no external processes:
 
 Values are JSON-serialisable dicts, matching the reference's
 ``json.dumps(...).encode('utf-8')`` value serializer.
+
+Trace context (:mod:`fmda_tpu.obs.trace`) rides **in-band**: a compact
+``trace`` field stamped into the value dict on publish when a trace is
+active, carried through every backend's JSON round-trip, read back by
+consumers via ``record.value.get("trace")``.  With tracing disabled the
+publish hot path pays exactly one branch.
 """
 
 from __future__ import annotations
@@ -23,6 +29,11 @@ import json
 import threading
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Protocol, Sequence
+
+from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
+
+#: Captured once — configure_tracing mutates this singleton in place.
+_TRACER = default_tracer()
 
 
 @dataclass(frozen=True)
@@ -134,6 +145,13 @@ class InProcessBus:
             )
 
     def publish(self, topic: str, value: dict) -> int:
+        if _TRACER.enabled:  # in-band trace context + a bus-stage span
+            value = stamp_message(value)
+            with _TRACER.span("bus_publish", "bus"):
+                return self._publish(topic, value)
+        return self._publish(topic, value)
+
+    def _publish(self, topic: str, value: dict) -> int:
         # round-trip through JSON to enforce serialisability (and decouple
         # the stored value from caller-side mutation), like a real broker
         value = json.loads(json.dumps(value))
@@ -154,7 +172,11 @@ class InProcessBus:
     def publish_many(self, topic: str, values) -> List[int]:
         """Batched :meth:`publish`: one JSON round-trip and one lock
         acquisition for the whole batch (the fleet gateway's per-flush
-        publish path)."""
+        publish path).  Per-message ``trace`` fields (the gateway stamps
+        each tick's own context) pass through untouched; messages
+        without one inherit the active context."""
+        if _TRACER.enabled:
+            values = stamp_messages(values)
         values = json.loads(json.dumps(list(values)))
         if not values:
             return []

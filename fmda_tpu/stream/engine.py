@@ -49,6 +49,7 @@ from fmda_tpu.config import (
     TOPIC_VIX,
     TOPIC_VOLUME,
 )
+from fmda_tpu.obs.trace import default_tracer, now_ns
 from fmda_tpu.ops.microstructure import deep_features, wick_percentage
 from fmda_tpu.stream.bus import MessageBus
 from fmda_tpu.stream.warehouse import Warehouse
@@ -63,6 +64,10 @@ class _Event:
     ts: int  # epoch seconds
     ts_str: str
     payload: Dict[str, float]
+    #: in-band trace context of the message that produced this event
+    #: (deep/book events only — the book tick IS the traced entity);
+    #: None when the producer wasn't tracing
+    trace: Optional[str] = None
 
 
 @dataclass
@@ -354,6 +359,9 @@ class StreamEngine:
             metrics.histogram("engine_step_seconds")
             if metrics is not None else None
         )
+        #: span recorder (fmda_tpu.obs.trace) — the process-default
+        #: tracer, captured once; disabled = one branch per step
+        self._tracer = default_tracer()
         if checkpoint_path and os.path.exists(checkpoint_path):
             self.restore()
 
@@ -366,18 +374,22 @@ class StreamEngine:
         fc = self.features
         polled_any = False
         raws = []
+        wires = []  # in-band trace contexts, aligned with raws
         for rec in self._consumers[TOPIC_DEEP].poll():
             polled_any = True
             try:
-                raws.append(
-                    _extract_deep_raw(rec.value, self._deep_keys)
-                )
+                raw = _extract_deep_raw(rec.value, self._deep_keys)
             except (KeyError, ValueError, TypeError, AttributeError) as e:
                 # AttributeError: a nested level that should be a dict is a
                 # scalar — malformed producer output, not a crash
                 log.warning("bad deep message at offset %d: %s", rec.offset, e)
+                continue
+            raws.append(raw)
+            wires.append(rec.value.get("trace"))
         try:
             deep_events = _parse_deep_batch(raws)
+            for event, wire in zip(deep_events, wires):
+                event.trace = wire
         except (KeyError, ValueError, TypeError, AttributeError) as e:
             # one pathological message that survived extraction must not
             # abort the whole poll's batch — fall back to per-message
@@ -385,11 +397,15 @@ class StreamEngine:
             log.warning(
                 "batched deep parse failed (%s); retrying per-message", e)
             deep_events = []
-            for raw in raws:
+            for raw, wire in zip(raws, wires):
                 try:
-                    deep_events.extend(_parse_deep_batch([raw]))
+                    parsed = _parse_deep_batch([raw])
                 except (KeyError, ValueError, TypeError, AttributeError) as e2:
                     log.warning("bad deep message %s dropped: %s", raw[0], e2)
+                    continue
+                for event in parsed:
+                    event.trace = wire
+                deep_events.extend(parsed)
         for event in deep_events:
             bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
             self._max_deep_ts = max(self._max_deep_ts, event.ts)
@@ -428,14 +444,19 @@ class StreamEngine:
 
     def _step(self) -> int:
         fc = self.features
+        tr = self._tracer
+        tracing = tr.enabled  # one branch; ns stamps only when tracing
+        t_step0_ns = now_ns() if tracing else 0
         with self.timer.stage("ingest"):
             polled_any = self._ingest()
         emitted_rows: List[Dict[str, float]] = []
         still_pending: List[_Event] = []
+        #: Timestamp -> in-band trace context for rows emitted this step
+        row_traces: Dict[str, str] = {}
 
         with self.timer.stage("join"):
             if self._core is not None:
-                emitted_rows, still_pending = self._join_native()
+                emitted_rows, still_pending = self._join_native(row_traces)
             else:
                 for deep_ev in self._pending_deep:  # insertion-sorted by ts
                     matches: Dict[str, _Event] = {}
@@ -468,8 +489,11 @@ class StreamEngine:
                         for m in matches.values():
                             row.update(m.payload)
                         emitted_rows.append(row)
+                        if deep_ev.trace is not None:
+                            row_traces[deep_ev.ts_str] = deep_ev.trace
 
         self._pending_deep = still_pending
+        t_join_ns = now_ns() if tracing else 0
 
         # one output row per book tick (dropDuplicates intent,
         # spark_consumer.py:477): a tick whose timestamp already landed —
@@ -499,18 +523,41 @@ class StreamEngine:
                 )
             emitted_rows = fresh
         if emitted_rows:
+            t_land0_ns = now_ns() if tracing else 0
             with self.timer.stage("land"):
                 self.warehouse.insert_rows(emitted_rows)
+            t_land1_ns = now_ns() if tracing else 0
             # mark landed / signal AFTER the write commits: no
             # sleep-and-retry race, no phantom dedupe entry on a failed
             # insert
             with self.timer.stage("signal"):
                 for row in emitted_rows:
                     self._landed_ts.add(row["Timestamp"])
-                    self.bus.publish(
-                        self.signal_topic, {"Timestamp": row["Timestamp"]}
-                    )
+                    msg: Dict[str, object] = {"Timestamp": row["Timestamp"]}
+                    if row_traces:
+                        # propagate the book tick's trace context onto
+                        # the signal, so serving stitches into its trace
+                        wire = row_traces.get(row["Timestamp"])
+                        if wire is not None:
+                            msg["trace"] = wire
+                    self.bus.publish(self.signal_topic, msg)
             self._emitted += len(emitted_rows)
+            if tracing and row_traces:
+                # per-landed-row stage attribution on the producer's
+                # trace: the step's measured boundaries, one span triple
+                # per traced row (join covers poll+match for the step
+                # that emitted the row)
+                t_sig1_ns = now_ns()
+                for row in emitted_rows:
+                    wire = row_traces.get(row["Timestamp"])
+                    if wire is None:
+                        continue
+                    tr.add_span_wire(
+                        wire, "join", "engine", t_step0_ns, t_join_ns)
+                    tr.add_span_wire(
+                        wire, "land", "warehouse", t_land0_ns, t_land1_ns)
+                    tr.add_span_wire(
+                        wire, "signal", "bus", t_land1_ns, t_sig1_ns)
 
         # bound buffer state by the global watermark
         horizon = min(
@@ -556,7 +603,9 @@ class StreamEngine:
             "no such event (state divergence)"
         )
 
-    def _join_native(self) -> Tuple[List[Dict[str, float]], List[_Event]]:
+    def _join_native(
+        self, row_traces: Optional[Dict[str, str]] = None
+    ) -> Tuple[List[Dict[str, float]], List[_Event]]:
         """Join decisions from the C++ scheduler; payload assembly here."""
         from collections import defaultdict
 
@@ -579,6 +628,8 @@ class StreamEngine:
             for i, topic in enumerate(self._stream_topics):
                 row.update(self._find_side_event(topic, tup[1 + i]).payload)
             rows.append(row)
+            if row_traces is not None and deep_ev.trace is not None:
+                row_traces[deep_ev.ts_str] = deep_ev.trace
         still_pending = [
             e
             for e in self._pending_deep
@@ -641,7 +692,10 @@ class StreamEngine:
         for a join match across a restart."""
 
         def dump_event(e: _Event) -> dict:
-            return {"ts": e.ts, "ts_str": e.ts_str, "payload": e.payload}
+            d = {"ts": e.ts, "ts_str": e.ts_str, "payload": e.payload}
+            if e.trace is not None:  # keep checkpoints small when untraced
+                d["trace"] = e.trace
+            return d
 
         state = {
             "offsets": {t: c.offset for t, c in self._consumers.items()},
@@ -669,7 +723,8 @@ class StreamEngine:
             state = json.load(fh)
 
         def load_event(d: dict) -> _Event:
-            return _Event(d["ts"], d["ts_str"], d["payload"])
+            return _Event(d["ts"], d["ts_str"], d["payload"],
+                          trace=d.get("trace"))
 
         for topic, offset in state["offsets"].items():
             if topic in self._consumers:

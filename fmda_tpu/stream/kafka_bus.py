@@ -14,9 +14,12 @@ import json
 import logging
 from typing import Iterable, List, Optional, Sequence
 
+from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
 from fmda_tpu.stream.bus import Consumer, Record
 
 log = logging.getLogger("fmda_tpu.stream")
+
+_TRACER = default_tracer()
 
 
 class KafkaBus:
@@ -60,6 +63,8 @@ class KafkaBus:
 
     def publish(self, topic: str, value: dict) -> int:
         self._check(topic)
+        if _TRACER.enabled:  # in-band trace context (fmda_tpu.obs.trace)
+            value = stamp_message(value)
         future = self._producer.send(topic, value=value)
         meta = future.get(timeout=30)
         return meta.offset
@@ -67,8 +72,11 @@ class KafkaBus:
     def publish_many(self, topic: str, values) -> List[int]:
         """Batched publish: all sends enter the producer's buffer before
         any ack is awaited, so the batch rides the broker round-trip
-        once instead of once per record."""
+        once instead of once per record.  Messages without their own
+        ``trace`` field inherit the active trace context."""
         self._check(topic)
+        if _TRACER.enabled:
+            values = stamp_messages(values)
         futures = [self._producer.send(topic, value=v) for v in values]
         return [f.get(timeout=30).offset for f in futures]
 

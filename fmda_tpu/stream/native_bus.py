@@ -15,10 +15,13 @@ import json
 import logging
 from typing import Iterable, List, Optional, Sequence
 
+from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
 from fmda_tpu.stream._native import build_and_load
 from fmda_tpu.stream.bus import Consumer, Record
 
 log = logging.getLogger("fmda_tpu.stream")
+
+_TRACER = default_tracer()
 
 
 class NativeBusUnavailable(RuntimeError):
@@ -149,6 +152,8 @@ class NativeBus:
         return offset
 
     def publish(self, topic: str, value: dict) -> int:
+        if _TRACER.enabled:  # in-band trace context (fmda_tpu.obs.trace)
+            value = stamp_message(value)
         offset = self._publish_one(self._tid(topic), topic, value)
         if self._publish_counters is not None:
             self._publish_counters[topic].inc()
@@ -157,7 +162,10 @@ class NativeBus:
     def publish_many(self, topic: str, values) -> List[int]:
         """Batched :meth:`publish`: the topic id is resolved and the
         metrics counter bumped once for the whole batch; records land in
-        the C++ log in order."""
+        the C++ log in order.  Messages without their own ``trace``
+        field inherit the active trace context."""
+        if _TRACER.enabled:
+            values = stamp_messages(values)
         tid = self._tid(topic)
         offsets = [self._publish_one(tid, topic, v) for v in values]
         if self._publish_counters is not None and offsets:

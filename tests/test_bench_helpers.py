@@ -68,6 +68,7 @@ class TestPhaseRegistry:
             "replay",
             "runtime_fleet_smoke",
             "obs_overhead",
+            "trace_overhead",
         }
         assert expected == set(bench._PHASES)
 

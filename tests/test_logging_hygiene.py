@@ -84,3 +84,46 @@ def test_allowlisted_modules_exist():
     # allowlist, not silently stop checking a path that no longer exists
     for rel in ALLOWLIST:
         assert (PACKAGE_DIR / rel).is_file(), f"stale allowlist entry {rel}"
+
+
+#: span-recording code, relative to the package root — everywhere span
+#: timestamps are minted (ISSUE 4 satellite)
+SPAN_CODE = {"obs/trace.py"}
+
+
+def _time_time_calls(path: pathlib.Path):
+    """Every ``time.time(...)`` / ``from time import time`` call site."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = path.relative_to(PACKAGE_DIR)
+    found = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (isinstance(fn, ast.Attribute) and fn.attr == "time"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("time", "_time")):
+            found.append(f"{rel}:{node.lineno}: time.time() call")
+        elif isinstance(fn, ast.Name) and fn.id == "time":
+            found.append(f"{rel}:{node.lineno}: bare time() call")
+    return found
+
+
+def test_span_code_never_uses_wall_clock():
+    """Span timestamps must come from ``time.perf_counter_ns`` —
+    monotonic and ns-resolution, so a mid-run NTP step can never fold a
+    trace back on itself or make stage durations negative.  Enforced
+    statically over the span-recording modules: a ``time.time()`` call
+    there fails tier-1 the commit it appears."""
+    violations = []
+    for rel in sorted(SPAN_CODE):
+        path = PACKAGE_DIR / rel
+        assert path.is_file(), f"stale SPAN_CODE entry {rel}"
+        violations.extend(_time_time_calls(path))
+    assert not violations, (
+        "span code must use time.perf_counter_ns, never time.time():\n"
+        + "\n".join(violations)
+    )
+    # and the sanctioned clock is actually present
+    text = (PACKAGE_DIR / "obs/trace.py").read_text()
+    assert "perf_counter_ns" in text

@@ -264,15 +264,20 @@ def test_small_fleet_flushes_without_linger_wait():
     for i in range(5):
         gw.submit(f"T{i}", rng.normal(size=cfg.n_features))
     # zero clock advance, linger budget untouched: all 5 pending == all
-    # 5 active -> batch-full semantics, one padded bucket-8 flush
-    res = gw.pump()
-    assert len(res) == 5
+    # 5 active -> batch-full semantics, one padded bucket-8 flush is
+    # DISPATCHED immediately (no linger wait) and stays in flight; the
+    # next (idle) pump completes it — the persistent overlap contract
+    assert gw.pump() == []
     assert gw.metrics.counters["flushes_bucket_8"] == 1
+    assert len(gw.pump()) == 5
     # a PARTIAL round (3 of 5) still waits for the deadline
     for i in range(3):
         gw.submit(f"T{i}", rng.normal(size=cfg.n_features))
     assert gw.pump() == []
+    assert gw.metrics.counters["flushes"] == 1  # nothing new dispatched
     clock.advance(100.0)
+    assert gw.pump() == []  # deadline flush dispatched, in flight
+    assert gw.metrics.counters["flushes"] == 2
     assert len(gw.pump()) == 3
 
 
@@ -484,11 +489,21 @@ def test_64_sessions_through_one_compiled_step():
         gw.open_session(f"T{i:03d}")
     rng = np.random.default_rng(7)
     rounds = 3
-    for _ in range(rounds):
+    served = 0
+    for k in range(rounds):
         rows = rng.normal(size=(n, feats)).astype(np.float32)
         for i in range(n):
             gw.submit(f"T{i:03d}", rows[i])
-        assert len(gw.pump()) == n  # batch-full -> one flush serves all
+        # batch-full -> one flush dispatched per round; under the
+        # persistent overlap pipeline each round's pump completes the
+        # PREVIOUS round's flush (round k dispatches while k-1 transfers)
+        res = gw.pump()
+        served += len(res)
+        assert len(res) == (0 if k == 0 else n)
+    served += len(gw.drain())
+    assert served == n * rounds
+    # rounds 2..N overlapped the prior round's in-flight flush
+    assert gw.metrics.counters["overlapped_flushes"] == rounds - 1
     assert pool.compile_count == 1
     assert gw.metrics.counters["flushes"] == rounds
     assert gw.metrics.counters["ticks_served"] == n * rounds
