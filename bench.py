@@ -816,8 +816,9 @@ def phase_serving() -> dict:
     def pred_window_fn(n: int) -> float:
         t0 = time.perf_counter()
         for _ in range(n):
-            probs = pred_core._forward(pred_core._params, xw)
-        float(probs[0])
+            probs = pred_core._forward(
+                pred_core._params, pred_core._x_min, pred_core._x_range, xw)
+        float(probs[0, 0])
         return time.perf_counter() - t0
 
     pred_window_fn(4)
@@ -1224,6 +1225,129 @@ def phase_runtime_fleet() -> dict:
     return result
 
 
+def phase_predictor_fleet() -> dict:
+    """Batched-Predictor smoke + latency-SLO gate (ISSUE 5): the
+    window-re-scan serving path multiplexed onto the fleet runtime
+    (fmda_tpu.runtime.predictor_pool) vs the serial solo Predictor loop
+    over the same warehouse, model, and signals — signals/s both ways,
+    the speedup headline (acceptance: >= 2x on a quiet host), and
+    compile_count == len(buckets).
+
+    The SLO gate mirrors runtime_fleet_smoke's: total (submit→publish)
+    p99 must stay under ``FMDA_PREDICTOR_SLO_P99_MS`` (default 250 —
+    the batched window forward is O(window·F) device work per signal,
+    an order heavier than the carried-state tick).  Violations on a
+    quiet host error the phase; a loaded host or ``--slo-soft`` /
+    ``FMDA_FLEET_SLO_SOFT=1`` downgrades to report-only."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.config import (
+        DEFAULT_TOPICS, FeatureConfig, ModelConfig, WarehouseConfig)
+    from fmda_tpu.data.normalize import NormParams
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+    from fmda_tpu.models import build_model
+    from fmda_tpu.runtime import (
+        BatcherConfig, PredictorGateway, PredictorLoadConfig, PredictorPool,
+        run_predictor_load)
+    from fmda_tpu.serve.predictor import Predictor
+    from fmda_tpu.stream import InProcessBus
+
+    buckets = (8, 32)
+    fc = FeatureConfig()
+    wh, _ = build_corpus(
+        fc, SyntheticMarketConfig(seed=1, n_days=4),
+        warehouse_config=WarehouseConfig(path=":memory:"))
+    feats = len(wh.x_fields)
+    cfg = ModelConfig(hidden_size=HIDDEN, n_features=feats,
+                      output_size=CLASSES, dropout=0.0,
+                      bidirectional=True, use_pallas=False)
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, WINDOW, feats)))["params"]
+    norm = NormParams(np.zeros(feats, np.float32),
+                      np.ones(feats, np.float32))
+    timestamps = wh.timestamps()[WINDOW - 1:]
+
+    # serial reference: the solo Predictor loop, one signal at a time
+    solo = Predictor(
+        InProcessBus(DEFAULT_TOPICS), wh, cfg, params, norm,
+        window=WINDOW, max_staleness_s=None)
+    for ts in timestamps[:3]:
+        solo.predict_for_timestamp(ts)  # warm compile + sqlite cache
+    t0 = _time.perf_counter()
+    for ts in timestamps:
+        solo.predict_for_timestamp(ts)
+    serial_wall = _time.perf_counter() - t0
+    serial_per_s = len(timestamps) / serial_wall if serial_wall > 0 else 0.0
+
+    # batched gateway over the SAME warehouse/model/signals
+    pool = PredictorPool(cfg, params, norm, window=WINDOW)
+    gateway = PredictorGateway(
+        pool, InProcessBus(DEFAULT_TOPICS), wh,
+        batcher_config=BatcherConfig(bucket_sizes=buckets,
+                                     max_linger_s=0.002),
+        max_staleness_s=None)
+    for b in buckets:  # precompile: the loop prices the steady state
+        pool.forward(np.zeros((b, WINDOW, feats), np.float32))
+    assert pool.compile_count == len(buckets)
+    out = run_predictor_load(
+        gateway, timestamps, PredictorLoadConfig(burst=max(buckets)))
+
+    lat = out["latency"]
+    p99_ms = lat["total"]["p99_ms"]
+    slo_ms = float(os.environ.get("FMDA_PREDICTOR_SLO_P99_MS", "250"))
+    soft = os.environ.get("FMDA_FLEET_SLO_SOFT", "") == "1"
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = None
+    quiet = load1 is not None and load1 < 0.5 * (os.cpu_count() or 1)
+    batched_per_s = out["signals_per_s"] or 0.0
+    speedup = (batched_per_s / serial_per_s) if serial_per_s else None
+    result = {
+        "signals": len(timestamps),
+        "signals_served": out["signals_served"],
+        "serial_signals_per_s": round(serial_per_s, 1),
+        "batched_signals_per_s": round(batched_per_s, 1),
+        "speedup_vs_serial": round(speedup, 2) if speedup else None,
+        "tick_p50_ms": lat["total"]["p50_ms"],
+        "tick_p99_ms": p99_ms,
+        "gather_p50_ms": lat["gather"]["p50_ms"],
+        "device_p50_ms": lat["device"]["p50_ms"],
+        "compile_count": out["compile_count"],
+        "bucket_sizes": list(buckets),
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "slo_p99_ms": slo_ms,
+        "slo_ok": p99_ms <= slo_ms,
+        "slo_quiet_host": quiet,
+        "timing_note": "serial = solo Predictor.predict_for_timestamp "
+                       "loop (per-signal SQL lookup + window fetch + "
+                       "(1,W,F) forward); batched = PredictorGateway "
+                       "(one id-lookup query + one vectorized window "
+                       "gather + one bucketed forward per flush); same "
+                       "warehouse, model, signals; buckets precompiled",
+    }
+    if out["compile_count"] != len(buckets):
+        result["error"] = (
+            f"compile_count {out['compile_count']} != {len(buckets)} "
+            "buckets: something recompiled on the signal path")
+    elif speedup is not None and speedup < 2.0 and quiet and not soft:
+        result["error"] = (
+            f"batched Predictor speedup {speedup:.2f}x < 2x over the "
+            "serial loop on a quiet host (ISSUE 5 acceptance; "
+            "--slo-soft / FMDA_FLEET_SLO_SOFT=1 to report-only)")
+    elif p99_ms > slo_ms and quiet and not soft:
+        result["error"] = (
+            f"latency SLO violated: total p99 {p99_ms}ms > {slo_ms}ms "
+            "bound on a quiet host (FMDA_PREDICTOR_SLO_P99_MS to "
+            "retune, --slo-soft / FMDA_FLEET_SLO_SOFT=1 to report-only)")
+    return result
+
+
 def phase_obs_overhead() -> dict:
     """Observability-plane cost on the engine.step hot loop: the same
     synthetic replay driven twice per repetition — once with the obs
@@ -1388,6 +1512,7 @@ _PHASES = {
     "replay": phase_replay,
     "longctx_sp": phase_longctx_sp,
     "runtime_fleet_smoke": phase_runtime_fleet,
+    "predictor_fleet_smoke": phase_predictor_fleet,
     "obs_overhead": phase_obs_overhead,
     "trace_overhead": phase_trace_overhead,
 }
@@ -1816,6 +1941,7 @@ def main() -> None:
         ("multiticker", 420.0),
         ("serving", 300.0),
         ("runtime_fleet_smoke", 240.0),
+        ("predictor_fleet_smoke", 300.0),
         ("obs_overhead", 300.0),
         ("trace_overhead", 300.0),
         ("flagship_bf16", 300.0),
@@ -1935,8 +2061,9 @@ if __name__ == "__main__":
     parser.add_argument("--probe-interval", type=float, default=600.0)
     parser.add_argument("--wait-budget", type=float, default=10 * 3600.0)
     parser.add_argument("--slo-soft", action="store_true",
-                        help="report runtime_fleet_smoke's latency-SLO "
-                             "verdict without failing the phase "
+                        help="report the runtime_fleet_smoke and "
+                             "predictor_fleet_smoke SLO/speedup "
+                             "verdicts without failing the phases "
                              "(loaded-host escape hatch; also "
                              "FMDA_FLEET_SLO_SOFT=1)")
     args = parser.parse_args()

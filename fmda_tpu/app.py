@@ -129,6 +129,56 @@ class Application:
         self.predictors.append(predictor)
         return predictor
 
+    def attach_predictor_fleet(
+        self, model_cfg, params, norm_params, **gateway_kwargs
+    ):
+        """Batched window-re-scan serving (fmda_tpu.runtime
+        .predictor_pool) on this app's bus + warehouse, sized by the
+        ``config.runtime`` ``predictor_*`` knobs: predict-timestamp
+        signals coalesce into bucketed ``(B, window, F)`` jitted
+        forwards — the Predictor path as a fleet citizen.  The gateway
+        joins :attr:`predictors`, so :meth:`run_tick` polls it exactly
+        like a solo predictor."""
+        from fmda_tpu.runtime import (
+            BatcherConfig, PredictorGateway, PredictorPool,
+        )
+
+        rc = self.config.runtime
+        window = (rc.predictor_window if rc.predictor_window is not None
+                  else rc.window)
+        pool = PredictorPool(
+            model_cfg, params, norm_params, window=window,
+            use_ring=rc.predictor_ring)
+        gateway_kwargs.setdefault(
+            "batcher_config",
+            BatcherConfig(bucket_sizes=tuple(rc.predictor_bucket_sizes),
+                          max_linger_s=rc.predictor_max_linger_ms / 1e3))
+        gateway_kwargs.setdefault("queue_bound", rc.predictor_queue_bound)
+        gateway_kwargs.setdefault("pipeline_depth", rc.pipeline_depth)
+        gateway_kwargs.setdefault(
+            "threshold", self.config.train.prob_threshold)
+        gateway = PredictorGateway(
+            pool, self.bus, self.warehouse, **gateway_kwargs)
+        self.predictors.append(gateway)
+        self.observability.track_predictor_fleet(gateway)
+        return gateway
+
+    def attach_predictor_fleet_from_checkpoint(
+        self, checkpoint_path: str, model_cfg=None, **gateway_kwargs
+    ):
+        """:meth:`attach_predictor_fleet` from a training checkpoint
+        (params + norm stats in one tree, like the solo
+        :meth:`attach_predictor_from_checkpoint`)."""
+        from fmda_tpu.train.checkpoint import restore_checkpoint
+
+        tree, norm = restore_checkpoint(checkpoint_path)
+        if norm is None:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} has no normalization stats")
+        return self.attach_predictor_fleet(
+            model_cfg if model_cfg is not None else self.config.model,
+            tree["params"], norm, **gateway_kwargs)
+
     def attach_streaming_predictor(self, core, **kwargs):
         """Carried-state predictor: O(1)/tick with a StreamingBiGRU core
         (unidirectional), O(window)/tick with the bidirectional core."""

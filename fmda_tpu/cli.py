@@ -363,19 +363,35 @@ def cmd_serve_fleet(args) -> int:
     from fmda_tpu.runtime import FleetLoadConfig, run_fleet_load
 
     cfg = _config(args)
-    overrides = {
-        k: v for k, v in dict(
-            capacity=max(args.sessions, cfg.runtime.capacity),
-            max_linger_ms=args.max_linger_ms,
-            queue_bound=args.queue_bound,
-            window=args.window,
-            bucket_sizes=(tuple(int(b) for b in args.bucket_sizes.split(","))
-                          if args.bucket_sizes else None),
-            pipeline_depth=(0 if args.serial else None),
-            shard_pool=args.shard_pool,
-            slo_p99_ms=args.slo_p99_ms,
-        ).items() if v is not None
-    }
+    bucket_sizes = (tuple(int(b) for b in args.bucket_sizes.split(","))
+                    if args.bucket_sizes else None)
+    if args.predictor:
+        # the window-re-scan Predictor path: the batching knobs land on
+        # the predictor_* half of RuntimeConfig
+        overrides = {
+            k: v for k, v in dict(
+                predictor_max_linger_ms=args.max_linger_ms,
+                predictor_queue_bound=args.queue_bound,
+                predictor_window=args.window,
+                predictor_bucket_sizes=bucket_sizes,
+                predictor_ring=(True if args.ring else None),
+                pipeline_depth=(0 if args.serial else None),
+                slo_p99_ms=args.slo_p99_ms,
+            ).items() if v is not None
+        }
+    else:
+        overrides = {
+            k: v for k, v in dict(
+                capacity=max(args.sessions, cfg.runtime.capacity),
+                max_linger_ms=args.max_linger_ms,
+                queue_bound=args.queue_bound,
+                window=args.window,
+                bucket_sizes=bucket_sizes,
+                pipeline_depth=(0 if args.serial else None),
+                shard_pool=args.shard_pool,
+                slo_p99_ms=args.slo_p99_ms,
+            ).items() if v is not None
+        }
     cfg = dataclasses.replace(
         cfg, runtime=dataclasses.replace(cfg.runtime, **overrides))
     if args.trace or args.trace_out:
@@ -384,44 +400,91 @@ def cmd_serve_fleet(args) -> int:
         from fmda_tpu.obs.trace import configure_tracing
 
         configure_tracing(enabled=True, sample_rate=args.trace_sample)
-    app = Application(cfg)
 
-    # synthetic proof run: a randomly-initialised unidirectional carrier
-    # (the serving math is checkpoint-independent; --hidden sizes it)
     from fmda_tpu.models import build_model
-
-    model_cfg = dataclasses.replace(
-        cfg.model, bidirectional=False, dropout=0.0,
-        hidden_size=args.hidden, n_features=cfg.features.n_features,
-        cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
-    model = build_model(model_cfg)
     import jax.numpy as jnp
 
-    params = model.init(
-        {"params": jax.random.PRNGKey(args.seed)},
-        jnp.zeros((1, cfg.runtime.window, model_cfg.n_features)))["params"]
+    if args.predictor:
+        # batched-Predictor proof run: synthetic corpus warehouse, a
+        # randomly-initialised flagship bidirectional model (the serving
+        # math is checkpoint-independent), every servable timestamp
+        # signalled in bursts through the PredictorGateway
+        from fmda_tpu.data.normalize import NormParams
+        from fmda_tpu.data.synthetic import (
+            SyntheticMarketConfig, build_corpus,
+        )
+        from fmda_tpu.runtime import PredictorLoadConfig, run_predictor_load
+        import numpy as np
 
-    gateway = app.attach_fleet(model_cfg, params)
+        wh, _ = build_corpus(
+            cfg.features,
+            SyntheticMarketConfig(seed=args.seed,
+                                  n_days=args.predictor_days))
+        app = Application(cfg, warehouse=wh)
+        model_cfg = dataclasses.replace(
+            cfg.model, dropout=0.0, hidden_size=args.hidden,
+            n_features=len(wh.x_fields))
+        window = (cfg.runtime.predictor_window
+                  if cfg.runtime.predictor_window is not None
+                  else cfg.runtime.window)
+        params = build_model(model_cfg).init(
+            {"params": jax.random.PRNGKey(args.seed)},
+            jnp.zeros((1, window, model_cfg.n_features)))["params"]
+        norm = NormParams(
+            np.zeros(model_cfg.n_features, np.float32),
+            np.ones(model_cfg.n_features, np.float32))
+        gateway = app.attach_predictor_fleet(
+            model_cfg, params, norm, max_staleness_s=None)
+        timestamps = wh.timestamps()[window - 1:]
+        load_cfg = PredictorLoadConfig(
+            n_signals=args.signals, burst=args.burst)
+
+        def run_load():
+            return run_predictor_load(gateway, timestamps, load_cfg)
+    else:
+        app = Application(cfg)
+
+        # synthetic proof run: a randomly-initialised unidirectional
+        # carrier (the serving math is checkpoint-independent; --hidden
+        # sizes it)
+        model_cfg = dataclasses.replace(
+            cfg.model, bidirectional=False, dropout=0.0,
+            hidden_size=args.hidden, n_features=cfg.features.n_features,
+            cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
+        model = build_model(model_cfg)
+
+        params = model.init(
+            {"params": jax.random.PRNGKey(args.seed)},
+            jnp.zeros((1, cfg.runtime.window,
+                       model_cfg.n_features)))["params"]
+
+        gateway = app.attach_fleet(model_cfg, params)
+        load_cfg = FleetLoadConfig(
+            n_sessions=args.sessions,
+            n_ticks=args.ticks, duty=args.duty, seed=args.seed)
+
+        def run_load():
+            return run_fleet_load(gateway, load_cfg)
     if args.metrics_port is not None:
         server = app.observability.start_server(port=args.metrics_port)
         print(f"metrics endpoint: {server.url}/metrics "
               f"(healthz, snapshot, events, trace)", file=sys.stderr)
-    load_cfg = FleetLoadConfig(
-        n_sessions=args.sessions,
-        n_ticks=args.ticks, duty=args.duty, seed=args.seed)
     if args.jax_profile:
         # device-side work joins the host spans: a TensorBoard/XProf
-        # capture of the whole load, pool flushes annotated as numbered
-        # StepTraceAnnotation steps
+        # capture of the whole load; carried-state pool flushes are
+        # annotated as numbered StepTraceAnnotation steps
         from fmda_tpu.utils.tracing import device_trace
 
-        gateway.annotate_device_steps = True
+        if not args.predictor:
+            gateway.annotate_device_steps = True
         with device_trace(args.jax_profile):
-            out = run_fleet_load(gateway, load_cfg)
+            out = run_load()
         print(f"jax profile captured to {args.jax_profile} "
               f"(tensorboard --logdir)", file=sys.stderr)
     else:
-        out = run_fleet_load(gateway, load_cfg)
+        out = run_load()
+    if args.predictor:
+        out["ring"] = gateway.pool.use_ring
     out["backend"] = jax.default_backend()
     if args.trace or args.trace_out:
         from fmda_tpu.obs.trace import default_tracer
@@ -561,10 +624,37 @@ def cmd_trace(args) -> int:
     """Per-stage latency attribution for recorded tick traces — the
     "where did tick T spend its 38 ms" tool (docs/OPERATIONS.md §4d).
     Input is Chrome/Perfetto trace_event JSON: a ``serve-fleet
-    --trace-out`` file, or a running endpoint's ``/trace``."""
-    from fmda_tpu.obs.trace import format_trace, group_chrome_traces
+    --trace-out`` file, a running endpoint's ``/trace``, or several
+    per-process files stitched by trace id (``--merge``)."""
+    from fmda_tpu.obs.trace import (
+        format_trace, group_chrome_traces, merge_chrome_traces,
+    )
 
-    if args.endpoint:
+    if args.merge:
+        docs = []
+        for path in args.merge:
+            try:
+                with open(path) as fh:
+                    docs.append(json.load(fh))
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"cannot read {path}: {e}", file=sys.stderr)
+                return 2
+        doc = merge_chrome_traces(docs)
+        if args.out:
+            try:
+                with open(args.out, "w") as fh:
+                    json.dump(doc, fh)
+            except OSError as e:
+                print(f"cannot write {args.out}: {e}", file=sys.stderr)
+                return 2
+            n_traces = len(group_chrome_traces(doc))
+            print(f"merged {len(args.merge)} trace files "
+                  f"({n_traces} traces) -> {args.out} "
+                  "(load at https://ui.perfetto.dev)", file=sys.stderr)
+            return 0
+        # no --out: fall through to the attribution display over the
+        # merged document (cross-process journeys group by trace id)
+    elif args.endpoint:
         import urllib.error
         import urllib.request
 
@@ -584,8 +674,9 @@ def cmd_trace(args) -> int:
             print(f"cannot read {args.input}: {e}", file=sys.stderr)
             return 2
     else:
-        print("pass --input FILE (a serve-fleet --trace-out file) or "
-              "--endpoint HOST:PORT (a running /trace endpoint)",
+        print("pass --input FILE (a serve-fleet --trace-out file), "
+              "--endpoint HOST:PORT (a running /trace endpoint), or "
+              "--merge FILE FILE... (stitch per-process trace files)",
               file=sys.stderr)
         return 2
     traces = group_chrome_traces(doc)
@@ -711,6 +802,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queue-bound", type=int, default=None,
                    help="override config runtime.queue_bound")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--predictor", action="store_true",
+                   help="serve the window-re-scan Predictor path "
+                        "instead of carried-state sessions: "
+                        "predict-timestamp signals over a synthetic "
+                        "corpus, batched into bucketed (B, window, F) "
+                        "forwards (runtime.predictor_* knobs; "
+                        "docs/runtime.md 'Batched Predictor path')")
+    p.add_argument("--predictor-days", type=int, default=3,
+                   help="synthetic corpus size for --predictor (days)")
+    p.add_argument("--signals", type=int, default=0,
+                   help="signal count for --predictor (0 = every "
+                        "servable warehouse timestamp)")
+    p.add_argument("--burst", type=int, default=32,
+                   help="signals published per poll for --predictor "
+                        "(the engine's signal-after-commit burst shape)")
+    p.add_argument("--ring", action="store_true", default=None,
+                   help="enable the device-resident window ring for "
+                        "--predictor (runtime.predictor_ring: "
+                        "consecutive signals re-send only new rows)")
     p.add_argument("--serial", action="store_true", default=None,
                    help="disable the one-deep flush overlap pipeline "
                         "(runtime.pipeline_depth=0; bit-identical A/B "
@@ -769,6 +879,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(serve-fleet --trace-out)")
     p.add_argument("--endpoint", default=None, metavar="HOST:PORT",
                    help="scrape a running endpoint's /trace instead")
+    p.add_argument("--merge", nargs="+", default=None, metavar="FILE",
+                   help="stitch several per-process --trace-out files "
+                        "into one trace by trace id (timelines aligned "
+                        "on shared journeys); with --out writes the "
+                        "merged Perfetto JSON, without it shows the "
+                        "attribution over the merged document")
+    p.add_argument("--out", default=None, metavar="FILE",
+                   help="write the --merge result to this file")
     p.add_argument("--last", type=int, default=10,
                    help="show the newest N traces (default 10)")
     p.add_argument("--slowest", type=int, default=None, metavar="N",

@@ -474,6 +474,27 @@ class RuntimeConfig:
     #: gate; `--slo-soft` reports the verdict without failing.
     slo_p99_ms: Optional[float] = None
 
+    # -- the batched Predictor path (window-re-scan serving on the fleet
+    # runtime: fmda_tpu.runtime.predictor_pool; docs/runtime.md) --------
+
+    #: Padded micro-batch sizes for the batched Predictor's jitted
+    #: (B, window, F) forward — one compiled program each.  Smaller set
+    #: than the carried-state fleet's: each window forward is
+    #: O(window·F) device work, so padding waste is costlier.
+    predictor_bucket_sizes: Tuple[int, ...] = (8, 32, 64)
+    #: Max time (ms) the oldest queued signal may linger before a flush.
+    predictor_max_linger_ms: float = DEFAULT_MAX_LINGER_S * 1e3
+    #: Bound on queued signals; overload sheds the oldest, counted.
+    predictor_queue_bound: int = DEFAULT_QUEUE_BOUND
+    #: Model input window for the batched Predictor; None = `window`.
+    predictor_window: Optional[int] = None
+    #: Keep a device-resident ring of the stream's newest `window`
+    #: feature rows: consecutive signals re-send only the new rows and
+    #: the (B, window, F) gather happens on device.  Off by default —
+    #: it assumes in-order landing (an out-of-order row's derived-view
+    #: recompute would not reach rows already on device).
+    predictor_ring: bool = False
+
 
 @dataclass(frozen=True)
 class ObservabilityConfig:

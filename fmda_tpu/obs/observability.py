@@ -55,16 +55,19 @@ def stage_timer_families(prefix: str, timer) -> Snapshot:
     return {"counters": counters}
 
 
-def runtime_families(metrics) -> Snapshot:
-    """:class:`RuntimeMetrics` -> registry samples under the ``runtime_``
-    prefix: per-stage latency summaries, every counter as a ``_total``,
-    every gauge verbatim, the host StageTimer as stage counters."""
+def runtime_families(metrics, prefix: str = "runtime") -> Snapshot:
+    """:class:`RuntimeMetrics` -> registry samples under ``<prefix>_``:
+    per-stage latency summaries, every counter as a ``_total``, every
+    gauge verbatim, the host StageTimer as stage counters.  The fleet
+    gateway exports under the default ``runtime`` prefix; the batched
+    Predictor gateway under ``predictor`` (two gateways in one process
+    must not collide on series names)."""
     histograms = []
     for stage, h in metrics.histograms.items():
         if not h.n:
             continue
         s: Sample = h.sample()
-        s["name"] = "runtime_latency_seconds"
+        s["name"] = f"{prefix}_latency_seconds"
         s["labels"] = {"stage": stage}
         histograms.append(s)
     # dict() first: the gateway hot path inserts keys (count()/gauge()
@@ -73,14 +76,14 @@ def runtime_families(metrics) -> Snapshot:
     # C-level copy is atomic under the GIL; the histograms dict is
     # fixed-key from construction, so it needs no copy.
     counters = [
-        {"name": f"runtime_{name}_total", "labels": {}, "value": value}
+        {"name": f"{prefix}_{name}_total", "labels": {}, "value": value}
         for name, value in dict(metrics.counters).items()
     ]
     gauges = [
-        {"name": f"runtime_{name}", "labels": {}, "value": value}
+        {"name": f"{prefix}_{name}", "labels": {}, "value": value}
         for name, value in dict(metrics.gauges).items()
     ]
-    out = stage_timer_families("runtime_stage", metrics.timer)
+    out = stage_timer_families(f"{prefix}_stage", metrics.timer)
     out["counters"] = counters + out.get("counters", [])
     out["gauges"] = gauges
     out["histograms"] = histograms
@@ -234,6 +237,31 @@ class Observability:
             "fleet.attached",
             capacity=gateway.pool.capacity,
             queue_bound=gateway.queue_bound,
+        )
+
+    def track_predictor_fleet(self, gateway) -> None:
+        """Register a batched-Predictor gateway's RuntimeMetrics (under
+        the ``predictor_`` series prefix — a carried-state fleet may
+        coexist in the same process) + saturation check (called by
+        ``Application.attach_predictor_fleet``; re-attaching replaces)."""
+        if not self.registry.enabled:
+            return
+        metrics = gateway.metrics
+        self.registry.register_collector(
+            "predictor_runtime",
+            lambda: runtime_families(metrics, prefix="predictor"))
+
+        def check_predictor() -> Tuple[bool, object]:
+            depth = len(gateway.batcher)
+            return (not gateway.saturated,
+                    f"queue depth {depth}/{gateway.queue_bound}")
+
+        self.checks["predictor_queue"] = check_predictor
+        self.events.emit(
+            "predictor_fleet.attached",
+            window=gateway.pool.window,
+            queue_bound=gateway.queue_bound,
+            ring=gateway.pool.use_ring,
         )
 
     # -- ticks / health -------------------------------------------------------

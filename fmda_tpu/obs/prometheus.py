@@ -63,9 +63,19 @@ def _value(v: float) -> str:
     return repr(f)
 
 
-def render_prometheus(snapshot: Snapshot) -> str:
+def render_prometheus(snapshot: Snapshot, *, exemplars: bool = False) -> str:
     """Registry snapshot -> text exposition (one ``# TYPE`` line per
-    family, samples grouped under it)."""
+    family, samples grouped under it).
+
+    ``exemplars=True`` appends OpenMetrics exemplar syntax
+    (``# {trace_id="..."} value``) to the bucket lines of histogram
+    samples that carry them (the tracer's ``e2e_tick_seconds``).  That
+    suffix is **illegal in text exposition v0.0.4** — the legacy parser
+    expects at most a timestamp after the value and fails the whole
+    scrape — so callers must only enable it for clients that negotiated
+    an OpenMetrics response (the ``/metrics`` endpoint checks the
+    ``Accept`` header); the default rendering stays 0.0.4-clean (the
+    bucketed histogram form itself is legal there)."""
     by_family: Dict[str, tuple] = {}  # name -> (type, [lines])
 
     def family(name: str, kind: str) -> List[str]:
@@ -87,12 +97,33 @@ def render_prometheus(snapshot: Snapshot) -> str:
     for s in snapshot.get("histograms", ()):
         name = _name(str(s["name"]))
         labels = s.get("labels", {})
-        lines = family(name, "summary")
-        for q, key in (("0.5", "p50_s"), ("0.99", "p99_s")):
-            extra = 'quantile="%s"' % q
-            lines.append(
-                f"{name}{_labels(labels, extra)} {_value(s[key])}"
-            )
+        buckets = s.get("buckets")
+        if buckets:
+            # bucketed exposition for series carrying sample-linked
+            # exemplars (the tracer's e2e_tick_seconds): sparse
+            # cumulative `le` buckets, each annotated with its last
+            # trace id in OpenMetrics exemplar syntax — the scrape-side
+            # bridge from "p99 is bad" to "trace THIS tick"
+            lines = family(name, "histogram")
+            for b in buckets:
+                le = b["le"]
+                extra = 'le="%s"' % (
+                    le if isinstance(le, str) else _value(le))
+                line = (f"{name}_bucket{_labels(labels, extra)} "
+                        f"{_value(b['count'])}")
+                ex = b.get("exemplar")
+                if exemplars and ex:
+                    line += (' # {trace_id="%s"} %s'
+                             % (_escape_label(ex["trace_id"]),
+                                _value(ex["value_s"])))
+                lines.append(line)
+        else:
+            lines = family(name, "summary")
+            for q, key in (("0.5", "p50_s"), ("0.99", "p99_s")):
+                extra = 'quantile="%s"' % q
+                lines.append(
+                    f"{name}{_labels(labels, extra)} {_value(s[key])}"
+                )
         lines.append(f"{name}_sum{_labels(labels)} {_value(s['sum_s'])}")
         lines.append(f"{name}_count{_labels(labels)} {_value(s['count'])}")
 

@@ -130,6 +130,12 @@ class LatencyHistogram:
         b = int((math.log10(seconds) - self._LO_EXP) * self.BINS_PER_DECADE)
         return min(max(b, 0), self.N_BINS - 1)
 
+    @classmethod
+    def bin_upper_edge(cls, b: int) -> float:
+        """Upper edge (seconds) of bin ``b`` — the ``le`` bound exemplar
+        export keys on (fmda_tpu.obs.trace sample-linked exemplars)."""
+        return 10.0 ** (cls._LO_EXP + (b + 1) / cls.BINS_PER_DECADE)
+
     def observe(self, seconds: float) -> None:
         b = self._bin(seconds)
         with self._lock:

@@ -73,11 +73,25 @@ class MetricsServer:
                 path, _, query = self.path.partition("?")
                 try:
                     if path == "/metrics":
-                        body = render_prometheus(
-                            server.registry.snapshot()).encode()
-                        self._send(
-                            200, body,
-                            "text/plain; version=0.0.4; charset=utf-8")
+                        # exemplar syntax is OpenMetrics-only — the
+                        # 0.0.4 text parser fails the WHOLE scrape on
+                        # the '# {...}' suffix — so emit it (and the
+                        # matching content type + EOF terminator) only
+                        # for clients that negotiated OpenMetrics
+                        om = "openmetrics" in (
+                            self.headers.get("Accept") or "")
+                        text = render_prometheus(
+                            server.registry.snapshot(), exemplars=om)
+                        if om:
+                            self._send(
+                                200, (text + "# EOF\n").encode(),
+                                "application/openmetrics-text; "
+                                "version=1.0.0; charset=utf-8")
+                        else:
+                            self._send(
+                                200, text.encode(),
+                                "text/plain; version=0.0.4; "
+                                "charset=utf-8")
                     elif path == "/healthz":
                         health = (
                             server.health_fn()

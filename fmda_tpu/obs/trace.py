@@ -229,6 +229,11 @@ class Tracer:
         self.e2e = LatencyHistogram("e2e_tick_seconds")
         #: per-span-name attribution: name -> [total_s, count]
         self._stage_totals: Dict[str, List[float]] = {}
+        #: sample-linked exemplars: e2e histogram bin -> (trace_id,
+        #: seconds) of the LAST journey landing in that bin — the
+        #: aggregate-to-forensics bridge ("which tick made p99 bad?"):
+        #: /snapshot and /metrics expose the trace id per bucket.
+        self._exemplars: Dict[int, Tuple[str, float]] = {}
 
     @property
     def capacity(self) -> int:
@@ -257,6 +262,7 @@ class Tracer:
         with self._lock:
             self._ring.clear()
             self._stage_totals.clear()
+            self._exemplars.clear()
             self.recorded = 0
             self.traces_started = 0
             self.traces_finished = 0
@@ -280,6 +286,11 @@ class Tracer:
             acc[1] += 1
             if span.parent_id is None:
                 self.traces_finished += 1
+            if e2e:
+                # exemplar: the last trace id to land in this latency
+                # bucket (keyed on the e2e histogram's own binning)
+                self._exemplars[self.e2e._bin(seconds)] = (
+                    span.trace_id, seconds)
         if e2e:
             # only roots closed via finish_root feed e2e_tick_seconds:
             # those close AT the journey's end (the fleet publish), so
@@ -383,6 +394,7 @@ class Tracer:
             recorded = self.recorded
             started = self.traces_started
             finished = self.traces_finished
+            exemplars = dict(self._exemplars)
         for name in sorted(totals):
             total_s, count = totals[name]
             out["counters"].append({
@@ -404,7 +416,32 @@ class Tracer:
             {"name": "trace_spans_buffered", "labels": {},
              "value": buffered})
         if self.e2e.n:
-            out["histograms"].append(self.e2e.sample())
+            s = self.e2e.sample()
+            # sample-linked exemplars: sparse cumulative buckets (only
+            # the occupied bins + the implicit +Inf — cumulative counts
+            # stay exact over a sparse `le` series) with the last trace
+            # id per bucket.  /snapshot serves this verbatim; the
+            # Prometheus renderer switches this one series to histogram
+            # exposition with OpenMetrics exemplar syntax.
+            snap = self.e2e.snapshot()
+            buckets = []
+            cum = 0
+            for b, c in enumerate(snap["counts"]):
+                cum += c
+                if not c:
+                    continue
+                entry: Dict[str, object] = {
+                    "le": round(LatencyHistogram.bin_upper_edge(b), 9),
+                    "count": cum,
+                }
+                if b in exemplars:
+                    tid, secs = exemplars[b]
+                    entry["exemplar"] = {
+                        "trace_id": tid, "value_s": round(secs, 9)}
+                buckets.append(entry)
+            buckets.append({"le": "+Inf", "count": snap["n"]})
+            s["buckets"] = buckets
+            out["histograms"].append(s)
         return out
 
 
@@ -572,6 +609,60 @@ def group_chrome_traces(doc: Dict[str, object]) -> List[Dict[str, object]]:
         })
     out.sort(key=lambda t: t["start_ms"])
     return out
+
+
+def merge_chrome_traces(docs: List[Dict[str, object]]) -> Dict[str, object]:
+    """Stitch per-process ``--trace-out`` files into ONE Perfetto trace.
+
+    Trace/span ids are process-agnostic (the in-band ``trace`` field
+    crosses the bus), but span rings are per-process and each process's
+    ``perf_counter_ns`` timeline has its own arbitrary epoch.  This
+    merges the documents by **trace id**: every later document's
+    timeline is shifted so journeys shared with the first document line
+    up (per shared trace, the delta between the two files' earliest
+    span; the median delta across shared traces is the offset — robust
+    to one skewed journey).  Documents sharing no trace ids are
+    concatenated unshifted (nothing to align on — their relative offset
+    is unknowable without a shared clock, and Perfetto still renders
+    them on distinct pid lanes).
+
+    The result groups cleanly: a consumer process's spans (parented via
+    ``add_span_wire``) land under the producer process's root, so
+    ``python -m fmda_tpu trace`` attributes the full cross-process
+    journey.
+    """
+    merged: List[Dict[str, object]] = []
+    base_starts: Dict[str, float] = {}
+    for doc in docs:
+        starts: Dict[str, float] = {}
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") != "X":
+                continue
+            tid = (ev.get("args") or {}).get("trace_id")
+            if not tid:
+                continue
+            ts = float(ev["ts"])
+            if tid not in starts or ts < starts[tid]:
+                starts[tid] = ts
+        shared = sorted(set(base_starts) & set(starts))
+        if shared:
+            deltas = sorted(base_starts[t] - starts[t] for t in shared)
+            offset = deltas[len(deltas) // 2]
+        else:
+            offset = 0.0
+        for ev in doc.get("traceEvents", ()):
+            if offset and ev.get("ph") == "X":
+                ev = {**ev, "ts": float(ev["ts"]) + offset}
+            merged.append(ev)
+        for tid, ts in starts.items():
+            aligned = ts + offset
+            if tid not in base_starts or aligned < base_starts[tid]:
+                base_starts[tid] = aligned
+    meta = [e for e in merged if e.get("ph") == "M"]
+    events = sorted(
+        (e for e in merged if e.get("ph") != "M"),
+        key=lambda e: float(e.get("ts", 0.0)))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 def format_trace(t: Dict[str, object]) -> str:

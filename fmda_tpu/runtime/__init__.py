@@ -14,8 +14,14 @@ runs the whole stack against a synthetic multi-ticker load
 
 from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
 from fmda_tpu.runtime.gateway import FleetGateway, FleetResult
-from fmda_tpu.runtime.loadgen import FleetLoadConfig, run_fleet_load
+from fmda_tpu.runtime.loadgen import (
+    FleetLoadConfig,
+    PredictorLoadConfig,
+    run_fleet_load,
+    run_predictor_load,
+)
 from fmda_tpu.runtime.metrics import LatencyHistogram, RuntimeMetrics
+from fmda_tpu.runtime.predictor_pool import PredictorGateway, PredictorPool
 from fmda_tpu.runtime.session_pool import (
     PoolExhausted,
     SessionHandle,
@@ -30,9 +36,13 @@ __all__ = [
     "FleetGateway",
     "FleetResult",
     "FleetLoadConfig",
+    "PredictorLoadConfig",
     "run_fleet_load",
+    "run_predictor_load",
     "LatencyHistogram",
     "RuntimeMetrics",
+    "PredictorGateway",
+    "PredictorPool",
     "PoolExhausted",
     "SessionHandle",
     "SessionPool",

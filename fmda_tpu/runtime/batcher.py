@@ -68,6 +68,10 @@ class Tick:
     #: sampled trace root (:class:`fmda_tpu.obs.trace.TraceRef`) begun at
     #: submit; None when tracing is disabled or the tick was unsampled
     trace: Optional[object] = None
+    #: in-band trace context (``"trace_id:span_id"``) the request arrived
+    #: with — the predictor gateway stitches its serve spans into the
+    #: *signal's* journey instead of opening a fresh root
+    wire: Optional[str] = None
 
 
 class MicroBatcher:

@@ -84,3 +84,49 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
         "compile_count": pool.compile_count,
         **summary,
     }
+
+
+@dataclass(frozen=True)
+class PredictorLoadConfig:
+    """Shape of a batched-Predictor load: serve ``n_signals`` warehouse
+    timestamps (0 = every servable one) in bursts of ``burst`` signals
+    per poll — the traffic the engine's signal-after-commit cadence
+    produces."""
+
+    n_signals: int = 0
+    burst: int = 32
+
+
+def run_predictor_load(
+    gateway, timestamps, load: Optional[PredictorLoadConfig] = None
+) -> Dict:
+    """Publish predict-timestamp signals in bursts on the gateway's bus
+    and poll the :class:`~fmda_tpu.runtime.predictor_pool
+    .PredictorGateway` after each burst; returns throughput + per-stage
+    latency + loss counters (``serve-fleet --predictor`` and the
+    ``predictor_fleet_smoke`` bench phase)."""
+    from fmda_tpu.config import TOPIC_PREDICT_TIMESTAMP
+
+    load = load or PredictorLoadConfig()
+    timestamps = list(timestamps)
+    if load.n_signals:
+        timestamps = timestamps[: load.n_signals]
+    served = 0
+    t0 = time.perf_counter()
+    for i in range(0, len(timestamps), load.burst):
+        for ts in timestamps[i:i + load.burst]:
+            gateway.bus.publish(TOPIC_PREDICT_TIMESTAMP, {"Timestamp": ts})
+        served += len(gateway.poll())
+    served += len(gateway.drain())
+    wall_s = time.perf_counter() - t0
+
+    summary = gateway.metrics.summary()
+    return {
+        "signals_submitted": len(timestamps),
+        "signals_served": served,
+        "burst": load.burst,
+        "wall_s": round(wall_s, 3),
+        "signals_per_s": round(served / wall_s, 1) if wall_s > 0 else None,
+        "compile_count": gateway.pool.compile_count,
+        **summary,
+    }

@@ -30,6 +30,10 @@ __all__ = ["LatencyHistogram", "RuntimeMetrics", "STAGES"]
 #: :attr:`RuntimeMetrics.histograms`.
 STAGES: Tuple[str, ...] = (
     "enqueue_to_dispatch",  # time spent queued/lingering before a flush
+    "gather",               # batched warehouse window gather (the
+                            # predictor gateway's id lookup + fetch;
+                            # unused — and therefore unreported — by the
+                            # carried-state fleet gateway)
     "dispatch",             # stale filter + staging assembly + async
                             # enqueue of the batched jit step
     "device",               # host transfer block in _complete; under the

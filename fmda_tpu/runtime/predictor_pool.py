@@ -1,0 +1,667 @@
+"""Batched Predictor serving: the window-re-scan path on the fleet runtime.
+
+PR 1 multiplexed the unidirectional carried-state sessions; this module
+closes the ROADMAP follow-up by multiplexing the flagship *bidirectional*
+(and attn) serving path — the window-re-scan
+:class:`~fmda_tpu.serve.predictor.Predictor` — onto the same
+micro-batching machinery.  The Predictor is stateless per request, so
+batcher reuse is direct: no slot pool, no carried state, just bucketed
+``(B, window, F)`` forwards compiled once per bucket.
+
+Two pieces:
+
+- :class:`PredictorPool` — the compiled batched forward.  It jits the
+  *same* :func:`~fmda_tpu.serve.predictor.make_batched_forward` program
+  the solo Predictor runs (normalization folded in, norm stats as jit
+  arguments), so a bucket-1 flush is **bit-identical** to the solo path
+  — the contract ``tests/test_predictor_fleet.py`` asserts.  One compile
+  per bucket (:attr:`PredictorPool.compile_count` is the proof hook).
+  With ``use_ring=True`` it additionally keeps a **device-resident
+  window ring** of the stream's newest ``window`` feature rows: when a
+  flush's signals continue the stream (consecutive row positions), only
+  the ``B`` *new* rows cross the host boundary and a jitted gather
+  builds the ``(B, window, F)`` windows on device — O(B·F) host bytes
+  per flush instead of O(B·window·F).  The windows feed the exact same
+  compiled forward, so ring flushes stay bit-identical to fetch flushes;
+  a gap (skipped/missing signal, out-of-order landing) falls back to the
+  batched warehouse gather and re-seeds the ring, counted
+  (``ring_hits``/``ring_misses``).
+
+- :class:`PredictorGateway` — the serving loop: consume
+  ``predict_timestamp`` signals (stale filter, exactly the solo
+  Predictor's semantics), coalesce them through the existing
+  :class:`~fmda_tpu.runtime.batcher.MicroBatcher`, replace B per-signal
+  SQL lookups + window fetches with ONE
+  :meth:`~fmda_tpu.stream.warehouse.Warehouse.ids_for_timestamps` +
+  :meth:`~fmda_tpu.stream.warehouse.Warehouse.fetch_windows` per flush,
+  dispatch the batched forward asynchronously through the one-deep
+  in-flight pipeline (``pipeline_depth=0`` = the bit-identical serial
+  A/B reference), and publish every flush with one ``publish_many``.
+  Missing-row / short-history signals are skipped with the solo path's
+  warnings, plus counters (``missing_rows`` / ``short_history``).
+  Per-signal trace spans (queued/gather/dispatch/device/publish) tile
+  the tick's journey; a signal arriving with in-band trace context gets
+  them stitched under a ``serve`` span on *its* trace (the engine →
+  serve journey), a bare signal gets its own sampled root.
+
+:class:`~fmda_tpu.runtime.metrics.RuntimeMetrics` instruments the whole
+path (the new ``gather`` stage prices the batched warehouse read);
+``Observability.track_predictor_fleet`` exports it under the
+``predictor_`` prefix.  Architecture: docs/runtime.md "Batched
+Predictor path".
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fmda_tpu.config import (
+    DEFAULT_QUEUE_BOUND,
+    TARGET_COLUMNS,
+    TOPIC_PREDICT_TIMESTAMP,
+    TOPIC_PREDICTION,
+    ModelConfig,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.obs.trace import TraceRef, default_tracer, now_ns, parse_wire
+from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
+from fmda_tpu.runtime.metrics import RuntimeMetrics
+from fmda_tpu.runtime.session_pool import SessionHandle
+from fmda_tpu.serve.predictor import (
+    Prediction,
+    labels_over_threshold,
+    make_batched_forward,
+    prediction_message,
+)
+from fmda_tpu.utils.timeutils import get_timezone, parse_ts
+
+log = logging.getLogger("fmda_tpu.runtime")
+
+#: Queued predictor requests carry no feature row (the window is gathered
+#: per flush, not per submit) — one shared placeholder, never read.
+_NO_ROW = np.empty(0, np.float32)
+
+
+class PredictorPool:
+    """The compiled batched window-re-scan forward (+ optional device
+    window ring).
+
+    Stateless per request — "pool" here pools *compilations*, not
+    sessions: one jitted ``(B, window, F) -> (B, n_classes)`` program
+    per micro-batch bucket, replayed forever.  The program is the solo
+    Predictor's own (:func:`make_batched_forward`), so bucket-1 flushes
+    are bit-identical to solo serving.
+    """
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params,
+        norm_params: NormParams,
+        *,
+        window: int,
+        use_ring: bool = False,
+    ) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.cfg = model_cfg
+        self.window = window
+        self.n_features = int(np.asarray(norm_params.x_min).shape[0])
+        self._params = params
+        self._x_min = jnp.asarray(norm_params.x_min)
+        self._x_range = jnp.asarray(norm_params.x_max - norm_params.x_min)
+        # the ONE shared forward (serve/predictor.py) — jitting it here
+        # and in the solo Predictor yields the same program at B=1
+        self._forward = jax.jit(make_batched_forward(model_cfg))
+        # fallback compile accounting (batch size is the only varying
+        # shape in the forward signature; see SessionPool.compile_count)
+        self._batch_sizes_seen: set = set()
+
+        #: Device-resident window ring (``use_ring``): the newest
+        #: ``window`` feature rows of the served stream, living on device
+        #: between flushes so consecutive signals re-send only new rows.
+        self.use_ring = use_ring
+        self._ring = None  # (window, F) device array once seeded
+        #: warehouse position (1-based) of the ring's newest row; 0 =
+        #: unseeded (the next flush takes the fetch path and seeds it)
+        self.ring_pos = 0
+        w = window
+
+        def ring_gather(ring, rows, n_valid):
+            """Windows for ``n_valid`` consecutive new rows, on device.
+
+            ``ring`` (window, F) holds the stream's last rows; ``rows``
+            (bucket, F) appends the new ones (lanes past ``n_valid`` are
+            padding).  Lane i's window is rows ``i+1 .. i+window`` of the
+            concatenation — garbage for padded lanes, sliced off by the
+            caller.  The new ring is the concatenation's last ``window``
+            *real* rows (dynamic slice at ``n_valid``, so padding never
+            enters the carried state)."""
+            buf = jnp.concatenate([ring, rows], axis=0)
+            bucket = rows.shape[0]
+            idx = (jnp.arange(1, w + 1)[None, :]
+                   + jnp.arange(bucket)[:, None])
+            x = buf[idx]  # (bucket, window, F)
+            new_ring = jax.lax.dynamic_slice_in_dim(buf, n_valid, w, axis=0)
+            return x, new_ring
+
+        self._ring_gather = jax.jit(ring_gather)
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct compiled forward programs — one per bucket size ever
+        dispatched (the no-recompile-on-the-tick-path proof hook; the
+        ring's gather programs are counted separately and never affect
+        this).  Probes jax's jit cache when the hook exists."""
+        cache_size = getattr(self._forward, "_cache_size", None)
+        if cache_size is not None:
+            return cache_size()
+        return len(self._batch_sizes_seen)
+
+    # -- the hot path -------------------------------------------------------
+
+    def forward_device(self, x):
+        """One bucketed flush, asynchronously: ``x`` (B, window, F) →
+        the (B, n_classes) sigmoid probabilities as a **device** array
+        (no host transfer; the gateway forces it one flush late).
+        Padded lanes compute garbage the caller slices off."""
+        self._batch_sizes_seen.add(int(x.shape[0]))
+        return self._forward(
+            self._params, self._x_min, self._x_range, jnp.asarray(x))
+
+    def forward(self, x) -> np.ndarray:
+        """Blocking :meth:`forward_device` (direct callers and tests)."""
+        return np.asarray(self.forward_device(x))
+
+    # -- the device window ring ---------------------------------------------
+
+    def seed_ring(self, last_window: np.ndarray, row_id: int) -> None:
+        """(Re-)seed the ring from a host-fetched window ending at
+        warehouse position ``row_id`` — the fetch path does this on every
+        flush so the *next* consecutive flush can take the ring path."""
+        self._ring = jnp.asarray(last_window, jnp.float32)
+        self.ring_pos = int(row_id)
+
+    def ring_forward_device(
+        self, rows: np.ndarray, n_valid: int, last_row_id: int
+    ):
+        """Ring-path flush: append ``n_valid`` consecutive new rows
+        (``rows`` is bucket-padded, padding zeroed), build the windows on
+        device, and run the SAME compiled forward the fetch path runs —
+        identical program, identical row values, bit-identical output."""
+        if self._ring is None:
+            raise RuntimeError("ring not seeded; take the fetch path first")
+        x, self._ring = self._ring_gather(
+            self._ring, jnp.asarray(rows, jnp.float32),
+            np.int32(n_valid))
+        self.ring_pos = int(last_row_id)
+        return self.forward_device(x)
+
+
+@dataclass
+class _InFlight:
+    """A dispatched-but-unconsumed flush: the device handle to its
+    probabilities plus what ``_complete`` needs to publish them."""
+
+    live: List[Tick]
+    probs_dev: object  # (bucket, n_classes) device array
+    bucket: int
+    #: perf_counter_ns stamps of the dispatch window (0 when untraced)
+    t_gather_ns: int = 0
+    t_dispatch_ns: int = 0
+    t_dispatched_ns: int = 0
+
+
+class PredictorGateway:
+    """Multiplexes predict-timestamp signals onto bucketed batched
+    forwards — the window-re-scan Predictor as a fleet citizen."""
+
+    #: Log every Nth shed (counter is the source of truth).
+    SHED_LOG_EVERY = 1000
+
+    def __init__(
+        self,
+        pool: PredictorPool,
+        bus,
+        warehouse,
+        *,
+        batcher_config: Optional[BatcherConfig] = None,
+        queue_bound: int = DEFAULT_QUEUE_BOUND,
+        metrics: Optional[RuntimeMetrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        signal_topic: str = TOPIC_PREDICT_TIMESTAMP,
+        prediction_topic: str = TOPIC_PREDICTION,
+        threshold: float = 0.5,
+        y_fields: Tuple[str, ...] = TARGET_COLUMNS,
+        from_end: bool = True,
+        max_staleness_s: Optional[int] = 4 * 60,
+        timezone: str = "US/Eastern",
+        now_fn: Optional[Callable[[], _dt.datetime]] = None,
+        pipeline_depth: int = 1,
+    ) -> None:
+        if queue_bound < 1:
+            raise ValueError(f"queue_bound must be >= 1, got {queue_bound}")
+        if pipeline_depth not in (0, 1):
+            raise ValueError(
+                f"pipeline_depth must be 0 (serial) or 1 (one-deep "
+                f"overlap), got {pipeline_depth}")
+        if bus is not None and prediction_topic not in bus.topics():
+            # fail at construction, not mid-flush (same contract as the
+            # fleet gateway: a publish KeyError after dispatch would
+            # lose the whole flush's results)
+            raise ValueError(
+                f"bus has no topic {prediction_topic!r} (configured: "
+                f"{sorted(bus.topics())})")
+        self.pool = pool
+        self.bus = bus
+        self.warehouse = warehouse
+        self.queue_bound = queue_bound
+        self.metrics = metrics or RuntimeMetrics()
+        self.clock = clock
+        self.prediction_topic = prediction_topic
+        self.threshold = threshold
+        self.y_fields = tuple(y_fields)
+        self.max_staleness_s = max_staleness_s
+        #: 1 = one-deep overlap pipeline; 0 = strictly serial flushes
+        #: (the bit-identical A/B reference, CLI ``--serial``).
+        self.pipeline_depth = pipeline_depth
+        # staleness clock: exchange-local, exactly the solo Predictor's
+        # (signal timestamps are naive exchange-local strings)
+        if now_fn is None:
+            tz = get_timezone(timezone)
+
+            def now_fn():
+                return _dt.datetime.now(tz).replace(tzinfo=None)
+
+        self.now_fn = now_fn
+        self._consumer = (
+            bus.consumer(signal_topic, from_end=from_end)
+            if bus is not None else None)
+        self.batcher = MicroBatcher(batcher_config, clock=clock)
+        # signals are stateless one-shots: every request is its own
+        # "session" for the batcher's per-session bookkeeping, keyed by
+        # a monotonically increasing synthetic slot (no two requests
+        # ever collide, so every flush takes the lockstep fast path)
+        self._next_slot = 0
+        # double-buffered per-bucket staging, one (bucket, window, F)
+        # window buffer + one (bucket, F) ring-row buffer per parity
+        # (jax may alias host numpy on CPU; a one-deep pipeline has at
+        # most one prior dispatch still reading its staging)
+        self._staging = {}
+        self._staging_idx = {}
+        self._publish_many = (
+            getattr(bus, "publish_many", None) if bus is not None else None)
+        #: the cross-pump in-flight flush (None when pipeline_depth == 0)
+        self._inflight: Optional[_InFlight] = None
+        self._tracer = default_tracer()
+        self._ids_for = getattr(warehouse, "ids_for_timestamps", None)
+        self._fetch_windows = getattr(warehouse, "fetch_windows", None)
+
+    # -- the request path ---------------------------------------------------
+
+    def _is_stale(self, ts_str: str) -> bool:
+        if self.max_staleness_s is None:
+            return False
+        age = (self.now_fn() - parse_ts(ts_str)).total_seconds()
+        return age > self.max_staleness_s
+
+    def submit(self, ts_str: str, wire: Optional[str] = None) -> None:
+        """Enqueue a predict-timestamp signal.  ``wire`` is the signal's
+        in-band trace context, carried onto the prediction message and
+        used as the span parent.  Overload sheds the oldest queued
+        signal (counted + heartbeat-logged) — stale market signals are
+        the cheapest thing to lose."""
+        while len(self.batcher) >= self.queue_bound:
+            shed = self.batcher.shed_oldest()
+            self.metrics.count("shed_oldest")
+            n = self.metrics.counters["shed_oldest"]
+            if n == 1 or n % self.SHED_LOG_EVERY == 0:
+                log.warning(
+                    "signal queue full (bound=%d): shed oldest (%s); "
+                    "%d shed so far",
+                    self.queue_bound, shed.handle.session_id, n)
+        ref = None
+        if wire is None:
+            # bare signal: this tick may become its own sampled root
+            ref = self._tracer.maybe_trace()
+        elif self._tracer.enabled:
+            ctx = parse_wire(wire)
+            if ctx is not None:
+                # ride the signal's journey: serve spans parent on the
+                # publisher's span, t0 stamps the serve stage start
+                ref = TraceRef(ctx[0], ctx[1], now_ns())
+        slot, self._next_slot = self._next_slot, self._next_slot + 1
+        self.batcher.add(Tick(
+            handle=SessionHandle(ts_str, slot, 0), row=_NO_ROW,
+            t_enqueue=self.clock(), trace=ref, wire=wire))
+        self.metrics.gauge("queue_depth", len(self.batcher))
+
+    @property
+    def saturated(self) -> bool:
+        """Backpressure signal: the next submit will shed."""
+        return len(self.batcher) >= self.queue_bound
+
+    # -- the serving loop ---------------------------------------------------
+
+    def poll(self) -> List[Prediction]:
+        """Serve every new signal on the bus: stale-filter (solo
+        semantics, plus a ``stale_signals`` counter), batch, flush.
+        Returns the predictions made — the same contract as the solo
+        :meth:`Predictor.poll`, so ``Application.run_tick`` drives
+        either interchangeably."""
+        for rec in self._consumer.poll():
+            ts = rec.value.get("Timestamp")
+            if not ts:
+                log.warning(
+                    "signal without Timestamp at offset %d", rec.offset)
+                continue
+            if self._is_stale(ts):
+                log.warning("dropping stale signal %s", ts)
+                self.metrics.count("stale_signals")
+                continue
+            self.submit(ts, wire=rec.value.get("trace"))
+        return self.pump(force=True)
+
+    def pump(self, *, force: bool = False) -> List[Prediction]:
+        """Flush ready micro-batches (all pending when ``force``).
+        Consecutive flushes run through the one-deep overlap pipeline —
+        flush k+1's gather + dispatch run while flush k's probabilities
+        cross the host boundary and publish — persisting across calls
+        exactly like the fleet gateway's (``pump`` returns predictions
+        *completed* this call; ``force`` completes everything)."""
+        results: List[Prediction] = []
+        dispatched_any = False
+        try:
+            while True:
+                if force:
+                    if not len(self.batcher):
+                        break
+                elif not self.batcher.ready(self.clock()):
+                    break
+                ticks = self.batcher.take_batch()
+                if not ticks:
+                    break
+                nxt = self._dispatch(ticks)
+                if nxt is not None:
+                    dispatched_any = True
+                # hand the previous flush off BEFORE completing it, so a
+                # completion failure can never strand the new dispatch
+                prev, self._inflight = self._inflight, nxt
+                if prev is not None:
+                    if nxt is not None:
+                        self.metrics.count("overlapped_flushes")
+                    results.extend(self._complete_counted(prev))
+                if self.pipeline_depth == 0 and self._inflight is not None:
+                    prev, self._inflight = self._inflight, None
+                    results.extend(self._complete_counted(prev))
+            if self._inflight is not None and (force or not dispatched_any):
+                prev, self._inflight = self._inflight, None
+                results.extend(self._complete_counted(prev))
+        except BaseException:
+            # an in-flight flush's results must still publish on unwind
+            # (and a second failure is counted, never silent)
+            if self._inflight is not None:
+                prev, self._inflight = self._inflight, None
+                try:
+                    self._complete_counted(prev)
+                except Exception:  # noqa: BLE001 — don't mask the unwind
+                    log.exception(
+                        "in-flight flush lost while unwinding pump failure")
+            raise
+        finally:
+            self.metrics.gauge("queue_depth", len(self.batcher))
+        return results
+
+    def drain(self) -> List[Prediction]:
+        """Serve everything still queued (shutdown / end of load)."""
+        return self.pump(force=True)
+
+    def _complete_counted(self, inflight: _InFlight) -> List[Prediction]:
+        try:
+            return self._complete(inflight)
+        except Exception:
+            self.metrics.count("flush_results_lost", len(inflight.live))
+            raise
+
+    # -- flush stages -------------------------------------------------------
+
+    def _staging_for(self, bucket: int):
+        """The next (windows, rows) staging pair for ``bucket`` —
+        pre-allocated once, alternating between two parities."""
+        bufs = self._staging.get(bucket)
+        if bufs is None:
+            w, f = self.pool.window, self.pool.n_features
+            bufs = [
+                (np.zeros((bucket, w, f), np.float32),
+                 np.zeros((bucket, f), np.float32))
+                for _ in range(2)
+            ]
+            self._staging[bucket] = bufs
+            self._staging_idx[bucket] = 0
+        idx = self._staging_idx[bucket]
+        self._staging_idx[bucket] = 1 - idx
+        return bufs[idx]
+
+    def _lookup_ids(self, ts_list: List[str]) -> List[Optional[int]]:
+        if self._ids_for is not None:
+            return self._ids_for(ts_list)  # ONE query for the flush
+        # warehouse without the batched API (custom FeatureSource): the
+        # per-signal path still works, just without the batching win
+        return [self.warehouse.id_for_timestamp(ts) for ts in ts_list]
+
+    def _gather_ids(
+        self, ticks: List[Tick], window: int
+    ) -> Tuple[List[Tick], List[int]]:
+        """Batched id lookup + the solo path's skip semantics: unknown
+        timestamps and short-history rows are warned and counted, never
+        fatal to the flush's other signals."""
+        ts_list = [t.handle.session_id for t in ticks]
+        row_ids = self._lookup_ids(ts_list)
+        live: List[Tick] = []
+        live_ids: List[int] = []
+        for tick, rid in zip(ticks, row_ids):
+            if rid is None:
+                log.warning("no warehouse row for signal %s",
+                            tick.handle.session_id)
+                self.metrics.count("missing_rows")
+            elif rid < window:
+                log.warning(
+                    "row %d at %s has <%d rows of history; skipping",
+                    rid, tick.handle.session_id, window)
+                self.metrics.count("short_history")
+            else:
+                live.append(tick)
+                live_ids.append(rid)
+        return live, live_ids
+
+    def _gather_rows(
+        self, live_ids: List[int], windows_staging, rows_staging,
+        window: int,
+    ) -> bool:
+        """Fill the flush's staging: the ring path (the flush continues
+        the stream — consecutive positions picking up right after the
+        ring's newest row; fetch only the B new rows) or the batched
+        full-window gather (which (re-)seeds the ring).  Returns whether
+        the ring path was taken."""
+        n = len(live_ids)
+        ring_hit = (
+            self.pool.use_ring
+            and self.pool.ring_pos == live_ids[0] - 1
+            and live_ids == list(range(live_ids[0], live_ids[0] + n))
+        )
+        if ring_hit:
+            rows_staging[:n] = self.warehouse.fetch(
+                range(live_ids[0], live_ids[-1] + 1))
+            rows_staging[n:] = 0.0
+            self.metrics.count("ring_hits")
+        else:
+            windows = (
+                self._fetch_windows(live_ids, window)
+                if self._fetch_windows is not None
+                else np.stack([
+                    self.warehouse.fetch(range(rid - window + 1, rid + 1))
+                    for rid in live_ids
+                ]))
+            windows_staging[:n] = windows
+            if self.pool.use_ring:
+                self.pool.seed_ring(windows[-1], live_ids[-1])
+                self.metrics.count("ring_misses")
+        return ring_hit
+
+    def _dispatch(self, ticks: List[Tick]) -> Optional[_InFlight]:
+        """Stage 1 of a flush: batched id lookup + window gather (or the
+        device-ring append), then the async bucketed forward.  Returns
+        None when every signal was skipped (missing row/short history —
+        the solo path's warnings, plus counters) or when the warehouse
+        read failed (the batched analogue of the solo poll()'s
+        per-signal error isolation: a transient backend error drops the
+        flush's signals — counted, never silent — and the serving loop
+        keeps running)."""
+        tracing = self._tracer.enabled
+        t_gather = self.clock()
+        t_gather_ns = now_ns() if tracing else 0
+        window = self.pool.window
+        with self.metrics.timer.stage("gather"):
+            try:
+                live, live_ids = self._gather_ids(ticks, window)
+                if not live:
+                    return None
+                bucket = self.batcher.bucket_for(len(live))
+                windows_staging, rows_staging = self._staging_for(bucket)
+                n = len(live)
+                ring_hit = self._gather_rows(
+                    live_ids, windows_staging, rows_staging, window)
+            except Exception:  # noqa: BLE001 — a warehouse failure
+                # mid-flush must not abort the poll/pump loop (the solo
+                # Predictor's per-signal isolation, per flush here: a
+                # batched read cannot name the failing signal)
+                self.metrics.count("gather_errors")
+                self.metrics.count("signals_dropped_on_error", len(ticks))
+                log.exception(
+                    "batched warehouse gather failed; dropping %d "
+                    "queued signal(s) and continuing", len(ticks))
+                return None
+        t_dispatch = self.clock()
+        t_dispatch_ns = now_ns() if tracing else 0
+        with self.metrics.timer.stage("dispatch"):
+            if ring_hit:
+                probs_dev = self.pool.ring_forward_device(
+                    rows_staging, n, live_ids[-1])
+            else:
+                probs_dev = self.pool.forward_device(windows_staging)
+        t_dispatched = self.clock()
+        t_dispatched_ns = now_ns() if tracing else 0
+
+        m = self.metrics
+        m.count("flushes")
+        m.count(f"flushes_bucket_{bucket}")
+        m.count("padded_lanes", bucket - n)
+        m.observe("gather", t_dispatch - t_gather)
+        m.observe("dispatch", t_dispatched - t_dispatch)
+        for tick in live:
+            m.observe("enqueue_to_dispatch", t_gather - tick.t_enqueue)
+        return _InFlight(
+            live=live, probs_dev=probs_dev, bucket=bucket,
+            t_gather_ns=t_gather_ns, t_dispatch_ns=t_dispatch_ns,
+            t_dispatched_ns=t_dispatched_ns)
+
+    def _complete(self, inflight: _InFlight) -> List[Prediction]:
+        """Stage 2: force the host transfer, threshold labels, publish
+        the whole flush in one batched bus call."""
+        tracing = self._tracer.enabled
+        t_synced = self.clock()
+        with self.metrics.timer.stage("device"):
+            probs = np.asarray(inflight.probs_dev)  # blocks: host array
+        t_device = self.clock()
+        t_device_ns = now_ns() if tracing else 0
+
+        results: List[Prediction] = []
+        messages = [] if self.bus is not None else None
+        t_pub0_ns = 0
+        with self.metrics.timer.stage("publish"):
+            for i, tick in enumerate(inflight.live):
+                p = probs[i]
+                idx, labels = labels_over_threshold(
+                    p, self.threshold, self.y_fields)
+                pred = Prediction(
+                    timestamp=tick.handle.session_id,
+                    probabilities=tuple(float(v) for v in p),
+                    threshold=self.threshold,
+                    labels=labels,
+                    label_indices=idx,
+                )
+                results.append(pred)
+                if messages is not None:
+                    # in-band context propagates onward: the signal's own
+                    # wire when it arrived with one, this tick's sampled
+                    # root otherwise
+                    wire = tick.wire if tick.wire is not None else (
+                        tick.trace.wire if tick.trace is not None else None)
+                    messages.append(prediction_message(pred, wire))
+            if messages:
+                t_pub0_ns = now_ns() if tracing else 0
+                if self._publish_many is not None:
+                    self._publish_many(self.prediction_topic, messages)
+                else:
+                    for msg in messages:
+                        self.bus.publish(self.prediction_topic, msg)
+        t_publish = self.clock()
+
+        m = self.metrics
+        m.count("signals_served", len(results))
+        m.observe("device", t_device - t_synced)
+        m.observe("publish", t_publish - t_device)
+        for tick in inflight.live:
+            m.observe("total", t_publish - tick.t_enqueue)
+        if tracing:
+            self._record_flush_spans(inflight, t_device_ns, t_pub0_ns)
+        return results
+
+    def _record_flush_spans(
+        self, inflight: _InFlight, t_device_ns: int, t_pub0_ns: int
+    ) -> None:
+        """Close every traced signal in a completed flush: queued /
+        gather / dispatch / device / publish children tiling the serve
+        journey.  Signals with in-band context get the children under a
+        ``serve`` span on their OWN trace (stitching into the engine →
+        serve journey, like the solo Predictor's serve span — with the
+        breakdown the solo span never had); bare sampled signals get
+        their own root, closed via ``finish_root`` so they feed
+        ``e2e_tick_seconds``."""
+        if not inflight.t_gather_ns:
+            return  # dispatched before tracing was enabled
+        tr = self._tracer
+        t_publish_ns = now_ns()
+        for tick in inflight.live:
+            ref = tick.trace
+            if ref is None:
+                continue
+            tid = ref.trace_id
+            if tick.wire is not None:
+                parent = tr.add_span(tid, ref.span_id, "serve", "serve",
+                                     ref.t0_ns, t_publish_ns)
+            else:
+                parent = ref.span_id
+            tr.add_span(tid, parent, "queued", "gateway",
+                        ref.t0_ns, inflight.t_gather_ns)
+            tr.add_span(tid, parent, "gather", "warehouse",
+                        inflight.t_gather_ns, inflight.t_dispatch_ns)
+            tr.add_span(tid, parent, "dispatch", "gateway",
+                        inflight.t_dispatch_ns, inflight.t_dispatched_ns)
+            tr.add_span(tid, parent, "device", "pool",
+                        inflight.t_dispatched_ns, t_device_ns)
+            pub = tr.add_span(tid, parent, "publish", "publish",
+                              t_device_ns, t_publish_ns)
+            if t_pub0_ns:
+                tr.add_span(tid, pub, "bus_publish", "bus",
+                            t_pub0_ns, t_publish_ns)
+            if tick.wire is None:
+                tr.finish_root(ref, "predict", "serve", t_publish_ns)

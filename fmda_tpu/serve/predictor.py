@@ -52,6 +52,45 @@ def labels_over_threshold(
     return idx, tuple(y_fields[i] for i in idx)
 
 
+def make_batched_forward(model_cfg: ModelConfig):
+    """The one window-re-scan forward every serving path shares:
+    ``(params, x_min, x_range, x)`` with ``x`` of shape
+    ``(B, window, F)`` → ``(B, n_classes)`` sigmoid probabilities,
+    normalization folded into the compiled program.
+
+    Norm stats are jit *arguments*, not closure constants (a constant
+    denominator compiles differently at the ulp level — the same lesson
+    the carried-state cores learned in PR 1), and the batch dimension is
+    left free, so the solo :class:`Predictor` at ``(1, window, F)`` and
+    the fleet :class:`~fmda_tpu.runtime.predictor_pool.PredictorPool` at
+    bucket size 1 jit the *identical* program — the bit-identity
+    contract ``tests/test_predictor_fleet.py`` asserts."""
+    model = build_model(model_cfg)
+
+    def forward(params, x_min, x_range, x):
+        x = (x - x_min) / x_range
+        logits = model.apply({"params": params}, x)
+        return jax.nn.sigmoid(logits)
+
+    return forward
+
+
+def prediction_message(pred: "Prediction", trace: Optional[str]) -> dict:
+    """The ``prediction``-topic payload (reference predict.py:193-197
+    fields) — shared by the solo Predictor and the batched gateway so
+    the wire schema cannot fork."""
+    msg = {
+        "timestamp": pred.timestamp,
+        "probabilities": list(pred.probabilities),
+        "prob_threshold": pred.threshold,
+        "pred_indices": list(pred.label_indices),
+        "pred_labels": list(pred.labels),
+    }
+    if trace is not None:
+        msg["trace"] = trace
+    return msg
+
+
 @dataclass(frozen=True)
 class Prediction:
     timestamp: str
@@ -103,15 +142,17 @@ class Predictor:
         self._params = params
         self._x_min = jnp.asarray(norm_params.x_min)
         self._x_range = jnp.asarray(norm_params.x_max - norm_params.x_min)
+        #: per-signal failures survived by poll() (also counted on the
+        #: process-default registry as ``serve_errors_total``)
+        self.serve_errors = 0
+        from fmda_tpu.obs.registry import default_registry
 
-        model = build_model(model_cfg)
+        self._errors_counter = default_registry().counter(
+            "serve_errors_total")
 
-        def forward(params, x):
-            x = (x - self._x_min) / self._x_range
-            logits = model.apply({"params": params}, x)
-            return jax.nn.sigmoid(logits)[0]
-
-        self._forward = jax.jit(forward)
+        # the shared batched forward at B=1 — the same compiled program
+        # the fleet PredictorPool replays at bucket size 1
+        self._forward = jax.jit(make_batched_forward(model_cfg))
 
     @classmethod
     def from_checkpoint(
@@ -168,7 +209,8 @@ class Predictor:
             return None
         ids = range(row_id - self.window + 1, row_id + 1)
         x = self.warehouse.fetch(ids)[None, ...]  # (1, window, F)
-        probs = np.asarray(self._forward(self._params, jnp.asarray(x)))
+        probs = np.asarray(self._forward(
+            self._params, self._x_min, self._x_range, jnp.asarray(x)))[0]
         idx, labels = labels_over_threshold(probs, self.threshold,
                                             self.y_fields)
         pred = Prediction(
@@ -178,16 +220,8 @@ class Predictor:
             labels=labels,
             label_indices=idx,
         )
-        msg = {
-            "timestamp": pred.timestamp,
-            "probabilities": list(pred.probabilities),
-            "prob_threshold": pred.threshold,
-            "pred_indices": list(pred.label_indices),
-            "pred_labels": list(pred.labels),
-        }
-        if trace is not None:
-            msg["trace"] = trace
-        self.bus.publish(self.prediction_topic, msg)
+        self.bus.publish(self.prediction_topic,
+                         prediction_message(pred, trace))
         if t0_ns:
             tracer.add_span_wire(trace, "serve", "serve", t0_ns, now_ns())
         return pred
@@ -203,8 +237,18 @@ class Predictor:
             if self._is_stale(ts_str):
                 log.warning("dropping stale signal %s", ts_str)
                 continue
-            pred = self.predict_for_timestamp(
-                ts_str, trace=rec.value.get("trace"))
+            try:
+                pred = self.predict_for_timestamp(
+                    ts_str, trace=rec.value.get("trace"))
+            except Exception:  # noqa: BLE001 — one bad signal (e.g. a
+                # warehouse fetch error) must not abort the rest of the
+                # poll batch: count it, log it, serve the remainder
+                self.serve_errors += 1
+                self._errors_counter.inc()
+                log.exception(
+                    "serving signal %s failed (%d so far); continuing "
+                    "with the remaining signals", ts_str, self.serve_errors)
+                continue
             if pred is not None:
                 out.append(pred)
                 log.info(

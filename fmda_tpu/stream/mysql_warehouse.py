@@ -331,6 +331,25 @@ class MySQLWarehouse:
             )
         return np.asarray([by_id[i] for i in ids], np.float32)
 
+    def fetch_windows(self, row_ids: Sequence[int], window: int):
+        """Batched trailing-window gather, ``(B, window, F)`` — the same
+        contract as the embedded Warehouse's: one round-trip for the
+        *union* of window ids (overlapping windows of a flush share most
+        rows, and :meth:`fetch` already de-duplicates the IN list), then
+        a host-side reshape per window.  Raises on any missing row, like
+        :meth:`fetch`."""
+        import numpy as np
+
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        row_ids = [int(i) for i in row_ids]
+        if not row_ids:
+            return np.zeros(
+                (0, window, len(self.features.x_fields())), np.float32)
+        flat = [i - window + 1 + k for i in row_ids for k in range(window)]
+        rows = self.fetch(flat)  # ONE IN-query over the de-duplicated ids
+        return rows.reshape(len(row_ids), window, -1)
+
     def fetch_targets(self, ids: Sequence[int]):
         """Target labels in the requested id order (same contract as
         :meth:`fetch`)."""

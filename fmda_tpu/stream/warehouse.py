@@ -234,6 +234,70 @@ class Warehouse:
             ).fetchone()
             return int(pos)
 
+    def ids_for_timestamps(
+        self, ts_list: Sequence[str]
+    ) -> List[Optional[int]]:
+        """Batched :meth:`id_for_timestamp`: positions for a whole flush
+        of signal timestamps in ONE indexed query plus one sorted-array
+        lookup against the row-ID cache — the fleet predictor gateway's
+        per-flush replacement for B per-signal lookup queries.  Unknown
+        timestamps map to None (the caller skips them, visibly)."""
+        ts_list = list(ts_list)
+        if not ts_list:
+            return []
+        qmarks = ", ".join("?" * len(ts_list))
+        with self._lock:
+            # the cache refresh guarantees _ids covers every committed
+            # row the query can return (signals fire after commit)
+            self._refresh_derived()
+            rows = self._conn.execute(
+                f"SELECT Timestamp, MAX(ID) FROM {self.table} "
+                f"WHERE Timestamp IN ({qmarks}) GROUP BY Timestamp",
+                ts_list,
+            ).fetchall()
+            by_ts = {r[0]: int(r[1]) for r in rows}
+            # _ids is strictly increasing (insertion order), so the rank
+            # of an ID — its 1-based position, the space fetch() speaks —
+            # is one searchsorted away
+            return [
+                int(np.searchsorted(self._ids, by_ts[ts])) + 1
+                if ts in by_ts else None
+                for ts in ts_list
+            ]
+
+    def fetch_windows(
+        self, row_ids: Sequence[int], window: int
+    ) -> np.ndarray:
+        """Batched trailing-window gather: ``(B, window, F)`` feature
+        windows ending at each 1-based ``row_ids`` position, from one
+        cache refresh and one vectorized gather — the batched-serving
+        replacement for B per-signal ``fetch(range(...))`` calls.  Bit-
+        identical to stacking :meth:`fetch` windows (same gather, same
+        NaN policy; tests assert it).  Raises IndexError when any window
+        would reach before row 1 or past the newest row."""
+        t0 = _time.perf_counter() if self._obs_query_hist is not None else 0.0
+        try:
+            return self._fetch_windows(row_ids, window)
+        finally:
+            if self._obs_query_hist is not None:
+                self._obs_query_hist.observe(_time.perf_counter() - t0)
+
+    def _fetch_windows(
+        self, row_ids: Sequence[int], window: int
+    ) -> np.ndarray:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        pos = np.asarray(list(row_ids), np.int64)
+        if pos.size == 0:
+            return np.zeros((0, window, len(self.x_fields)), np.float32)
+        # (B, window) 1-based positions of each trailing window, through
+        # the ONE existing gather (:meth:`_fetch`) — bit-identity with
+        # stacked per-signal fetches holds by construction, and the NaN
+        # policy / derived-column layout live in exactly one place
+        flat = (pos[:, None]
+                - np.arange(window - 1, -1, -1)[None, :]).reshape(-1)
+        return self._fetch(flat).reshape(len(pos), window, -1)
+
     def _fetch_rows_after(
         self, row_id: int
     ) -> Tuple[np.ndarray, np.ndarray, List[str]]:

@@ -67,6 +67,7 @@ class TestPhaseRegistry:
             "tpu_export",
             "replay",
             "runtime_fleet_smoke",
+            "predictor_fleet_smoke",
             "obs_overhead",
             "trace_overhead",
         }

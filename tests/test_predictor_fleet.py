@@ -1,0 +1,465 @@
+"""Batched Predictor serving (ISSUE 5): the window-re-scan path on the
+fleet runtime.
+
+The acceptance surface: batched == solo `Predictor` **bit-identical** at
+bucket size 1 (same checkpoint/model/signals, same published payloads),
+staleness-drop and missing-row/short-history skips preserved under
+batching, compile_count == len(buckets actually used), the serial
+(`pipeline_depth=0`) A/B reference bit-identical to the overlapped
+default, and the optional device-resident window ring bit-identical to
+the fetch path (same compiled forward, same row values).
+"""
+
+import datetime as dt
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    ModelConfig,
+    TOPIC_PREDICTION,
+    TOPIC_PREDICT_TIMESTAMP,
+    WarehouseConfig,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.models import build_model
+from fmda_tpu.runtime import (
+    BatcherConfig,
+    PredictorGateway,
+    PredictorPool,
+)
+from fmda_tpu.serve import Predictor
+from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
+
+from test_stream import _session_messages, _small_features
+
+WINDOW = 3
+
+
+def _warehouse(n_ticks=12):
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+    for topic, msg in _session_messages(n_ticks):
+        bus.publish(topic, msg)
+    eng.step()
+    return wh
+
+
+def _model(wh, hidden=4, seed=0):
+    cfg = ModelConfig(hidden_size=hidden, n_features=len(wh.x_fields),
+                      output_size=4, dropout=0.0, use_pallas=False)
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(seed)},
+        jnp.zeros((1, WINDOW, cfg.n_features)))["params"]
+    norm = NormParams(np.zeros(cfg.n_features, np.float32),
+                      np.ones(cfg.n_features, np.float32))
+    return cfg, params, norm
+
+
+def _gateway(wh, cfg, params, norm, *, buckets=(1,), use_ring=False,
+             pipeline_depth=1, **kwargs):
+    pool = PredictorPool(cfg, params, norm, window=WINDOW,
+                         use_ring=use_ring)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    gw = PredictorGateway(
+        pool, bus, wh,
+        batcher_config=BatcherConfig(bucket_sizes=buckets,
+                                     max_linger_s=0.0),
+        from_end=False, max_staleness_s=None,
+        pipeline_depth=pipeline_depth, **kwargs)
+    return gw, bus
+
+
+def _signal(bus, ts, **extra):
+    bus.publish(TOPIC_PREDICT_TIMESTAMP, {"Timestamp": ts, **extra})
+
+
+# ---------------------------------------------------------------------------
+# the numerical contract: batched == solo, bit for bit at bucket 1
+# ---------------------------------------------------------------------------
+
+
+def test_batched_bucket1_bit_identical_to_solo():
+    """The whole batched path — batched id lookup, vectorized window
+    gather, bucketed jitted forward, publish_many — adds exactly zero
+    numerical or payload change at bucket size 1: every Prediction and
+    every published message equals the solo Predictor's, bit for bit
+    (they jit the same make_batched_forward program at (1, W, F))."""
+    wh = _warehouse()
+    cfg, params, norm = _model(wh)
+    solo_bus = InProcessBus(DEFAULT_TOPICS)
+    solo = Predictor(solo_bus, wh, cfg, params, norm, window=WINDOW,
+                     from_end=False, max_staleness_s=None)
+    gw, gw_bus = _gateway(wh, cfg, params, norm, buckets=(1,))
+
+    for ts in wh.timestamps():
+        _signal(solo_bus, ts)
+        _signal(gw_bus, ts)
+    solo_preds = solo.poll()
+    batched_preds = gw.poll()
+
+    assert len(solo_preds) == len(batched_preds) == len(wh) - (WINDOW - 1)
+    # Prediction is a frozen dataclass: == compares every field exactly,
+    # including the float probability tuples
+    assert solo_preds == batched_preds
+    solo_msgs = [m.value for m in solo_bus.consumer(TOPIC_PREDICTION).poll()]
+    gw_msgs = [m.value for m in gw_bus.consumer(TOPIC_PREDICTION).poll()]
+    assert solo_msgs == gw_msgs
+    assert gw.pool.compile_count == 1
+    assert gw.metrics.counters["signals_served"] == len(batched_preds)
+
+
+def test_overlap_pipeline_bit_identical_to_serial():
+    """pipeline_depth=0 (the --serial A/B reference) serves the same
+    signals to the same predictions and the same bus transcript as the
+    overlapped default — the pipeline reorders WORK, never results."""
+    wh = _warehouse(n_ticks=16)
+    cfg, params, norm = _model(wh)
+    gws = [_gateway(wh, cfg, params, norm, buckets=(2,),
+                    pipeline_depth=d) for d in (0, 1)]
+    ts_all = wh.timestamps()
+    outs = []
+    for gw, bus in gws:
+        for i in range(0, len(ts_all), 6):  # bursts -> multi-flush drains
+            for ts in ts_all[i:i + 6]:
+                _signal(bus, ts)
+            outs.append((gw, gw.poll()))
+    serial = [p for gw, ps in outs if gw is gws[0][0] for p in ps]
+    overlapped = [p for gw, ps in outs if gw is gws[1][0] for p in ps]
+    assert serial == overlapped
+    msgs = [[m.value for m in bus.consumer(TOPIC_PREDICTION).poll()]
+            for _, bus in gws]
+    assert msgs[0] == msgs[1]
+    assert gws[1][0].metrics.counters["overlapped_flushes"] > 0
+    assert gws[0][0].metrics.counters.get("overlapped_flushes", 0) == 0
+
+
+def test_ring_path_bit_identical_to_fetch_path():
+    """The device-resident window ring changes WHERE the (B, window, F)
+    gather happens (device vs host), never the values: consecutive
+    signals through a ring gateway are bit-identical to the fetch
+    gateway, hits/misses are counted, and a gap (skipped signal) falls
+    back to the batched gather and re-seeds."""
+    wh = _warehouse(n_ticks=16)
+    cfg, params, norm = _model(wh)
+    gw_fetch, bus_f = _gateway(wh, cfg, params, norm, buckets=(2, 4))
+    gw_ring, bus_r = _gateway(wh, cfg, params, norm, buckets=(2, 4),
+                              use_ring=True)
+    ts_all = wh.timestamps()
+    fetch_preds, ring_preds = [], []
+    # consecutive bursts (ring hits after the seeding first flush)...
+    for i in range(2, 10, 4):
+        for ts in ts_all[i:i + 4]:
+            _signal(bus_f, ts)
+            _signal(bus_r, ts)
+        fetch_preds.extend(gw_fetch.poll())
+        ring_preds.extend(gw_ring.poll())
+    assert gw_ring.metrics.counters["ring_hits"] > 0
+    # ...then a GAP (skip one signal): the ring must miss and re-seed
+    for ts in ts_all[11:15]:
+        _signal(bus_f, ts)
+        _signal(bus_r, ts)
+    fetch_preds.extend(gw_fetch.poll())
+    ring_preds.extend(gw_ring.poll())
+    assert fetch_preds == ring_preds
+    assert gw_ring.metrics.counters["ring_misses"] >= 2  # seed + gap
+    # the ring never adds forward compilations
+    assert gw_ring.pool.compile_count == gw_fetch.pool.compile_count
+
+
+# ---------------------------------------------------------------------------
+# compile stability + solo-path skip semantics under batching
+# ---------------------------------------------------------------------------
+
+
+def test_compile_count_equals_buckets_used():
+    """Ragged burst sizes over many flushes compile exactly one forward
+    per configured bucket actually used — never one per flush size."""
+    wh = _warehouse(n_ticks=20)
+    cfg, params, norm = _model(wh)
+    gw, bus = _gateway(wh, cfg, params, norm, buckets=(2, 4, 8))
+    ts_all = wh.timestamps()[WINDOW - 1:]
+    assert gw.pool.compile_count == 0
+    i = 0
+    for burst in (1, 2, 3, 4, 1, 3, 4, 2):
+        for ts in ts_all[i:i + burst]:
+            _signal(bus, ts)
+        i += burst
+        gw.poll()
+    assert gw.pool.compile_count == 2  # buckets 2 and 4, ever
+    c = gw.metrics.counters
+    assert c["flushes_bucket_2"] + c["flushes_bucket_4"] == c["flushes"]
+
+
+def test_skips_preserved_under_batching():
+    """The solo path's signal hygiene survives batching: stale signals
+    dropped before queueing, unknown timestamps and short-history rows
+    skipped mid-flush — each counted, none aborting the flush's other
+    signals."""
+    wh = _warehouse()
+    cfg, params, norm = _model(wh)
+    gw, bus = _gateway(wh, cfg, params, norm, buckets=(8,))
+    gw.max_staleness_s = 240
+    gw.now_fn = lambda: dt.datetime(2020, 2, 7, 9, 48, 0)
+    ts_all = wh.timestamps()  # 09:30, 09:35, ... (5-min ticks)
+    _signal(bus, ts_all[0])       # 09:30: short history AND stale
+    _signal(bus, ts_all[3])       # 09:45: servable, fresh
+    _signal(bus, "2020-02-07 09:46:00")  # fresh but no warehouse row
+    _signal(bus, ts_all[1])       # 09:35: short history? no — row 2 < 3;
+                                  # also 13 min old -> stale, dropped first
+    preds = gw.poll()
+    assert [p.timestamp for p in preds] == [ts_all[3]]
+    c = gw.metrics.counters
+    assert c["stale_signals"] == 2      # 09:30 and 09:35
+    assert c["missing_rows"] == 1       # 09:46
+    assert c["signals_served"] == 1
+
+    # short history on its own (fresh signal, row < window)
+    gw.max_staleness_s = None
+    _signal(bus, ts_all[1])
+    assert gw.poll() == []
+    assert c["short_history"] == 1
+
+
+def test_all_skipped_flush_dispatches_nothing():
+    wh = _warehouse()
+    cfg, params, norm = _model(wh)
+    gw, bus = _gateway(wh, cfg, params, norm, buckets=(4,))
+    _signal(bus, "1999-01-01 00:00:00")
+    _signal(bus, "1999-01-01 00:05:00")
+    assert gw.poll() == []
+    assert gw.metrics.counters["missing_rows"] == 2
+    assert gw.metrics.counters.get("flushes", 0) == 0
+
+
+def test_overload_sheds_oldest_signals_counted():
+    wh = _warehouse()
+    cfg, params, norm = _model(wh)
+    gw, bus = _gateway(wh, cfg, params, norm, buckets=(4,),
+                       queue_bound=3)
+    ts_all = wh.timestamps()
+    for ts in ts_all[2:8]:  # 6 submits into a bound of 3
+        gw.submit(ts)
+    assert len(gw.batcher) == 3
+    assert gw.saturated
+    assert gw.metrics.counters["shed_oldest"] == 3
+    preds = gw.drain()
+    # survivors are the NEWEST three signals
+    assert [p.timestamp for p in preds] == ts_all[5:8]
+
+
+def test_pump_failure_never_strands_the_inflight_flush():
+    """A publish failure mid-pump completes the already-dispatched next
+    flush on unwind and counts the lost flush — same contract as the
+    carried-state gateway."""
+    wh = _warehouse()
+    cfg, params, norm = _model(wh)
+
+    class FailOnceBus(InProcessBus):
+        def __init__(self, topics):
+            super().__init__(topics)
+            self.failed = False
+
+        def publish_many(self, topic, values):
+            if not self.failed:
+                self.failed = True
+                raise RuntimeError("transport hiccup")
+            return super().publish_many(topic, values)
+
+    pool = PredictorPool(cfg, params, norm, window=WINDOW)
+    bus = FailOnceBus(DEFAULT_TOPICS)
+    gw = PredictorGateway(
+        pool, bus, wh,
+        batcher_config=BatcherConfig(bucket_sizes=(2,), max_linger_s=0.0),
+        from_end=False, max_staleness_s=None)
+    ts_all = wh.timestamps()
+    for ts in ts_all[2:6]:  # two bucket-2 flushes
+        _signal(bus, ts)
+    with pytest.raises(RuntimeError, match="transport hiccup"):
+        gw.poll()
+    assert gw.metrics.counters["flush_results_lost"] == 2
+    assert gw.metrics.counters["signals_served"] == 2  # flush 2 landed
+    msgs = bus.consumer(TOPIC_PREDICTION).poll()
+    assert [m.value["timestamp"] for m in msgs] == ts_all[4:6]
+    # the gateway stays serviceable
+    for ts in ts_all[6:8]:
+        _signal(bus, ts)
+    assert [p.timestamp for p in gw.poll()] == ts_all[6:8]
+
+
+def test_gather_failure_drops_flush_counted_and_keeps_serving():
+    """A warehouse error during the batched gather (e.g. a transient DB
+    failure on a MySQL backend) must not abort poll() or silently lose
+    signals: the flush is dropped with counters and the gateway keeps
+    serving — the batched analogue of the solo poll()'s per-signal
+    error isolation."""
+    wh = _warehouse()
+    cfg, params, norm = _model(wh)
+    gw, bus = _gateway(wh, cfg, params, norm, buckets=(4,))
+    ts_all = wh.timestamps()
+    real = wh.fetch_windows
+    calls = {"n": 0}
+
+    def flaky(ids, window):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("db went away")
+        return real(ids, window)
+
+    gw._fetch_windows = flaky
+    for ts in ts_all[2:5]:
+        _signal(bus, ts)
+    assert gw.poll() == []  # flush dropped, loop survived
+    assert gw.metrics.counters["gather_errors"] == 1
+    assert gw.metrics.counters["signals_dropped_on_error"] == 3
+    for ts in ts_all[5:8]:
+        _signal(bus, ts)
+    assert [p.timestamp for p in gw.poll()] == ts_all[5:8]
+
+
+def test_gateway_rejects_bad_construction():
+    wh = _warehouse(n_ticks=4)
+    cfg, params, norm = _model(wh)
+    pool = PredictorPool(cfg, params, norm, window=WINDOW)
+    with pytest.raises(ValueError, match="prediction"):
+        PredictorGateway(pool, InProcessBus(("vix",)), wh)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        PredictorGateway(pool, InProcessBus(DEFAULT_TOPICS), wh,
+                         pipeline_depth=2)
+    with pytest.raises(ValueError, match="window"):
+        PredictorPool(cfg, params, norm, window=0)
+
+
+# ---------------------------------------------------------------------------
+# the batched warehouse reads
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_windows_matches_per_signal_fetch():
+    """One vectorized gather == B stacked fetch(range(...)) calls, bit
+    for bit (same gather, same NaN policy), and range errors raise."""
+    wh = _warehouse()
+    ids = [3, 5, 5, 9]  # duplicates allowed
+    got = wh.fetch_windows(ids, WINDOW)
+    assert got.shape == (4, WINDOW, len(wh.x_fields))
+    for i, rid in enumerate(ids):
+        np.testing.assert_array_equal(
+            got[i], wh.fetch(range(rid - WINDOW + 1, rid + 1)))
+    assert wh.fetch_windows([], WINDOW).shape == (0, WINDOW,
+                                                  len(wh.x_fields))
+    with pytest.raises(IndexError):
+        wh.fetch_windows([2], WINDOW)  # needs rows 0..2: before row 1
+    with pytest.raises(IndexError):
+        wh.fetch_windows([len(wh) + 1], WINDOW)
+    with pytest.raises(ValueError, match="window"):
+        wh.fetch_windows([5], 0)
+
+
+def test_ids_for_timestamps_matches_per_signal_lookup():
+    wh = _warehouse()
+    ts_all = wh.timestamps()
+    queries = [ts_all[4], "2099-01-01 00:00:00", ts_all[0], ts_all[-1]]
+    batched = wh.ids_for_timestamps(queries)
+    assert batched == [wh.id_for_timestamp(ts) for ts in queries]
+    assert batched[1] is None
+    assert wh.ids_for_timestamps([]) == []
+
+
+def test_mysql_fetch_windows_single_query(monkeypatch):
+    """The MariaDB adapter's batched window fetch: one IN-query for the
+    whole flush, windows assembled in requested order."""
+    import sys as _sys
+
+    import fake_mysql
+
+    from fmda_tpu.config import FeatureConfig
+    from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse
+
+    fake_mysql.SERVER = fake_mysql.FakeServer()
+    monkeypatch.setitem(_sys.modules, "mysql", fake_mysql)
+    monkeypatch.setitem(_sys.modules, "mysql.connector",
+                        fake_mysql.connector)
+    fc = FeatureConfig()
+    wh = MySQLWarehouse(fc, WarehouseConfig(backend="mysql"))
+    n_fields = len(fc.x_fields())
+    rng = np.random.default_rng(0)
+    rows = {i: tuple(rng.normal(size=n_fields)) for i in range(1, 7)}
+    fake_mysql.SERVER.seed(rows, {})
+    n_stmts = len(fake_mysql.SERVER.statements)
+    got = wh.fetch_windows([3, 5], 2)
+    assert len(fake_mysql.SERVER.statements) == n_stmts + 1  # ONE query
+    assert got.shape == (2, 2, n_fields)
+    np.testing.assert_array_equal(got[0], wh.fetch([2, 3]))
+    np.testing.assert_array_equal(got[1], wh.fetch([4, 5]))
+    with pytest.raises(IndexError):
+        wh.fetch_windows([99], 2)
+
+
+# ---------------------------------------------------------------------------
+# app + obs + CLI wiring
+# ---------------------------------------------------------------------------
+
+
+def test_attach_predictor_fleet_serves_through_run_tick():
+    """Application.attach_predictor_fleet joins the predictors list, so
+    run_tick polls it like a solo predictor, and its RuntimeMetrics land
+    on the obs plane under the predictor_ prefix."""
+    import dataclasses
+
+    from fmda_tpu.app import Application
+    from fmda_tpu.config import FrameworkConfig, RuntimeConfig
+
+    fc = _small_features(get_cot=False)
+    app_cfg = dataclasses.replace(
+        FrameworkConfig(features=fc),
+        runtime=RuntimeConfig(window=WINDOW,
+                              predictor_bucket_sizes=(4,),
+                              predictor_ring=True))
+    app = Application(app_cfg)
+    try:
+        for topic, msg in _session_messages(8):
+            app.bus.publish(topic, msg)
+        cfg, params, norm = _model(app.warehouse)
+        gw = app.attach_predictor_fleet(
+            cfg, params, norm, from_end=False, max_staleness_s=None)
+        assert gw.pool.use_ring
+        assert gw.batcher.config.bucket_sizes == (4,)
+        out = app.run_tick()  # engine lands rows + emits signals,
+        # run_tick polls the gateway in the same tick
+        assert out["served"] == 8 - (WINDOW - 1)
+        names = {s["name"] for s in app.observability.snapshot()["counters"]}
+        assert "predictor_signals_served_total" in names
+        health = app.observability.health()
+        assert health["checks"]["predictor_queue"]["ok"]
+    finally:
+        app.close()
+
+
+def test_serve_fleet_cli_predictor(capsys):
+    from fmda_tpu.cli import main
+
+    assert main(["serve-fleet", "--predictor", "--predictor-days", "2",
+                 "--hidden", "4", "--window", "3", "--bucket-sizes", "8",
+                 "--signals", "24", "--burst", "8", "--seed", "0"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["signals_served"] == out["signals_submitted"] == 24
+    assert out["compile_count"] == 1
+    assert out["counters"]["signals_served"] == 24
+    assert out["ring"] is False
+
+    # --serial + --ring knobs reach the gateway; SLO gate verdict wired
+    assert main(["serve-fleet", "--predictor", "--predictor-days", "2",
+                 "--hidden", "4", "--window", "3", "--bucket-sizes", "8",
+                 "--signals", "8", "--ring", "--serial",
+                 "--slo-p99-ms", "1e9"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ring"] is True
+    assert out["slo"]["ok"] is True
+    assert out["counters"].get("overlapped_flushes", 0) == 0

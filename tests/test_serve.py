@@ -114,6 +114,40 @@ def test_signal_for_missing_row_skipped():
     assert predictor.poll() == []  # warehouse empty -> warn + skip, no crash
 
 
+def test_poll_survives_per_signal_failure():
+    """One signal blowing up mid-loop (e.g. a warehouse fetch error)
+    must not abort the rest of the poll batch: the failure is counted
+    (serve_errors) and the remaining signals are served."""
+    fc, bus, wh, eng, predictor = _served_pipeline()
+    for topic, msg in _session_messages(8):
+        bus.publish(topic, msg)
+    eng.step()
+
+    ts_all = wh.timestamps()
+    boom = ts_all[4]
+    real_fetch = wh.fetch
+
+    def flaky_fetch(ids):
+        rows = list(ids)
+        if wh.id_for_timestamp(boom) == rows[-1]:
+            raise RuntimeError("disk on fire")
+        return real_fetch(rows)
+
+    wh.fetch = flaky_fetch
+    try:
+        preds = predictor.poll()
+    finally:
+        wh.fetch = real_fetch
+    # rows 1,2 lack history; row 5 (boom) failed; 8 - 2 - 1 = 5 served
+    assert len(preds) == 5
+    assert boom not in {p.timestamp for p in preds}
+    assert predictor.serve_errors == 1
+    # the failure is visible on the process-default registry too
+    from fmda_tpu.obs.registry import default_registry
+
+    assert default_registry().counter("serve_errors_total").value >= 1
+
+
 def test_from_checkpoint_full_loop(tmp_path):
     """Train on the warehouse, checkpoint, then serve from that checkpoint —
     the full train->serve artifact handoff (params + norm in one tree,

@@ -561,3 +561,261 @@ def test_idle_pump_flushes_the_persistent_pipeline():
     assert gw.pump() == []          # dispatched, in flight
     assert len(gw.pump()) == 2      # idle pump -> pipeline flushed
     assert gw.pump() == []          # nothing left
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace merge (ISSUE 5: ROADMAP trace follow-up)
+# ---------------------------------------------------------------------------
+
+
+def _doc_with(trace_id, spans, pid=1):
+    """A minimal per-process trace_event doc: spans = [(name, parent_id
+    or None, span_id, ts_us, dur_us)]."""
+    return {"traceEvents": [
+        {"name": n, "cat": "serve", "ph": "X", "ts": ts, "dur": dur,
+         "pid": pid, "tid": 1,
+         "args": {"trace_id": trace_id, "span_id": sid, "parent_id": par}}
+        for n, par, sid, ts, dur in spans
+    ], "displayTimeUnit": "ms"}
+
+
+def test_merge_chrome_traces_aligns_shared_journeys():
+    """Two processes' span rings (each on its own perf_counter epoch)
+    stitch into one trace per trace id: the consumer process's spans
+    land under the producer's root after the timeline alignment."""
+    from fmda_tpu.obs.trace import merge_chrome_traces
+
+    tid = "a" * 16
+    # producer: root at ts=1000, publish child
+    producer = _doc_with(tid, [
+        ("tick", None, "root1", 1000.0, 500.0),
+        ("bus_publish", "root1", "p1", 1200.0, 100.0),
+    ], pid=1)
+    # consumer process: serve span on the SAME trace, its epoch wildly
+    # different (its perf_counter started elsewhere)
+    consumer = _doc_with(tid, [
+        ("serve", "root1", "s1", 9_000_000.0, 200.0),
+    ], pid=2)
+    merged = merge_chrome_traces([producer, consumer])
+    evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == 3
+    # alignment: the consumer's earliest span for the shared trace now
+    # starts at the producer's earliest (offset = 1000 - 9_000_000)
+    serve = next(e for e in evs if e["name"] == "serve")
+    assert serve["ts"] == 1000.0
+    # and the grouped view shows one journey with the serve stage
+    traces = group_chrome_traces(merged)
+    assert len(traces) == 1
+    assert traces[0]["root"] == "tick"
+    assert {s[0] for s in traces[0]["stages"]} == {"bus_publish", "serve"}
+
+
+def test_merge_without_shared_traces_concatenates():
+    from fmda_tpu.obs.trace import merge_chrome_traces
+
+    a = _doc_with("a" * 16, [("tick", None, "r1", 100.0, 10.0)], pid=1)
+    b = _doc_with("b" * 16, [("tick", None, "r2", 777.0, 10.0)], pid=2)
+    merged = merge_chrome_traces([a, b])
+    evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["ts"] for e in evs} == {100.0, 777.0}  # unshifted
+    assert len(group_chrome_traces(merged)) == 2
+
+
+def test_trace_cli_merge_writes_and_reports(tmp_path, capsys):
+    from fmda_tpu.cli import main
+
+    tid = "c" * 16
+    p1 = tmp_path / "proc1.json"
+    p2 = tmp_path / "proc2.json"
+    p1.write_text(json.dumps(_doc_with(tid, [
+        ("tick", None, "r1", 1000.0, 400.0)], pid=1)))
+    p2.write_text(json.dumps(_doc_with(tid, [
+        ("serve", "r1", "s1", 5_000.0, 100.0)], pid=2)))
+    out = tmp_path / "merged.json"
+    assert main(["trace", "--merge", str(p1), str(p2),
+                 "--out", str(out)]) == 0
+    merged = json.loads(out.read_text())
+    assert len(group_chrome_traces(merged)) == 1
+    # without --out: attribution display over the merged doc
+    assert main(["trace", "--merge", str(p1), str(p2), "--json"]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown[0]["trace_id"] == tid
+    assert {s[0] for s in shown[0]["stages"]} == {"serve"}
+
+
+# ---------------------------------------------------------------------------
+# sample-linked exemplars (ISSUE 5: ROADMAP trace follow-up)
+# ---------------------------------------------------------------------------
+
+
+def test_e2e_exemplars_on_snapshot_and_metrics(tracer):
+    """finish_root records the last trace id per e2e_tick_seconds
+    bucket; /snapshot carries them on the histogram sample and /metrics
+    renders OpenMetrics exemplar syntax on the bucketed exposition."""
+    from fmda_tpu.obs.prometheus import render_prometheus
+    from fmda_tpu.obs.trace import TraceRef, tracer_families
+
+    slow_tid = "f" * 16
+    tracer.finish_root(  # ~1 ms journey
+        TraceRef("a" * 16, "s1", 0), "tick", "ingest", 1_000_000)
+    tracer.finish_root(  # ~100 ms journey — a different bucket
+        TraceRef(slow_tid, "s2", 0), "tick", "ingest", 100_000_000)
+    fam = tracer_families(tracer)
+    e2e = next(h for h in fam["histograms"]
+               if h["name"] == "e2e_tick_seconds")
+    buckets = e2e["buckets"]
+    assert buckets[-1] == {"le": "+Inf", "count": 2}
+    with_ex = [b for b in buckets if "exemplar" in b]
+    assert {b["exemplar"]["trace_id"] for b in with_ex} == \
+        {"a" * 16, slow_tid}
+    # cumulative counts are monotone and end at n
+    counts = [b["count"] for b in buckets]
+    assert counts == sorted(counts) and counts[-1] == 2
+    # the slow exemplar's bucket bound brackets its value
+    slow = next(b for b in with_ex
+                if b["exemplar"]["trace_id"] == slow_tid)
+    assert slow["exemplar"]["value_s"] <= slow["le"]
+
+    snap = {"counters": [], "gauges": [], "histograms": [e2e]}
+    text = render_prometheus(snap, exemplars=True)
+    assert "# TYPE fmda_e2e_tick_seconds histogram" in text
+    assert f'# {{trace_id="{slow_tid}"}} 0.1' in text
+    assert 'le="+Inf"' in text
+    # the DEFAULT (0.0.4) rendering must stay parseable by the legacy
+    # text parser: buckets yes, exemplar suffix no
+    legacy = render_prometheus(snap)
+    assert "_bucket" in legacy and "trace_id" not in legacy
+    # summary-form histograms (no exemplars) render unchanged
+    plain = render_prometheus({"counters": [], "gauges": [], "histograms": [
+        {"name": "x_seconds", "labels": {}, "count": 1, "sum_s": 0.5,
+         "max_s": 0.5, "p50_s": 0.5, "p99_s": 0.5}]})
+    assert 'quantile="0.5"' in plain and "_bucket" not in plain
+
+
+def test_predictor_gateway_traces_ride_the_signal_journey(tracer):
+    """A signal arriving with in-band context gets its batched serving
+    spans stitched under a ``serve`` span on the SIGNAL's trace (the
+    engine→serve journey); the stage breakdown tiles the serve span."""
+    from fmda_tpu.config import WarehouseConfig
+    from fmda_tpu.data.normalize import NormParams
+    from fmda_tpu.models import build_model
+    from fmda_tpu.runtime import PredictorGateway, PredictorPool
+    from fmda_tpu.stream import StreamEngine, Warehouse
+
+    sys.path.insert(0, "tests")
+    from test_stream import _session_messages, _small_features
+
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+    cfg = ModelConfig(hidden_size=4, n_features=len(wh.x_fields),
+                      output_size=4, dropout=0.0, use_pallas=False)
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 3, cfg.n_features)))["params"]
+    norm = NormParams(np.zeros(cfg.n_features, np.float32),
+                      np.ones(cfg.n_features, np.float32))
+    pool = PredictorPool(cfg, params, norm, window=3)
+    gw = PredictorGateway(pool, bus, wh, from_end=False,
+                          max_staleness_s=None,
+                          batcher_config=BatcherConfig(
+                              bucket_sizes=(8,), max_linger_s=0.0))
+    for topic, msg in _session_messages(5):
+        # each published feed message inside its own root: the book
+        # tick's context rides the join and lands on the signal
+        with tracer.root("session_tick", "ingest"):
+            bus.publish(topic, msg)
+    eng.step()  # engine stamps trace context onto the signals
+    preds = gw.poll()
+    assert len(preds) == 3
+    # each served signal's trace now holds a serve span whose children
+    # tile it: queued/gather/dispatch/device/publish (+ bus_publish)
+    by_trace = tracer.traces()
+    served = [spans for spans in by_trace.values()
+              if any(s.name == "serve" for s in spans)]
+    assert len(served) == 3
+    for spans in served:
+        serve = next(s for s in spans if s.name == "serve")
+        children = [s for s in spans if s.parent_id == serve.span_id]
+        names = [s.name for s in children]
+        assert names == ["queued", "gather", "dispatch", "device",
+                         "publish"]
+        tiled = sum(s.dur_ns for s in children)
+        assert abs(tiled - serve.dur_ns) <= 0.05 * serve.dur_ns + 10_000
+    # the prediction messages carry the signal's context onward
+    out = bus.consumer("prediction").poll()
+    assert all("trace" in m.value for m in out)
+
+
+def test_predictor_gateway_bare_signal_gets_own_root(tracer):
+    """Signals without in-band context become their own sampled roots,
+    closed via finish_root — they feed e2e_tick_seconds."""
+    from fmda_tpu.config import WarehouseConfig
+    from fmda_tpu.data.normalize import NormParams
+    from fmda_tpu.models import build_model
+    from fmda_tpu.runtime import PredictorGateway, PredictorPool
+    from fmda_tpu.stream import StreamEngine, Warehouse
+
+    sys.path.insert(0, "tests")
+    from test_stream import _session_messages, _small_features
+
+    fc = _small_features(get_cot=False)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    eng = StreamEngine(bus, wh, fc)
+    configure_tracing(enabled=False)
+    for topic, msg in _session_messages(5):
+        bus.publish(topic, msg)
+    eng.step()  # untraced: signals carry no context
+    configure_tracing(enabled=True, sample_rate=1.0)
+    cfg = ModelConfig(hidden_size=4, n_features=len(wh.x_fields),
+                      output_size=4, dropout=0.0, use_pallas=False)
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 3, cfg.n_features)))["params"]
+    norm = NormParams(np.zeros(cfg.n_features, np.float32),
+                      np.ones(cfg.n_features, np.float32))
+    pool = PredictorPool(cfg, params, norm, window=3)
+    gw = PredictorGateway(pool, bus, wh, from_end=False,
+                          max_staleness_s=None,
+                          batcher_config=BatcherConfig(
+                              bucket_sizes=(8,), max_linger_s=0.0))
+    before = tracer.e2e.n
+    preds = gw.poll()
+    assert len(preds) == 3
+    assert tracer.e2e.n == before + 3
+    roots = [s for s in tracer.spans()
+             if s.parent_id is None and s.name == "predict"]
+    assert len(roots) == 3
+
+
+def test_metrics_endpoint_negotiates_openmetrics_exemplars(tracer):
+    """/metrics stays 0.0.4-clean by default (the legacy parser fails a
+    whole scrape on exemplar syntax); clients that Accept OpenMetrics
+    get the exemplar-bearing exposition + EOF terminator."""
+    from fmda_tpu.obs.trace import TraceRef, tracer_families
+
+    tracer.finish_root(
+        TraceRef("d" * 16, "s1", 0), "tick", "ingest", 2_000_000)
+    reg = MetricsRegistry()
+    reg.register_collector("tracing", lambda: tracer_families(tracer))
+    server = MetricsServer(reg, port=0).start()
+    try:
+        plain = urllib.request.urlopen(
+            f"{server.url}/metrics", timeout=10)
+        body = plain.read().decode()
+        assert "version=0.0.4" in plain.headers["Content-Type"]
+        assert "trace_id" not in body and "# EOF" not in body
+        assert "_bucket" in body  # the bucketed form itself is legal
+
+        req = urllib.request.Request(
+            f"{server.url}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        om = urllib.request.urlopen(req, timeout=10)
+        om_body = om.read().decode()
+        assert "openmetrics-text" in om.headers["Content-Type"]
+        assert f'# {{trace_id="{"d" * 16}"}}' in om_body
+        assert om_body.endswith("# EOF\n")
+    finally:
+        server.stop()
