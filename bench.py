@@ -1348,6 +1348,123 @@ def phase_predictor_fleet() -> dict:
     return result
 
 
+def phase_runtime_multihost() -> dict:
+    """Multi-host fleet smoke (ISSUE 6): the distributed serving tier
+    (fmda_tpu.fleet, docs/multihost.md) as a real local topology —
+    router inline, N worker processes spawned, each hosting its own
+    data-plane bus — under the same synthetic multi-ticker load as
+    runtime_fleet_smoke, at 1 worker and at 4.
+
+    The scaling measure is **weak scaling** (sessions per worker held
+    constant, aggregate ticks/s compared): a session's ticks advance a
+    recurrence, so one session's flushes can never parallelise — fleets
+    scale by hosting MORE sessions, and that is what the gate prices.
+    Acceptance: >= FMDA_MULTIHOST_SCALING_MIN (default 2.5) aggregate
+    ticks/s at 4 workers vs 1.  The gate hard-fails only on a quiet
+    host with enough cores to actually run 4 workers + router in
+    parallel (>= 6); fewer cores physically cap process parallelism,
+    so the phase reports the measured scaling with ``gate_inert``
+    instead (same philosophy as the SLO gates' quiet-host guard).
+    Always gated hard: per-worker compile_count == len(buckets) in
+    BOTH topologies (no recompiles on the tick path, no matter how the
+    sessions shard), and zero lost/missing ticks.
+    """
+    from fmda_tpu.fleet.launcher import launch_local_fleet, spawn_supported
+    from fmda_tpu.runtime import FleetLoadConfig, run_fleet_load
+
+    if not spawn_supported():
+        return {"skipped": "subprocess spawn unavailable on this host"}
+    buckets = (8, 32, 64)
+    sessions_per_worker, rounds = 64, 100
+    per: dict = {}
+    loss_counters = ("results_missing", "routed_ticks_lost",
+                     "migration_buffer_shed")
+    for n in (1, 4):
+        topo = launch_local_fleet(
+            n_workers=n, hidden=HIDDEN,
+            capacity_per_worker=sessions_per_worker * 2,
+            bucket_sizes=buckets, seed=0)
+        try:
+            out = run_fleet_load(topo.router, FleetLoadConfig(
+                n_sessions=sessions_per_worker * n, n_ticks=rounds,
+                duty=1.0, seed=0))
+        finally:
+            worker_stats = topo.shutdown()
+        counters = out.get("counters", {})
+        per[n] = {
+            "sessions": sessions_per_worker * n,
+            "rounds": rounds,
+            "ticks_served": out["ticks_served"],
+            "ticks_submitted": out["ticks_submitted"],
+            "ticks_per_s": out["ticks_per_s"],
+            "route_p50_ms": out["latency"].get("route", {}).get("p50_ms"),
+            "total_p99_ms": out["latency"].get("total", {}).get("p99_ms"),
+            "compile_counts": {
+                w: s.get("compile_count") for w, s in worker_stats.items()},
+            "losses": {
+                # router-side loss counters + worker-side inbox
+                # overruns (those ride the heartbeat stats — the
+                # counter never appears in the router's own metrics)
+                **{k: counters.get(k, 0) for k in loss_counters
+                   if counters.get(k, 0)},
+                **{f"{w}.inbox_records_lost": s.get(
+                       "inbox_records_lost", 0)
+                   for w, s in worker_stats.items()
+                   if s.get("inbox_records_lost", 0)},
+            },
+        }
+    t1 = per[1]["ticks_per_s"] or 0.0
+    t4 = per[4]["ticks_per_s"] or 0.0
+    scaling = round(t4 / t1, 2) if t1 else None
+    scaling_min = float(os.environ.get("FMDA_MULTIHOST_SCALING_MIN", "2.5"))
+    soft = os.environ.get("FMDA_FLEET_SLO_SOFT", "") == "1"
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = None
+    cores = os.cpu_count() or 1
+    quiet = load1 is not None and load1 < 0.5 * cores
+    enough_cores = cores >= 6  # 4 workers + router + slack
+    result = {
+        "workers_1": per[1],
+        "workers_4": per[4],
+        "scaling_4x": scaling,
+        "scaling_min": scaling_min,
+        "scaling_mode": "weak (sessions per worker constant)",
+        "cpu_count": cores,
+        "quiet_host": quiet,
+        "bucket_sizes": list(buckets),
+    }
+    bad_compile = {
+        f"{n}w/{w}": c
+        for n in (1, 4)
+        for w, c in per[n]["compile_counts"].items()
+        if c != len(buckets)
+    }
+    losses = {n: per[n]["losses"] for n in (1, 4) if per[n]["losses"]}
+    if bad_compile:
+        result["error"] = (
+            f"compile_count != {len(buckets)} buckets on {bad_compile}: "
+            "something recompiled on the tick path")
+    elif per[1]["ticks_served"] != per[1]["ticks_submitted"] or \
+            per[4]["ticks_served"] != per[4]["ticks_submitted"] or losses:
+        result["error"] = (
+            f"ticks went missing (served != submitted or loss counters "
+            f"fired: {losses}) — the no-drop contract broke")
+    elif scaling is not None and scaling < scaling_min \
+            and quiet and enough_cores and not soft:
+        result["error"] = (
+            f"aggregate scaling {scaling}x < {scaling_min}x at 4 workers "
+            "on a quiet multi-core host (FMDA_MULTIHOST_SCALING_MIN to "
+            "retune, FMDA_FLEET_SLO_SOFT=1 to report-only)")
+    elif scaling is not None and scaling < scaling_min:
+        result["gate_inert"] = (
+            f"scaling {scaling}x below {scaling_min}x but the gate needs "
+            f"a quiet host with >= 6 cores (have {cores}, quiet={quiet}) "
+            "— processes cannot run in parallel here")
+    return result
+
+
 def phase_obs_overhead() -> dict:
     """Observability-plane cost on the engine.step hot loop: the same
     synthetic replay driven twice per repetition — once with the obs
@@ -1513,6 +1630,7 @@ _PHASES = {
     "longctx_sp": phase_longctx_sp,
     "runtime_fleet_smoke": phase_runtime_fleet,
     "predictor_fleet_smoke": phase_predictor_fleet,
+    "runtime_multihost_smoke": phase_runtime_multihost,
     "obs_overhead": phase_obs_overhead,
     "trace_overhead": phase_trace_overhead,
 }
@@ -1942,6 +2060,7 @@ def main() -> None:
         ("serving", 300.0),
         ("runtime_fleet_smoke", 240.0),
         ("predictor_fleet_smoke", 300.0),
+        ("runtime_multihost_smoke", 420.0),
         ("obs_overhead", 300.0),
         ("trace_overhead", 300.0),
         ("flagship_bf16", 300.0),

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -347,13 +348,318 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _fleet_worker_model(args, cfg):
+    """The worker-role model stack: a randomly-initialised
+    unidirectional carrier from the shared seed — deterministic, so
+    every worker process of one topology serves identical params."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.models import build_model
+
+    model_cfg = dataclasses.replace(
+        cfg.model, bidirectional=False, dropout=0.0,
+        hidden_size=args.hidden, n_features=cfg.features.n_features,
+        cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
+    window = args.window if args.window is not None else cfg.runtime.window
+    params = build_model(model_cfg).init(
+        {"params": jax.random.PRNGKey(args.seed)},
+        jnp.zeros((1, window, model_cfg.n_features)))["params"]
+    return model_cfg, params
+
+
+def _fleet_runtime_overrides(args, cfg):
+    """Fold the shared serve-fleet batching flags into cfg.runtime."""
+    import dataclasses
+
+    bucket_sizes = (tuple(int(b) for b in args.bucket_sizes.split(","))
+                    if args.bucket_sizes else None)
+    overrides = {
+        k: v for k, v in dict(
+            capacity=max(args.sessions, cfg.runtime.capacity),
+            max_linger_ms=args.max_linger_ms,
+            queue_bound=args.queue_bound,
+            window=args.window,
+            bucket_sizes=bucket_sizes,
+            pipeline_depth=(0 if args.serial else None),
+            slo_p99_ms=args.slo_p99_ms,
+        ).items() if v is not None
+    }
+    return dataclasses.replace(
+        cfg, runtime=dataclasses.replace(cfg.runtime, **overrides))
+
+
+def _maybe_write_trace(args, out: dict) -> None:
+    """Shared --trace/--trace-out tail for every serve-fleet role."""
+    if not (args.trace or args.trace_out):
+        return
+    from fmda_tpu.obs.trace import default_tracer
+
+    tracer = default_tracer()
+    out["tracing"] = {
+        "traces_finished": tracer.traces_finished,
+        "spans_buffered": len(tracer.spans()),
+        "e2e": tracer.e2e.summary(),
+    }
+    if args.trace_out:
+        with open(args.trace_out, "w") as fh:
+            json.dump(tracer.chrome(), fh)
+        out["tracing"]["file"] = args.trace_out
+
+
+def _cmd_fleet_worker(args) -> int:
+    """serve-fleet --role worker: one slot-range owner in a multi-host
+    topology (docs/multihost.md).  Connects a SocketBus to the router's
+    bus server, joins via hello, and serves its inbox until the router
+    says stop (or the --duration-s safety valve fires)."""
+    if not args.worker_id or not args.connect:
+        print("--role worker needs --worker-id and --connect HOST:PORT",
+              file=sys.stderr)
+        return 2
+    _ensure_backend(args)
+    cfg = _fleet_runtime_overrides(args, _config(args))
+    if args.trace or args.trace_out:
+        from fmda_tpu.obs.trace import configure_tracing
+
+        configure_tracing(enabled=True, sample_rate=args.trace_sample)
+    from fmda_tpu.config import TOPIC_FLEET_PREDICTION, fleet_worker_topic
+    from fmda_tpu.fleet.wire import BusServer, SocketBus
+    from fmda_tpu.fleet.worker import FleetWorker
+    from fmda_tpu.obs import Observability
+    from fmda_tpu.stream.bus import InProcessBus
+
+    model_cfg, params = _fleet_worker_model(args, cfg)
+    bus = SocketBus.connect(args.connect)
+    data_bus = None
+    data_server = None
+    data_address = None
+    if not args.shared_bus:
+        # worker-hosted data plane (default): this process serves its
+        # own inbox + results bus; the router links to it directly, so
+        # the serving hot loop never crosses a socket
+        data_bus = InProcessBus(
+            (fleet_worker_topic(args.worker_id), TOPIC_FLEET_PREDICTION))
+        data_server = BusServer(data_bus, host=cfg.fleet.host).start()
+        data_address = data_server.address
+    worker = FleetWorker(
+        args.worker_id, bus, model_cfg, params,
+        config=cfg.fleet, runtime=cfg.runtime, capacity=args.sessions,
+        data_bus=data_bus, data_address=data_address)
+    # per-process observability: every series this worker exports
+    # carries a `process` label, so a fleet-wide scrape never collides
+    obs = Observability(cfg.observability, process=args.worker_id)
+    obs.track_fleet(worker.gateway)
+    bus.bind_metrics(obs.registry)
+    if args.metrics_port is not None:
+        server = obs.start_server(port=args.metrics_port)
+        print(f"worker {args.worker_id} metrics: {server.url}/metrics",
+              file=sys.stderr)
+    try:
+        stats = worker.run(
+            duration_s=args.duration_s if args.duration_s else None)
+    finally:
+        obs.close()
+        if data_server is not None:
+            data_server.stop()
+        bus.close()
+    out = {"worker": args.worker_id, "stats": stats,
+           **worker.metrics.summary()}
+    _maybe_write_trace(args, out)
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def _cmd_fleet_broker(args) -> int:
+    """serve-fleet --role broker: host the topology's bus + bus server
+    and nothing else — the local stand-in for a Kafka broker.  The
+    router, workers, and loadgen each keep their own process (and GIL);
+    every bus op crosses a socket to here.  Runs until killed or
+    --duration-s elapses."""
+    import time
+
+    from fmda_tpu.config import DEFAULT_TOPICS, fleet_topics
+    from fmda_tpu.fleet.launcher import _build_local_bus
+    from fmda_tpu.fleet.wire import BusServer
+
+    # the broker is one connection-serving thread per client, all doing
+    # short JSON/frame work: the default 5ms GIL switch interval turns
+    # every request into multi-ms queueing delay under concurrency —
+    # drop it so round-trip latency tracks actual work
+    sys.setswitchinterval(0.0005)
+    cfg = _config(args)
+    n = args.workers if args.workers is not None else cfg.fleet.n_workers
+    worker_ids = [f"{cfg.fleet.worker_prefix}{i}" for i in range(n)]
+    topics = tuple(DEFAULT_TOPICS) + fleet_topics(worker_ids)
+    bus = _build_local_bus(cfg, topics)
+    port = args.listen if args.listen is not None else cfg.fleet.port
+    server = BusServer(bus, host=cfg.fleet.host, port=port).start()
+    # the one line launchers parse to find the ephemeral port
+    print(f"BROKER {server.address}", flush=True)
+    deadline = (time.monotonic() + args.duration_s
+                if args.duration_s else None)
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+def _cmd_fleet_router(args) -> int:
+    """serve-fleet --role router: the routing/membership/migration
+    control loop on a bus-only host (no jax on this code path).  With
+    ``--connect`` it joins an existing broker's bus (the production
+    shape: broker, router, and workers each their own process/host);
+    with ``--listen`` it hosts the bus + bus server itself (a two-tier
+    topology for small fleets)."""
+    import time
+
+    from fmda_tpu.fleet.router import FleetRouter
+
+    cfg = _config(args)
+    if args.trace or args.trace_out:
+        from fmda_tpu.obs.trace import configure_tracing
+
+        configure_tracing(enabled=True, sample_rate=args.trace_sample)
+    server = None
+    if args.connect:
+        from fmda_tpu.fleet.wire import SocketBus
+
+        bus = SocketBus.connect(args.connect)
+        fleet_cfg = cfg.fleet
+    else:
+        import dataclasses
+
+        from fmda_tpu.config import DEFAULT_TOPICS, fleet_topics
+        from fmda_tpu.fleet.launcher import _build_local_bus
+        from fmda_tpu.fleet.wire import BusServer
+
+        n = (args.workers if args.workers is not None
+             else cfg.fleet.n_workers)
+        worker_ids = [f"{cfg.fleet.worker_prefix}{i}" for i in range(n)]
+        topics = tuple(DEFAULT_TOPICS) + fleet_topics(worker_ids)
+        bus = _build_local_bus(cfg, topics)
+        fleet_cfg = dataclasses.replace(
+            cfg.fleet,
+            port=args.listen if args.listen is not None
+            else cfg.fleet.port)
+        server = BusServer(bus, host=fleet_cfg.host,
+                           port=fleet_cfg.port).start()
+        print(f"router bus server on {server.address}; start workers "
+              f"with: python -m fmda_tpu serve-fleet --role worker "
+              f"--connect {server.address} --worker-id w<N>",
+              file=sys.stderr)
+    router = FleetRouter(bus, fleet_cfg, n_features=cfg.features.n_features)
+    deadline = (time.monotonic() + args.duration_s
+                if args.duration_s else None)
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            router.pump()
+            time.sleep(0.005)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop_workers()
+        # keep pumping briefly so the workers' drain + goodbye (final
+        # stats) make it into the printed summary — stop_workers only
+        # SENDS the stop; the goodbyes land on the control topic after
+        # the workers drain (LocalFleet.shutdown does the same)
+        grace = time.monotonic() + 5.0
+        try:
+            while router.membership.workers and time.monotonic() < grace:
+                router.pump()
+                time.sleep(0.02)
+        except (ConnectionError, OSError):
+            pass
+        if server is not None:
+            server.stop()
+    out = router.summary()
+    out["n_features"] = router.n_features
+    _maybe_write_trace(args, out)
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+def _cmd_fleet_local(args) -> int:
+    """serve-fleet --role local: the single-command topology — spawn
+    router (inline) + N worker processes, drive the synthetic fleet
+    load through the router, print aggregate + per-worker stats."""
+    from fmda_tpu.fleet.launcher import launch_local_fleet, spawn_supported
+    from fmda_tpu.runtime.loadgen import FleetLoadConfig, run_fleet_load
+
+    cfg = _config(args)
+    if not spawn_supported():
+        print(json.dumps(
+            {"skipped": "subprocess spawn unavailable on this host"}))
+        return 0
+    if args.trace or args.trace_out or args.trace_dir:
+        from fmda_tpu.obs.trace import configure_tracing
+
+        configure_tracing(enabled=True, sample_rate=args.trace_sample)
+    n = args.workers if args.workers is not None else cfg.fleet.n_workers
+    bucket_sizes = (tuple(int(b) for b in args.bucket_sizes.split(","))
+                    if args.bucket_sizes else None)
+    topo = launch_local_fleet(
+        n_workers=n,
+        config=cfg,
+        hidden=args.hidden,
+        seed=args.seed,
+        capacity_per_worker=args.sessions,
+        bucket_sizes=bucket_sizes,
+        max_linger_ms=args.max_linger_ms,
+        window=args.window,
+        trace_dir=args.trace_dir,
+    )
+    try:
+        out = run_fleet_load(topo.router, FleetLoadConfig(
+            n_sessions=args.sessions, n_ticks=args.ticks,
+            duty=args.duty, seed=args.seed,
+            storm_every=args.storm_every,
+            storm_fraction=args.storm_fraction))
+    finally:
+        worker_stats = topo.shutdown()
+    out["workers"] = n
+    out["worker_stats"] = worker_stats
+    out["table_version"] = topo.router.table.version
+    if args.trace_dir:
+        from fmda_tpu.obs.trace import default_tracer
+
+        router_trace = os.path.join(args.trace_dir, "router.json")
+        with open(router_trace, "w") as fh:
+            json.dump(default_tracer().chrome(), fh)
+        out["trace_dir"] = args.trace_dir
+        print(f"per-process traces in {args.trace_dir}; merge with "
+              f"`python -m fmda_tpu trace --merge {args.trace_dir}`",
+              file=sys.stderr)
+    _maybe_write_trace(args, out)
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
 def cmd_serve_fleet(args) -> int:
     """Multi-tenant serving proof: N concurrent ticker sessions through
     the dynamic micro-batching runtime (fmda_tpu.runtime; docs/runtime.md)
     against a synthetic multi-ticker load — one fused jit step per flush
     serves every active session.  Prints the runtime metrics (per-stage
     latency histograms, shed/queue counters, compiled-bucket count) as
-    one JSON object."""
+    one JSON object.
+
+    ``--role router|worker|local`` runs the multi-host topology instead
+    (fmda_tpu.fleet; docs/multihost.md): a router fronting N worker
+    processes over the cross-process bus, with session routing,
+    membership, and live migration."""
+    if args.role == "worker":
+        return _cmd_fleet_worker(args)
+    if args.role == "broker":
+        return _cmd_fleet_broker(args)
+    if args.role == "router":
+        return _cmd_fleet_router(args)
+    if args.role == "local":
+        return _cmd_fleet_local(args)
     _ensure_backend(args)
     import dataclasses
 
@@ -461,7 +767,9 @@ def cmd_serve_fleet(args) -> int:
         gateway = app.attach_fleet(model_cfg, params)
         load_cfg = FleetLoadConfig(
             n_sessions=args.sessions,
-            n_ticks=args.ticks, duty=args.duty, seed=args.seed)
+            n_ticks=args.ticks, duty=args.duty, seed=args.seed,
+            storm_every=args.storm_every,
+            storm_fraction=args.storm_fraction)
 
         def run_load():
             return run_fleet_load(gateway, load_cfg)
@@ -568,30 +876,76 @@ def _print_status(snapshot: dict, health: dict) -> None:
                   f"{s['p99_s'] * 1e3:>9.3f} {mean_ms:>9.3f}")
 
 
+def _scrape_endpoint(endpoint: str):
+    """GET /snapshot + /healthz off one endpoint; raises on transport
+    failure (callers decide whether one dead worker fails the probe)."""
+    import urllib.error
+    import urllib.request
+
+    base = (endpoint if "://" in endpoint
+            else f"http://{endpoint}").rstrip("/")
+    with urllib.request.urlopen(base + "/snapshot", timeout=10) as r:
+        snapshot = json.loads(r.read())
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        # 503 = degraded; the body still carries the check detail
+        health = json.loads(e.read())
+    return snapshot, health
+
+
+def _status_multi(endpoints) -> int:
+    """Fleet-wide status: scrape every endpoint (one per worker/router
+    process), print per-process health, then the aggregate verdict.
+    Exit 0 iff every endpoint answered ok; an unreachable process is a
+    degraded fleet, not a CLI crash."""
+    import urllib.error
+
+    per = {}
+    for ep in endpoints:
+        try:
+            per[ep] = _scrape_endpoint(ep)
+        except (urllib.error.URLError, OSError,
+                json.JSONDecodeError) as e:
+            per[ep] = (None, {
+                "status": "unreachable",
+                "checks": {},
+                "error": str(e),
+            })
+    n_ok = 0
+    for ep, (snapshot, health) in per.items():
+        status = health.get("status")
+        print(f"===== {ep}: {status} =====")
+        if status == "unreachable":
+            print(f"  {health.get('error')}")
+            continue
+        if status == "ok":
+            n_ok += 1
+        _print_status(snapshot, health)
+    aggregate = "ok" if n_ok == len(endpoints) else "degraded"
+    print(f"aggregate: {aggregate} ({n_ok}/{len(endpoints)} endpoints ok)")
+    return 0 if aggregate == "ok" else 1
+
+
 def cmd_status(args) -> int:
     """Observability snapshot: local (build the app, sample its registry)
-    or remote (GET /snapshot + /healthz off a running endpoint)."""
+    or remote (GET /snapshot + /healthz off running endpoints).  Several
+    ``--endpoint`` values — one per fleet worker — report per-worker
+    health plus the aggregate verdict."""
     if args.endpoint:
         import urllib.error
-        import urllib.request
 
-        base = (args.endpoint if "://" in args.endpoint
-                else f"http://{args.endpoint}").rstrip("/")
+        if len(args.endpoint) > 1:
+            return _status_multi(args.endpoint)
         try:
-            with urllib.request.urlopen(base + "/snapshot", timeout=10) as r:
-                snapshot = json.loads(r.read())
-            try:
-                with urllib.request.urlopen(
-                        base + "/healthz", timeout=10) as r:
-                    health = json.loads(r.read())
-            except urllib.error.HTTPError as e:
-                # 503 = degraded; the body still carries the check detail
-                health = json.loads(e.read())
+            snapshot, health = _scrape_endpoint(args.endpoint[0])
         except (urllib.error.URLError, OSError,
                 json.JSONDecodeError) as e:
             # a down daemon is the most common reason to run this probe
             # — report it cleanly, don't traceback
-            print(f"cannot scrape {base}: {e}", file=sys.stderr)
+            print(f"cannot scrape {args.endpoint[0]}: {e}",
+                  file=sys.stderr)
             return 2
     else:
         import dataclasses
@@ -631,8 +985,29 @@ def cmd_trace(args) -> int:
     )
 
     if args.merge:
+        import glob as _glob
+
+        # each --merge arg may be a file, a directory of per-process
+        # --trace-out files (a topology's --trace-dir merges in one
+        # command), or a glob pattern
+        paths = []
+        for arg in args.merge:
+            if os.path.isdir(arg):
+                expanded = sorted(_glob.glob(os.path.join(arg, "*.json")))
+                if not expanded:
+                    print(f"no *.json trace files in directory {arg}",
+                          file=sys.stderr)
+                    return 2
+            elif _glob.has_magic(arg):
+                expanded = sorted(_glob.glob(arg))
+                if not expanded:
+                    print(f"glob {arg!r} matched nothing", file=sys.stderr)
+                    return 2
+            else:
+                expanded = [arg]
+            paths.extend(expanded)
         docs = []
-        for path in args.merge:
+        for path in paths:
             try:
                 with open(path) as fh:
                     docs.append(json.load(fh))
@@ -648,7 +1023,7 @@ def cmd_trace(args) -> int:
                 print(f"cannot write {args.out}: {e}", file=sys.stderr)
                 return 2
             n_traces = len(group_chrome_traces(doc))
-            print(f"merged {len(args.merge)} trace files "
+            print(f"merged {len(paths)} trace files "
                   f"({n_traces} traces) -> {args.out} "
                   "(load at https://ui.perfetto.dev)", file=sys.stderr)
             return 0
@@ -784,6 +1159,47 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser(
         "serve-fleet", parents=[common],
         help="multi-tenant micro-batching runtime vs a synthetic fleet")
+    p.add_argument("--role",
+                   choices=("solo", "broker", "router", "worker", "local"),
+                   default="solo",
+                   help="'solo' (default) runs the single-process fleet "
+                        "runtime; the multi-host topology "
+                        "(fmda_tpu.fleet, docs/multihost.md) splits into "
+                        "'broker' (bus + bus server only — the local "
+                        "Kafka stand-in), 'router' (session routing + "
+                        "membership + migration, jax-free), 'worker' "
+                        "(one slot-range owner), and 'local' (one "
+                        "command: broker + N workers spawned, router "
+                        "inline, synthetic load)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="worker-process count for --role local/router "
+                        "(default: config fleet.n_workers)")
+    p.add_argument("--listen", type=int, default=None,
+                   help="bus-server port for --role router (0 = "
+                        "ephemeral; default: config fleet.port)")
+    p.add_argument("--connect", default=None, metavar="HOST:PORT",
+                   help="router bus-server address for --role worker")
+    p.add_argument("--worker-id", default=None,
+                   help="this worker's id (--role worker); the router "
+                        "routes its slot-range to fleet_ticks_<id>")
+    p.add_argument("--shared-bus", action="store_true",
+                   help="--role worker: do the data plane on the shared "
+                        "--connect bus too (an external broker topology, "
+                        "e.g. Kafka-shaped) instead of hosting this "
+                        "worker's own inbox/results bus")
+    p.add_argument("--duration-s", type=float, default=0.0,
+                   help="safety-valve runtime bound for --role "
+                        "worker/router (0 = until stopped)")
+    p.add_argument("--storm-every", type=int, default=0,
+                   help="adversarial reconnect storm: every N load "
+                        "rounds, close + instantly reopen a burst of "
+                        "sessions (0 = off)")
+    p.add_argument("--storm-fraction", type=float, default=0.25,
+                   help="fraction of sessions hit per reconnect storm")
+    p.add_argument("--trace-dir", default=None, metavar="DIR",
+                   help="--role local: enable tracing in every process "
+                        "and write one trace file per process into DIR "
+                        "(merge: `python -m fmda_tpu trace --merge DIR`)")
     p.add_argument("--sessions", type=int, default=64,
                    help="concurrent ticker sessions (pool capacity grows "
                         "to fit when the config's is smaller)")
@@ -864,8 +1280,11 @@ def build_parser() -> argparse.ArgumentParser:
         "status", parents=[common],
         help="pretty-print an observability snapshot + health verdict")
     p.add_argument("--endpoint", default=None, metavar="HOST:PORT",
-                   help="scrape a running endpoint's /snapshot + /healthz "
-                        "instead of building a local app")
+                   nargs="+",
+                   help="scrape running endpoints' /snapshot + /healthz "
+                        "instead of building a local app; several "
+                        "endpoints (one per fleet worker) report "
+                        "per-worker + aggregate health")
     p.add_argument("--warehouse", default=None,
                    help="warehouse file for the local snapshot (default: "
                         "config's path)")
@@ -879,12 +1298,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(serve-fleet --trace-out)")
     p.add_argument("--endpoint", default=None, metavar="HOST:PORT",
                    help="scrape a running endpoint's /trace instead")
-    p.add_argument("--merge", nargs="+", default=None, metavar="FILE",
-                   help="stitch several per-process --trace-out files "
-                        "into one trace by trace id (timelines aligned "
-                        "on shared journeys); with --out writes the "
-                        "merged Perfetto JSON, without it shows the "
-                        "attribution over the merged document")
+    p.add_argument("--merge", nargs="+", default=None, metavar="PATH",
+                   help="stitch per-process --trace-out files into one "
+                        "trace by trace id (timelines aligned on shared "
+                        "journeys); each PATH may be a file, a glob, or "
+                        "a directory of *.json trace files (a topology's "
+                        "--trace-dir merges in one command); with --out "
+                        "writes the merged Perfetto JSON, without it "
+                        "shows the attribution over the merged document")
     p.add_argument("--out", default=None, metavar="FILE",
                    help="write the --merge result to this file")
     p.add_argument("--last", type=int, default=10,

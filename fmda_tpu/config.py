@@ -33,6 +33,16 @@ TOPIC_PREDICTION = "prediction"
 #: Fleet-serving results (fmda_tpu.runtime): one topic, per-session
 #: consumption keyed on the message's ``session`` field.
 TOPIC_FLEET_PREDICTION = "fleet_prediction"
+#: Multi-host fleet control plane (fmda_tpu.fleet): worker hello/
+#: heartbeat/goodbye, ownership-table announcements, migrated session
+#: state.  Not in DEFAULT_TOPICS — only fleet topologies carry it
+#: (fleet_topics adds it alongside the per-worker inboxes).
+TOPIC_FLEET_CONTROL = "fleet_control"
+#: Per-worker tick-inbox topic prefix (fmda_tpu.fleet): the router
+#: publishes a worker's opens/ticks/closes/drains to
+#: ``fleet_ticks_<worker_id>`` in routing order — the inbox's FIFO
+#: offsets ARE the ordering guarantee the migration protocol leans on.
+TOPIC_FLEET_TICKS_PREFIX = "fleet_ticks_"
 
 DEFAULT_TOPICS: Tuple[str, ...] = (
     TOPIC_VIX,
@@ -44,6 +54,19 @@ DEFAULT_TOPICS: Tuple[str, ...] = (
     TOPIC_PREDICTION,
     TOPIC_FLEET_PREDICTION,
 )
+
+
+def fleet_worker_topic(worker_id: str) -> str:
+    """The tick-inbox topic of one fleet worker."""
+    return TOPIC_FLEET_TICKS_PREFIX + worker_id
+
+
+def fleet_topics(worker_ids) -> Tuple[str, ...]:
+    """Every extra topic a fleet topology needs on its bus: the control
+    plane plus one inbox per worker (append to ``DEFAULT_TOPICS`` when
+    constructing the topology's bus)."""
+    return (TOPIC_FLEET_CONTROL,) + tuple(
+        fleet_worker_topic(w) for w in worker_ids)
 
 
 @dataclass(frozen=True)
@@ -497,6 +520,65 @@ class RuntimeConfig:
 
 
 @dataclass(frozen=True)
+class FleetTopologyConfig:
+    """Multi-host serving topology knobs (fmda_tpu.fleet;
+    docs/multihost.md).
+
+    Net-new vs the reference and vs the single-process fleet runtime:
+    N worker processes each own a contiguous slot-range of the session
+    hash space (each embedding the PR-1 FleetGateway/SessionPool), a
+    router hashes session → owner and drives membership + migration over
+    the cross-process bus (a BusServer-served NativeBus locally, Kafka
+    in prod).
+    """
+
+    #: Worker-process count the local launcher spawns (`serve-fleet
+    #: --role local`); membership itself is dynamic — workers may join
+    #: and leave a running router at any time.
+    n_workers: int = 2
+    #: Worker ids are ``<worker_prefix><index>`` (w0, w1, ...) for the
+    #: launcher; hand-started workers may use any id.
+    worker_prefix: str = "w"
+    #: Bus-server bind address for the local cross-process transport
+    #: (the router hosts the bus; workers connect with SocketBus).
+    host: str = "127.0.0.1"
+    #: 0 = ephemeral (the launcher reads the bound port off the server).
+    port: int = 0
+    #: Worker heartbeat cadence on the control topic.
+    heartbeat_interval_s: float = 0.5
+    #: Router declares a worker dead after this long without a
+    #: heartbeat (measured on the router's own clock at receipt, so
+    #: cross-process clock skew cannot mis-kill a healthy worker).
+    #: Deliberately ~20x the interval: a worker mid-drain under a deep
+    #: backlog beats late, and a false death costs carried state.
+    heartbeat_timeout_s: float = 10.0
+    #: Size of the session hash space the ownership table partitions
+    #: into contiguous per-worker ranges.
+    hash_space: int = 1 << 16
+    #: Bound on ticks the router buffers per migrating session while its
+    #: state is in flight between owners; overflow sheds the oldest,
+    #: counted (``migration_buffer_shed``) — same never-silent contract
+    #: as the gateway queue.
+    migration_buffer_bound: int = 4096
+    #: Max inbox records a worker consumes per step (bounds one socket
+    #: read's frame size; the backlog simply spans more steps).
+    worker_poll_max_records: int = 512
+    #: Router backpressure bound: once this many routed ticks are
+    #: unanswered, ``saturated`` turns on and well-behaved producers
+    #: pace themselves — otherwise an unbounded inbox backlog outruns
+    #: the bus's retention and ticks silently age off the topic.
+    max_inflight_ticks: int = 4096
+    #: Age (router clock) after which an unanswered tick is declared
+    #: lost (``results_missing``) — e.g. it rode into a worker that
+    #: died undrained.
+    result_timeout_s: float = 60.0
+    #: Byte arena per topic for the router-hosted NativeBus — sized for
+    #: deep tick backlogs (a ~700B tick message × max_inflight_ticks ×
+    #: workers fits with wide margin).
+    bus_arena_bytes: int = 1 << 26
+
+
+@dataclass(frozen=True)
 class ObservabilityConfig:
     """Observability-plane knobs (fmda_tpu.obs; docs/observability.md).
 
@@ -579,6 +661,7 @@ class FrameworkConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     session: SessionConfig = field(default_factory=SessionConfig)
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    fleet: FleetTopologyConfig = field(default_factory=FleetTopologyConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
@@ -611,6 +694,7 @@ _SECTIONS = {
     "mesh": MeshConfig,
     "session": SessionConfig,
     "runtime": RuntimeConfig,
+    "fleet": FleetTopologyConfig,
     "observability": ObservabilityConfig,
     "tracing": TracingConfig,
 }

@@ -1,0 +1,61 @@
+"""fmda_tpu.fleet — the multi-host distributed serving tier.
+
+N worker processes (each embedding the single-process fleet runtime:
+:class:`~fmda_tpu.runtime.gateway.FleetGateway` +
+:class:`~fmda_tpu.runtime.session_pool.SessionPool`) each own a
+contiguous slot-range of the session hash space
+(:mod:`~fmda_tpu.fleet.hashring`), fronted by a
+:class:`~fmda_tpu.fleet.router.FleetRouter` that hashes session → owner
+over the cross-process bus (:mod:`~fmda_tpu.fleet.wire` serves the
+router's NativeBus/InProcessBus to SocketBus workers; KafkaBus slots in
+for prod), with heartbeat membership (:mod:`~fmda_tpu.fleet.membership`)
+and live session migration that never drops, duplicates, or reorders a
+tick (:mod:`~fmda_tpu.fleet.state` carries the state bit-exact).
+``python -m fmda_tpu serve-fleet --role router|worker|local`` runs the
+topology.  Architecture: docs/multihost.md.
+
+Router-role names import **without jax** — a router is a bus-only host;
+the tier-1 hygiene check pins that.  :class:`FleetWorker` and the local
+launcher (which builds worker models) resolve lazily.
+"""
+
+from fmda_tpu.fleet.hashring import OwnershipTable, hash_session
+from fmda_tpu.fleet.membership import Heartbeater, MembershipView
+from fmda_tpu.fleet.router import FleetRouter, NoLiveWorkers
+from fmda_tpu.fleet.wire import BusServer, SocketBus
+
+#: worker/launcher names — lazy: they pull jax via the runtime
+_LAZY = {
+    "FleetWorker": "fmda_tpu.fleet.worker",
+    "LocalFleet": "fmda_tpu.fleet.launcher",
+    "launch_local_fleet": "fmda_tpu.fleet.launcher",
+    "spawn_supported": "fmda_tpu.fleet.launcher",
+}
+
+__all__ = sorted([
+    "OwnershipTable",
+    "hash_session",
+    "Heartbeater",
+    "MembershipView",
+    "FleetRouter",
+    "NoLiveWorkers",
+    "BusServer",
+    "SocketBus",
+    *_LAZY,
+])
+
+
+def __getattr__(name: str):
+    module_name = _LAZY.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module 'fmda_tpu.fleet' has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

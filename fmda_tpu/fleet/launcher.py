@@ -1,0 +1,256 @@
+"""Single-command local fleet topology: workers spawned, router inline.
+
+``launch_local_fleet`` builds the whole multi-host topology on one
+machine for benches, tests, and demos, with each tier in its **own
+process** (own GIL — a shared interpreter would serialize bus frame
+handling behind the load driver and flatten the scaling the topology
+exists to buy):
+
+- the calling process runs the **router** and hosts the **control bus**
+  behind a :class:`~fmda_tpu.fleet.wire.BusServer` (membership +
+  migrated state — low-rate traffic);
+- N **worker** processes (``serve-fleet --role worker``) build
+  identical models from the shared seed (same machine, same jax —
+  deterministic init), connect a SocketBus for control, and each host
+  their OWN data-plane bus (inbox + results), announced in their
+  heartbeats — the router links to every worker directly and the
+  worker's serving hot loop never crosses a socket;
+- the launcher blocks until membership is complete, so bootstrap joins
+  never migrate anything.
+
+The launcher is router-role code: no jax (the workers own the
+accelerator math in their own processes).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import replace as dc_replace
+from typing import Dict, List, Optional, Sequence
+
+from fmda_tpu.config import (
+    FleetTopologyConfig,
+    FrameworkConfig,
+    fleet_topics,
+)
+from fmda_tpu.fleet.router import FleetRouter
+from fmda_tpu.fleet.wire import BusServer
+
+log = logging.getLogger("fmda_tpu.fleet")
+
+
+def spawn_supported(python: str = sys.executable) -> bool:
+    """Can this host spawn worker subprocesses at all?  (Sandboxed CI
+    hosts sometimes cannot — the multihost bench reports ``skipped``
+    instead of erroring there.)"""
+    try:
+        proc = subprocess.run(
+            [python, "-c", "pass"], timeout=60,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return proc.returncode == 0
+    except Exception:  # noqa: BLE001 — any failure means "no"
+        return False
+
+
+def _build_local_bus(config: FrameworkConfig, topics: Sequence[str]):
+    """NativeBus when buildable (the C++ log is the production-shaped
+    local broker), InProcessBus otherwise — same fallback contract as
+    :func:`fmda_tpu.app.default_bus`, with the fleet topics added and
+    the arena sized for deep tick backlogs."""
+    try:
+        from fmda_tpu.stream.native_bus import NativeBus, native_available
+
+        if native_available():
+            return NativeBus(
+                topics,
+                arena_bytes=config.fleet.bus_arena_bytes,
+                max_records=config.bus.capacity)
+    except Exception as e:  # noqa: BLE001 — fall back, never fail startup
+        log.warning("native bus unavailable (%s); using InProcessBus", e)
+    from fmda_tpu.stream.bus import InProcessBus
+
+    return InProcessBus(topics, capacity=config.bus.capacity)
+
+
+class LocalFleet:
+    """A running local topology: workers spawned, router inline."""
+
+    def __init__(
+        self,
+        *,
+        router: FleetRouter,
+        server,
+        bus,
+        procs: List[subprocess.Popen],
+        worker_ids: List[str],
+        log_dir: str,
+    ) -> None:
+        self.router = router
+        self.server = server
+        self.bus = bus
+        self.procs = procs
+        self.worker_ids = worker_ids
+        self.log_dir = log_dir
+
+    def __enter__(self) -> "LocalFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def shutdown(
+        self, *, graceful: bool = True, timeout_s: float = 30.0
+    ) -> Dict[str, dict]:
+        """Stop the topology; returns the final per-worker stats (off
+        their goodbye heartbeats).  Stragglers are terminated, then
+        killed — shutdown always completes."""
+        try:
+            self.router.stop_workers(graceful=graceful)
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                self.router.pump()
+                if all(p.poll() is not None for p in self.procs):
+                    break
+                time.sleep(0.05)
+        except ConnectionError:
+            log.warning("bus connection lost during shutdown")
+        finally:
+            for p in self.procs:
+                if p.poll() is None:
+                    p.terminate()
+            for p in self.procs:
+                if p.poll() is None:
+                    try:
+                        p.wait(timeout=5.0)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+            self.router.close()
+            self.server.stop()
+        return self.router.worker_stats()
+
+    def worker_logs(self) -> Dict[str, str]:
+        """Captured stdout+stderr per spawned process (post-mortem)."""
+        out = {}
+        for name in self.worker_ids:
+            path = os.path.join(self.log_dir, f"{name}.log")
+            try:
+                with open(path) as fh:
+                    out[name] = fh.read()
+            except OSError:
+                out[name] = ""
+        return out
+
+
+def _spawn(argv: List[str], log_path: str, repo_root: str):
+    log_fh = open(log_path, "w")
+    proc = subprocess.Popen(
+        argv, stdout=log_fh, stderr=subprocess.STDOUT, cwd=repo_root)
+    log_fh.close()  # the child holds its own descriptor
+    return proc
+
+
+def launch_local_fleet(
+    *,
+    n_workers: int,
+    config: Optional[FrameworkConfig] = None,
+    hidden: int = 32,
+    seed: int = 0,
+    capacity_per_worker: Optional[int] = None,
+    bucket_sizes: Optional[Sequence[int]] = None,
+    max_linger_ms: Optional[float] = None,
+    window: Optional[int] = None,
+    trace_dir: Optional[str] = None,
+    platform: str = "cpu",
+    wait_timeout_s: float = 180.0,
+    python: str = sys.executable,
+    log_dir: Optional[str] = None,
+) -> LocalFleet:
+    """Spawn the whole topology and block until every worker joined.
+
+    Worker model/runtime knobs are passed on the command line so every
+    process builds the identical serving stack; ``trace_dir`` enables
+    tracing in every process with one ``--trace-out`` file per worker
+    (merge with ``python -m fmda_tpu trace --merge <trace_dir>``).
+    """
+    config = config or FrameworkConfig()
+    fleet_cfg: FleetTopologyConfig = dc_replace(
+        config.fleet, n_workers=n_workers)
+    worker_ids = [
+        f"{fleet_cfg.worker_prefix}{i}" for i in range(n_workers)]
+    log_dir = log_dir or tempfile.mkdtemp(prefix="fmda_fleet_")
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    # the router's own bus: the control plane, plus shared-mode inbox/
+    # results topics so --shared-bus workers (and tests) still work
+    from fmda_tpu.config import DEFAULT_TOPICS
+
+    topics = tuple(DEFAULT_TOPICS) + fleet_topics(worker_ids)
+    bus = _build_local_bus(config, topics)
+    server = BusServer(bus, host=fleet_cfg.host,
+                       port=fleet_cfg.port).start()
+    address = server.address
+    procs: List[subprocess.Popen] = []
+    try:
+        for wid in worker_ids:
+            argv = [
+                python, "-m", "fmda_tpu", "serve-fleet",
+                "--role", "worker",
+                "--platform", platform,
+                "--worker-id", wid,
+                "--connect", address,
+                "--hidden", str(hidden),
+                "--seed", str(seed),
+            ]
+            if capacity_per_worker is not None:
+                argv += ["--sessions", str(capacity_per_worker)]
+            if bucket_sizes is not None:
+                argv += ["--bucket-sizes",
+                         ",".join(str(b) for b in bucket_sizes)]
+            if max_linger_ms is not None:
+                argv += ["--max-linger-ms", str(max_linger_ms)]
+            if window is not None:
+                argv += ["--window", str(window)]
+            if trace_dir:
+                argv += ["--trace", "--trace-out",
+                         os.path.join(trace_dir, f"{wid}.json")]
+            procs.append(_spawn(
+                argv, os.path.join(log_dir, f"{wid}.log"), repo_root))
+
+        router = FleetRouter(
+            bus, fleet_cfg, n_features=config.features.n_features)
+
+        def _sleep_and_check(dt: float) -> None:
+            time.sleep(dt)
+            for p, wid in zip(procs, worker_ids):
+                if p.poll() is not None:
+                    tail = ""
+                    try:
+                        with open(os.path.join(
+                                log_dir, f"{wid}.log")) as fh:
+                            tail = fh.read()[-2000:]
+                    except OSError:
+                        pass
+                    raise RuntimeError(
+                        f"worker {wid} exited rc={p.returncode} before "
+                        f"joining; log tail:\n{tail}")
+
+        router.wait_for_workers(
+            n_workers, timeout_s=wait_timeout_s,
+            sleep_fn=_sleep_and_check)
+    except BaseException:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+        raise
+    return LocalFleet(
+        router=router, server=server, bus=bus, procs=procs,
+        worker_ids=worker_ids, log_dir=log_dir)

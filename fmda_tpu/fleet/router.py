@@ -1,0 +1,826 @@
+"""The fleet router: session → owner routing, membership, live migration.
+
+One :class:`FleetRouter` fronts N worker processes.  It owns the
+session registry and the versioned :class:`~fmda_tpu.fleet.hashring
+.OwnershipTable`; every session's ticks flow to its owner's inbox topic
+in submission order, and results come back on the prediction topic.
+The router is deliberately **model-free**: it never touches jax, numpy
+math, or checkpoints — a bus-only host runs it (the tier-1 hygiene
+check enforces no module-scope jax on this import path).
+
+Data-plane topology
+-------------------
+
+The control plane (membership, migrated state) is one topic on the
+router's bus.  The data plane (ticks in, results out) has two shapes:
+
+- **shared bus** — every worker reads/writes the router's own bus (an
+  in-process topology, or one external broker/Kafka).  Simple, but one
+  broker serializes the whole fleet's hot path;
+- **worker-hosted** — each worker serves its *own* bus (inbox + results)
+  and announces its address in every heartbeat; the router connects a
+  :class:`~fmda_tpu.fleet.wire.SocketBus` per worker and exchanges each
+  pump's traffic in one batched round trip per worker.  The worker's
+  serving loop then never crosses a socket, and data-plane capacity
+  scales with the worker count — the partitions-move-with-their-owner
+  shape (``serve-fleet --role worker`` does this by default).
+
+Ordering and the migration protocol
+-----------------------------------
+
+Per-session tick order is preserved end to end by *in-band* sequencing,
+never by timestamps:
+
+1. the router is single-threaded per pump, so a session's ticks enter
+   its owner's **FIFO inbox topic** in submission order;
+2. the worker consumes its inbox in offset order and its embedded
+   :class:`~fmda_tpu.runtime.gateway.FleetGateway` preserves per-session
+   order through micro-batching (one row per session per flush);
+3. migration markers ride the same inbox: a ``drain_session`` message
+   enqueued *after* a session's last routed tick is necessarily
+   processed after it.
+
+Migrating session S from worker A to worker B (ownership-table change):
+
+- the router stops routing S (new ticks **buffer** at the router,
+  bounded + counted) and enqueues ``drain_session`` on A's inbox;
+- A serves everything queued for S, exports S's carried state +
+  sequence counter (bit-exact codec, :mod:`fmda_tpu.fleet.state`),
+  publishes it on the control topic, and frees the slot;
+- the router receives the state, enqueues ``open`` (with state) on B's
+  inbox followed by the buffered ticks in order, and resumes routing.
+
+No tick is dropped (buffered, not discarded), none is reordered (every
+hop is FIFO), and none is duplicated (each tick is routed exactly once;
+the state transfer carries the sequence counter so B continues A's
+``seq`` stream).  A worker that dies *without* draining loses carried
+state by definition — its sessions are reopened fresh on the new owner
+(``sessions_lost_state`` counted) and ticks already in its inbox age
+out as ``results_missing``: counted degradation, never silence.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fmda_tpu.config import (
+    FleetTopologyConfig,
+    TOPIC_FLEET_CONTROL,
+    TOPIC_FLEET_PREDICTION,
+    fleet_worker_topic,
+)
+from fmda_tpu.fleet.hashring import OwnershipTable
+from fmda_tpu.fleet.membership import GOODBYE, HEARTBEAT, HELLO, MembershipView
+from fmda_tpu.fleet.state import encode_norm, encode_row
+from fmda_tpu.obs.trace import default_tracer, now_ns
+from fmda_tpu.runtime.metrics import RuntimeMetrics
+
+log = logging.getLogger("fmda_tpu.fleet")
+
+
+class NoLiveWorkers(RuntimeError):
+    """open_session on a fleet with an empty membership."""
+
+
+@dataclass(frozen=True)
+class FleetResult:
+    """One served tick as observed at the router (mirrors the worker
+    gateway's result, decoded off the prediction topic)."""
+
+    session_id: str
+    seq: int
+    probabilities: np.ndarray
+    labels: Tuple[str, ...]
+
+
+@dataclass
+class _Session:
+    """Router-side registry entry for one session."""
+
+    session_id: str
+    #: current owner worker id (None while orphaned — no live workers)
+    owner: Optional[str]
+    norm_wire: Optional[dict]
+    #: next router-side sequence number (stays in lockstep with the
+    #: owning gateway's ``seq`` because ticks are routed exactly once)
+    next_seq: int = 0
+    #: "active" = ticks route; "migrating" = ticks buffer until the
+    #: pending open lands on the new owner
+    status: str = "active"
+    #: current migration id (stale session_state messages are ignored)
+    mig: Optional[str] = None
+    #: ticks buffered while migrating/orphaned, in submission order
+    buffer: Deque[dict] = field(default_factory=deque)
+    #: exported state that arrived while no worker could host it
+    pending_state: Optional[dict] = None
+
+
+@dataclass
+class _WorkerLink:
+    """The router's data-plane connection to one worker's own bus."""
+
+    address: str
+    bus: object
+    #: next fleet_prediction offset to read off this worker's bus
+    results_offset: int = 0
+
+
+class FleetRouter:
+    """Routes a session space over live workers; drives migration."""
+
+    def __init__(
+        self,
+        bus,
+        config: Optional[FleetTopologyConfig] = None,
+        *,
+        n_features: int,
+        metrics: Optional[RuntimeMetrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        control_topic: str = TOPIC_FLEET_CONTROL,
+        prediction_topic: str = TOPIC_FLEET_PREDICTION,
+        connect_fn: Optional[Callable[[str], object]] = None,
+    ) -> None:
+        self.cfg = config or FleetTopologyConfig()
+        self.bus = bus
+        self.n_features = n_features
+        self.metrics = metrics or RuntimeMetrics()
+        self.clock = clock
+        self.control_topic = control_topic
+        self.prediction_topic = prediction_topic
+        self.membership = MembershipView(
+            self.cfg.heartbeat_timeout_s, clock=clock)
+        self.table = OwnershipTable(0, (), self.cfg.hash_space)
+        self._sessions: Dict[str, _Session] = {}
+        #: session ids whose status != "active" (migrating/orphaned) —
+        #: maintained at every status transition so saturation checks
+        #: and drain's are-we-done test never scan the whole registry
+        self._migrating: set = set()
+        #: leaving workers already sent their stop (idempotence; the
+        #: leave mark itself stays until the goodbye arrives, so the
+        #: stopping worker is never re-added to live())
+        self._stops_sent: set = set()
+        #: per-worker outgoing message batch, flushed each pump with one
+        #: publish_many (one JSON pass + one transport call per worker)
+        self._outgoing: Dict[str, List[dict]] = {}
+        #: data-plane links to worker-hosted buses (absent for workers
+        #: sharing this router's bus)
+        self._links: Dict[str, _WorkerLink] = {}
+        #: (worker_id, address) -> results_offset saved when a link
+        #: drops on a TRANSIENT error: the worker's bus (and its
+        #: retained results) are still there, so the re-link must
+        #: resume where it left off — restarting at 0 would re-deliver
+        #: every retained result as a duplicate.  A fresh incarnation
+        #: announces itself with a hello, which purges these (its new
+        #: bus restarts at offset 0).
+        self._link_resume: Dict[Tuple[str, str], int] = {}
+        #: (session, seq) -> (t_submit, trace_ref) for latency + loss
+        #: accounting; insertion-ordered, aged out at result_timeout_s
+        self._inflight: "OrderedDict[Tuple[str, int], tuple]" = OrderedDict()
+        self._control = bus.consumer(control_topic)
+        self._results = bus.consumer(prediction_topic)
+        self._mig_ids = itertools.count(1)
+        self._tracer = default_tracer()
+        #: set while the whole topology is being stopped: membership
+        #: churn then triggers NO migrations/reopens (every worker is
+        #: exiting — moving sessions between them is wasted motion)
+        self._stopping = False
+        #: how to reach a worker-announced data-plane address
+        if connect_fn is None:
+            from fmda_tpu.fleet.wire import SocketBus
+
+            connect_fn = lambda addr: SocketBus.connect(  # noqa: E731
+                addr, timeout_s=30.0)
+        self._connect_fn = connect_fn
+
+    # -- membership bootstrap ------------------------------------------------
+
+    def wait_for_workers(
+        self,
+        n: int,
+        *,
+        timeout_s: float = 60.0,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ) -> List[str]:
+        """Pump the control topic until ``n`` workers are live (the
+        launcher calls this before admitting sessions, so bootstrap
+        joins never trigger migrations)."""
+        deadline = self.clock() + timeout_s
+        while True:
+            self._drain_control()
+            if len(self.membership) >= n:
+                return self.membership.live()
+            if self.clock() >= deadline:
+                raise RuntimeError(
+                    f"only {self.membership.live()} of {n} workers "
+                    f"joined within {timeout_s:.0f}s")
+            sleep_fn(0.01)
+
+    # -- session admission ---------------------------------------------------
+
+    def open_session(self, session_id: str, norm=None) -> None:
+        """Admit a session: register it and route an ``open`` to its
+        owner.  Raises :class:`NoLiveWorkers` when the fleet is empty —
+        admission control stays loud, like the gateway's."""
+        if session_id in self._sessions:
+            raise ValueError(f"session {session_id!r} already open")
+        owner = self.table.owner_of(session_id)
+        if owner is None:
+            self.metrics.count("rejected_sessions")
+            raise NoLiveWorkers(
+                "no live workers to own sessions (did the fleet start? "
+                "wait_for_workers bootstraps membership)")
+        sess = _Session(session_id, owner, encode_norm(norm))
+        self._sessions[session_id] = sess
+        self._enqueue(owner, self._open_msg(sess))
+        self.metrics.count("sessions_opened")
+        self._sessions_changed()
+
+    def close_session(self, session_id: str) -> None:
+        sess = self._sessions.pop(session_id, None)
+        if sess is None:
+            raise KeyError(f"no open session {session_id!r}")
+        if sess.owner is not None and sess.status == "active":
+            self._enqueue(
+                sess.owner, {"kind": "close", "session": session_id})
+        # stop tracking the dead incarnation's in-flight ticks NOW: a
+        # reopen restarts seq at 0, and a stale (session, seq) key would
+        # collide with the new stream's tracking
+        stale = [k for k in self._inflight if k[0] == session_id]
+        for k in stale:
+            del self._inflight[k]
+        if stale:
+            self.metrics.count("inflight_dropped_on_close", len(stale))
+        self._migrating.discard(session_id)
+        self.metrics.count("sessions_closed")
+        self._sessions_changed()
+
+    def _open_msg(self, sess: _Session, state: Optional[dict] = None) -> dict:
+        msg = {
+            "kind": "open",
+            "session": sess.session_id,
+            "norm": sess.norm_wire,
+            "seq": int(state["seq"]) if state is not None else sess.next_seq,
+        }
+        if state is not None:
+            msg["state"] = state
+        if sess.mig is not None:
+            msg["mig"] = sess.mig
+        return msg
+
+    def _sessions_changed(self) -> None:
+        self.metrics.gauge("active_sessions", len(self._sessions))
+
+    # -- the request path ----------------------------------------------------
+
+    def submit(self, session_id: str, row: np.ndarray) -> int:
+        """Route one tick; returns its per-session sequence number.
+        Migrating/orphaned sessions buffer (bounded + counted) instead
+        of racing their state transfer."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"no open session {session_id!r}")
+        row = np.asarray(row, np.float32)
+        if row.shape != (self.n_features,):
+            raise ValueError(
+                f"row shape {row.shape} != ({self.n_features},) for "
+                f"session {session_id!r}")
+        seq = sess.next_seq
+        sess.next_seq = seq + 1
+        msg = {
+            "kind": "tick",
+            "session": session_id,
+            "row": encode_row(row),
+            "seq": seq,
+        }
+        ref = self._tracer.maybe_trace()
+        if ref is not None:
+            msg["trace"] = ref.wire
+        self._inflight[(session_id, seq)] = (self.clock(), ref)
+        self.metrics.count("routed_ticks")
+        if sess.status == "active" and sess.owner is not None:
+            self._enqueue(sess.owner, msg)
+        else:
+            sess.buffer.append(msg)
+            self.metrics.count("buffered_ticks")
+            while len(sess.buffer) > self.cfg.migration_buffer_bound:
+                shed = sess.buffer.popleft()
+                self._inflight.pop(
+                    (session_id, shed["seq"]), None)
+                self.metrics.count("migration_buffer_shed")
+        return seq
+
+    @property
+    def saturated(self) -> bool:
+        """Router-side backpressure: too many unanswered ticks in
+        flight (the fleet is behind — an unbounded inbox backlog would
+        eventually outrun bus retention), or a migration buffer at its
+        bound.  Well-behaved producers pump-and-wait instead of racing
+        either limit.  O(migrating sessions), not O(all sessions) —
+        this sits in front of every submit."""
+        if len(self._inflight) >= self.cfg.max_inflight_ticks:
+            return True
+        if not self._migrating:
+            return False
+        bound = self.cfg.migration_buffer_bound
+        return any(
+            len(self._sessions[sid].buffer) >= bound
+            for sid in self._migrating
+            if sid in self._sessions
+        )
+
+    def _set_status(self, sess: _Session, status: str) -> None:
+        sess.status = status
+        if status == "active":
+            self._migrating.discard(sess.session_id)
+        else:
+            self._migrating.add(sess.session_id)
+        self.metrics.gauge("migrating_sessions", len(self._migrating))
+
+    def _enqueue(self, worker_id: str, msg: dict) -> None:
+        self._outgoing.setdefault(worker_id, []).append(msg)
+
+    # -- the serving loop ----------------------------------------------------
+
+    def pump(self, *, force: bool = False) -> List[FleetResult]:
+        """One router cycle: fold control messages (membership, migrated
+        state), reap silent workers, exchange data with every worker
+        (outgoing batch + results, one round trip per linked worker),
+        and return the results that arrived.  ``force`` is accepted for
+        gateway-API compatibility (the router has no deferred flushes —
+        every pump flushes)."""
+        del force
+        self._drain_control()
+        dead = self.membership.reap()
+        if dead:
+            self.metrics.count("workers_dead", len(dead))
+            for wid in dead:
+                # resume=True: a falsely-reaped worker (long stall, not
+                # death) re-joins via its next beat and must not re-read
+                # its retained results from 0; a truly dead worker's
+                # replacement hellos, which purges the saved position
+                self._close_link(wid, resume=True)
+                self._stops_sent.discard(wid)
+            self._rebalance(f"worker death: {sorted(dead)}")
+        # a migration completed this pump may have emptied a leaving
+        # worker — release it now, not on the next membership change
+        self._maybe_release_leaving()
+        results = self._exchange_data()
+        self._age_inflight()
+        self.metrics.gauge("inflight_ticks", len(self._inflight))
+        return results
+
+    def drain(
+        self,
+        *,
+        timeout_s: float = 60.0,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ) -> List[FleetResult]:
+        """Pump until every routed tick has answered (or aged out) and
+        no migration is mid-flight — the end-of-load / shutdown path.
+        Bounded by ``timeout_s`` of *stall* (no progress), not of total
+        wall clock: a busy fleet draining a deep backlog keeps going as
+        long as results keep arriving."""
+        results: List[FleetResult] = []
+        last_progress = self.clock()
+        outstanding = len(self._inflight)
+        while True:
+            got = self.pump()
+            results.extend(got)
+            if not self._inflight and not self._migrating:
+                return results
+            now = self.clock()
+            if len(self._inflight) != outstanding or got:
+                outstanding = len(self._inflight)
+                last_progress = now
+            elif now - last_progress > timeout_s:
+                self.metrics.count("drain_stalled")
+                log.warning(
+                    "drain stalled: %d ticks unanswered after %.0fs "
+                    "without progress", len(self._inflight), timeout_s)
+                return results
+            sleep_fn(0.002)
+
+    # -- data-plane exchange -------------------------------------------------
+
+    def _exchange_data(self) -> List[FleetResult]:
+        """Flush every per-worker outgoing batch and collect results.
+
+        Linked (worker-hosted-bus) workers get ONE round trip each:
+        their tick batch and their results read share a batched frame —
+        on high-syscall-latency hosts the round-trip count is the
+        router's throughput ceiling (fmda_tpu.fleet.wire).  Workers on
+        the shared bus are published/polled through it as a group.
+        """
+        outgoing, self._outgoing = self._outgoing, {}
+        tracing = self._tracer.enabled
+        rows: List[tuple] = []
+        for wid, link in list(self._links.items()):
+            msgs = outgoing.pop(wid, [])
+            t0_ns = now_ns() if tracing else 0
+            t0 = self.clock()
+            try:
+                with self.metrics.timer.stage("route"):
+                    batch = getattr(link.bus, "batch", None)
+                    read_op = {
+                        "op": "read",
+                        "topic": self.prediction_topic,
+                        "offset": link.results_offset,
+                        "max_records": None,
+                    }
+                    if batch is not None:
+                        ops = []
+                        if msgs:
+                            ops.append({
+                                "op": "publish_many",
+                                "topic": fleet_worker_topic(wid),
+                                "values": msgs,
+                            })
+                        ops.append(read_op)
+                        resps = link.bus.batch(ops)
+                        for op, resp in zip(ops[:-1], resps[:-1]):
+                            if "err" in resp:
+                                self.metrics.count(
+                                    "routed_publish_errors",
+                                    len(op["values"]))
+                                log.error(
+                                    "router: publish to %s failed: %s",
+                                    wid, resp["err"])
+                        link_rows = link.bus.unwrap_op(read_op, resps[-1])
+                    else:
+                        if msgs:
+                            link.bus.publish_many(
+                                fleet_worker_topic(wid), msgs)
+                        link_rows = [
+                            (r.offset, r.value) for r in link.bus.read(
+                                self.prediction_topic,
+                                link.results_offset)]
+            except (ConnectionError, OSError) as e:
+                # the worker's bus went away mid-exchange: drop the
+                # link (a live worker's next heartbeat re-links it —
+                # every beat carries the address; a dead worker's
+                # silence confirms the death by timeout) and count the
+                # batch lost, never silent
+                self.metrics.count("link_errors")
+                self.metrics.count("routed_ticks_lost", len(msgs))
+                log.warning("data link to %s failed: %s", wid, e)
+                self._close_link(wid, resume=True)
+                continue
+            if msgs:
+                self.metrics.observe("route", self.clock() - t0)
+                if tracing:
+                    t1_ns = now_ns()
+                    for msg in msgs:
+                        wire = msg.get("trace")
+                        if wire is not None:
+                            self._tracer.add_span_wire(
+                                wire, "route", "bus", t0_ns, t1_ns)
+            if link_rows:
+                link.results_offset = int(link_rows[-1][0]) + 1
+                rows.extend(link_rows)
+        # whatever remains targets shared-bus workers (or stale ids
+        # whose topic still exists on the shared bus)
+        if outgoing:
+            publish_many = getattr(self.bus, "publish_many", None)
+            for wid, msgs in outgoing.items():
+                t0_ns = now_ns() if tracing else 0
+                t0 = self.clock()
+                try:
+                    with self.metrics.timer.stage("route"):
+                        topic = fleet_worker_topic(wid)
+                        if publish_many is not None:
+                            publish_many(topic, msgs)
+                        else:
+                            for msg in msgs:
+                                self.bus.publish(topic, msg)
+                except KeyError:
+                    self.metrics.count("routed_publish_errors", len(msgs))
+                    log.error(
+                        "router: no inbox topic for %s on the shared "
+                        "bus", wid)
+                    continue
+                self.metrics.observe("route", self.clock() - t0)
+                if tracing:
+                    t1_ns = now_ns()
+                    for msg in msgs:
+                        wire = msg.get("trace")
+                        if wire is not None:
+                            self._tracer.add_span_wire(
+                                wire, "route", "bus", t0_ns, t1_ns)
+        # shared-bus results: skip the poll only when every live worker
+        # is linked (then nothing ever lands on the shared topic)
+        if (not self._links
+                or any(wid not in self._links
+                       for wid in self.membership.workers)):
+            rows.extend(
+                (r.offset, r.value) for r in self._results.poll())
+        return self._fold_results(rows)
+
+    def _ensure_link(self, worker_id: str, address: Optional[str]) -> None:
+        """(Re)connect the data-plane link a worker announces."""
+        if not address:
+            return
+        link = self._links.get(worker_id)
+        if link is not None and link.address == address:
+            return
+        if link is not None:
+            self._close_link(worker_id)
+        try:
+            bus = self._connect_fn(address)
+        except (OSError, ConnectionError) as e:
+            self.metrics.count("link_errors")
+            log.error("cannot connect %s data bus at %s: %s",
+                      worker_id, address, e)
+            return
+        self._links[worker_id] = _WorkerLink(
+            address=address, bus=bus,
+            results_offset=self._link_resume.pop((worker_id, address), 0))
+        log.info("data link to %s at %s", worker_id, address)
+
+    def _close_link(self, worker_id: str, *, resume: bool = False) -> None:
+        """Drop a worker's data link.  ``resume`` (transient link error:
+        the worker's bus survives) saves the results read position so the
+        heartbeat-driven re-link picks up where this one stopped; the
+        default (leave/death/goodbye/shutdown — the process is gone)
+        forgets it, because a replacement's bus restarts at offset 0."""
+        link = self._links.pop(worker_id, None)
+        if resume and link is not None:
+            self._link_resume[(worker_id, link.address)] = \
+                link.results_offset
+        elif not resume:
+            for key in [k for k in self._link_resume if k[0] == worker_id]:
+                del self._link_resume[key]
+        if link is not None:
+            close = getattr(link.bus, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except OSError:
+                    pass
+
+    def _fold_results(self, rows) -> List[FleetResult]:
+        results: List[FleetResult] = []
+        for _offset, v in rows:
+            sid, seq = v.get("session"), v.get("seq")
+            entry = self._inflight.pop((sid, seq), None)
+            if entry is not None:
+                t_submit, ref = entry
+                self.metrics.observe("total", self.clock() - t_submit)
+                if ref is not None:
+                    self._tracer.finish_root(ref, "tick", "ingest", now_ns())
+            else:
+                # a result this router never routed (restart, foreign
+                # producer, tick that aged out) — visible, not fatal
+                self.metrics.count("results_unmatched")
+            results.append(FleetResult(
+                sid, seq,
+                np.asarray(v.get("probabilities", ()), np.float32),
+                tuple(v.get("pred_labels", ())),
+            ))
+        self.metrics.count("results_received", len(results))
+        return results
+
+    def _age_inflight(self) -> None:
+        now = self.clock()
+        timeout = self.cfg.result_timeout_s
+        while self._inflight:
+            key = next(iter(self._inflight))
+            t_submit, _ref = self._inflight[key]
+            if now - t_submit <= timeout:
+                break
+            del self._inflight[key]
+            self.metrics.count("results_missing")
+            log.warning(
+                "tick (%s, %d) unanswered after %.0fs — counted lost",
+                key[0], key[1], timeout)
+
+    # -- control plane -------------------------------------------------------
+
+    def _drain_control(self) -> None:
+        for rec in self._control.poll():
+            self._handle_control(rec.value)
+
+    def _handle_control(self, msg: dict) -> None:
+        kind = msg.get("kind")
+        if kind in (HELLO, HEARTBEAT, GOODBYE):
+            if kind == HELLO:
+                # a hello is a fresh process whose data bus restarts at
+                # offset 0 — any resume position saved from a previous
+                # incarnation's transient link error is now wrong
+                self._close_link(msg.get("worker"))
+            if kind != GOODBYE:
+                # link before rebalance: a join's first drain markers
+                # and opens must have somewhere to land
+                self._ensure_link(msg.get("worker"), msg.get("address"))
+            event = self.membership.observe(msg)
+            if event == "join":
+                self.metrics.count("workers_joined")
+                self._stops_sent.discard(msg.get("worker"))
+                self._rebalance(f"worker join: {msg.get('worker')}")
+            elif event == "leave":
+                self.metrics.count("workers_left")
+                # drop the link before the next pump would error on it
+                self._close_link(msg.get("worker"))
+                self._stops_sent.discard(msg.get("worker"))
+                self._rebalance(f"worker leave: {msg.get('worker')}")
+            elif kind == GOODBYE:
+                # a released leaving worker's goodbye: already out of
+                # live(), nothing to rebalance — just drop its link
+                self._close_link(msg.get("worker"))
+                self._stops_sent.discard(msg.get("worker"))
+        elif kind == "session_state":
+            self._on_session_state(msg)
+        elif kind == "leaving":
+            self.request_leave(msg.get("worker"))
+        elif kind == "open_failed":
+            self.metrics.count("open_failures")
+            log.error(
+                "worker %s could not open session %s: %s",
+                msg.get("worker"), msg.get("session"), msg.get("error"))
+        # "ownership" announcements are our own — ignored on re-read
+
+    def request_leave(self, worker_id: Optional[str]) -> None:
+        """Gracefully drain a worker out of the fleet: it keeps serving
+        while its sessions migrate off one ``drain_session`` at a time,
+        and is stopped once it owns nothing."""
+        if worker_id and self.membership.mark_leaving(worker_id):
+            self.metrics.count("workers_leaving")
+            self._rebalance(f"graceful leave: {worker_id}")
+
+    def _maybe_release_leaving(self) -> None:
+        """Stop a leaving worker once no session is assigned to it any
+        more (its drains are all complete).  The leave mark is NOT
+        cleared here — the worker stays out of live() until its goodbye
+        actually arrives, so a join rebalance in the stop→goodbye
+        window can never route sessions (or migrated state) into the
+        stopping worker's inbox."""
+        for wid in sorted(self.membership.leaving - self._stops_sent):
+            if any(s.owner == wid for s in self._sessions.values()):
+                continue
+            self._enqueue(wid, {"kind": "stop"})
+            self._stops_sent.add(wid)
+
+    def _rebalance(self, reason: str) -> None:
+        """Re-derive the ownership table from the live set and move (or
+        reopen) every session whose range changed hands."""
+        live = self.membership.live()
+        self.table = OwnershipTable.derive(
+            self.table.version + 1, live, self.cfg.hash_space)
+        self.metrics.count("rebalances")
+        self.metrics.gauge("n_workers", len(live))
+        self.metrics.gauge("table_version", self.table.version)
+        if self._stopping:
+            # the whole topology is exiting: goodbyes must not cascade
+            # into pointless migrations between dying workers
+            return
+        self.bus.publish(self.control_topic, {
+            "kind": "ownership", "table": self.table.to_wire(),
+            "reason": reason,
+        })
+        log.info(
+            "ownership v%d over %s (%s)", self.table.version, live, reason)
+        # "present" = still alive and serving its inbox, even if leaving
+        # (a leaving worker is out of live() — it gets no NEW sessions —
+        # but it gracefully drains the ones it has)
+        present = set(self.membership.workers)
+        for sess in self._sessions.values():
+            new_owner = self.table.owner_of(sess.session_id)
+            if sess.status != "active":
+                # migration already in flight: if the exporter died
+                # before its state got out (or never existed), the state
+                # is gone — reopen fresh; otherwise the state message is
+                # still coming and will be routed against the new table
+                if sess.owner not in present and sess.pending_state is None:
+                    if sess.mig is not None:
+                        self.metrics.count("migrations_aborted")
+                    self._reopen_lost(sess, new_owner)
+                elif sess.pending_state is not None and new_owner is not None:
+                    self._complete_migration(sess, new_owner,
+                                             sess.pending_state)
+                continue
+            if new_owner == sess.owner:
+                continue
+            if sess.owner not in present:
+                # owner died with the carried state on board
+                self._reopen_lost(sess, new_owner)
+            else:
+                self._start_migration(sess)
+        self._maybe_release_leaving()
+
+    def _start_migration(self, sess: _Session) -> None:
+        self._set_status(sess, "migrating")
+        sess.mig = f"m{next(self._mig_ids)}"
+        self._enqueue(sess.owner, {
+            "kind": "drain_session",
+            "session": sess.session_id,
+            "mig": sess.mig,
+        })
+        self.metrics.count("migrations_started")
+
+    def _on_session_state(self, msg: dict) -> None:
+        sess = self._sessions.get(msg.get("session"))
+        if sess is None or sess.mig != msg.get("mig"):
+            self.metrics.count("stale_session_state")
+            return
+        # state stays in wire form end to end — the router never decodes
+        # the arrays, it only forwards them to the new owner
+        new_owner = self.table.owner_of(sess.session_id)
+        if new_owner is None:
+            # every worker left between export and now: hold the state
+            # until one joins (the next rebalance re-enters here)
+            sess.pending_state = msg["state"]
+            sess.owner = None
+            return
+        self._complete_migration(sess, new_owner, msg["state"])
+
+    def _complete_migration(
+        self, sess: _Session, new_owner: str, state: dict
+    ) -> None:
+        self._enqueue(new_owner, self._open_msg(sess, state=state))
+        replayed = len(sess.buffer)
+        while sess.buffer:
+            self._enqueue(new_owner, sess.buffer.popleft())
+        sess.owner = new_owner
+        self._set_status(sess, "active")
+        sess.mig = None
+        sess.pending_state = None
+        self.metrics.count("migrations_completed")
+        self.metrics.count("migration_replayed_ticks", replayed)
+        log.info(
+            "session %s migrated to %s (%d buffered ticks replayed)",
+            sess.session_id, new_owner, replayed)
+
+    def _reopen_lost(self, sess: _Session, new_owner: Optional[str]) -> None:
+        """The owner died with the session's carried state: reopen fresh
+        on the new owner (state restarts from zero — counted, documented
+        in the failure matrix) and forward any buffered ticks so the
+        stream keeps flowing."""
+        if sess.owner is not None:
+            # an ownerless session was already counted lost when its
+            # owner died; re-entering here on a later rebalance (a
+            # worker finally joined) is placement, not a second loss
+            self.metrics.count("sessions_lost_state")
+        sess.mig = None
+        sess.pending_state = None
+        if new_owner is None:
+            # no workers at all: buffer until one joins
+            sess.owner = None
+            self._set_status(sess, "migrating")
+            return
+        # resume the seq stream at the first tick the new owner will
+        # actually serve, so (session, seq) never collides
+        resume_seq = (sess.buffer[0]["seq"] if sess.buffer
+                      else sess.next_seq)
+        sess.owner = new_owner
+        self._set_status(sess, "active")
+        self._enqueue(new_owner, {
+            "kind": "open",
+            "session": sess.session_id,
+            "norm": sess.norm_wire,
+            "seq": resume_seq,
+        })
+        while sess.buffer:
+            self._enqueue(new_owner, sess.buffer.popleft())
+        log.warning(
+            "session %s reopened on %s with FRESH state (previous owner "
+            "died undrained)", sess.session_id, new_owner)
+
+    # -- shutdown / introspection -------------------------------------------
+
+    def stop_workers(self, *, graceful: bool = True) -> None:
+        """Tell every live worker to exit: ``graceful`` serves every
+        queued tick before exiting (final stats arrive with the
+        goodbye; carried state is NOT exported — a topology stop ends
+        the streams); otherwise a bare stop."""
+        self._stopping = True
+        kind = "drain_all" if graceful else "stop"
+        for wid in sorted(self.membership.workers):  # leaving ones too
+            self._enqueue(wid, {"kind": kind})
+        self._exchange_data()
+
+    def close(self) -> None:
+        """Release every data-plane link (shutdown)."""
+        for wid in list(self._links):
+            self._close_link(wid)
+
+    def worker_stats(self) -> Dict[str, dict]:
+        """Latest heartbeat-carried stats per worker (live + departed)."""
+        out = {}
+        for wid, info in {**self.membership.departed,
+                          **self.membership.workers}.items():
+            out[wid] = dict(info.stats)
+        return out
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            **self.metrics.summary(),
+            "table_version": self.table.version,
+            "workers": self.membership.live(),
+            "worker_stats": self.worker_stats(),
+        }

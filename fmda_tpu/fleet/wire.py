@@ -1,0 +1,461 @@
+"""Cross-process bus transport: a MessageBus served over TCP.
+
+The framework's local bus backends live inside one process (InProcessBus
+is Python objects, NativeBus a C++ arena in process memory); Kafka is
+the cross-process answer in production but demands an external broker.
+This module is the framework-owned middle: the fleet **router** hosts
+its bus (NativeBus when buildable, InProcessBus otherwise) and serves it
+on a socket with :class:`BusServer`; every worker connects a
+:class:`SocketBus` — the same :class:`~fmda_tpu.stream.bus.MessageBus`
+contract, so gateways/engines/consumers run unchanged over it.
+
+Framing: every request and response is one length-prefixed frame —
+4-byte big-endian length, then that many bytes of UTF-8 JSON.  A
+connection's requests are strictly serialized by the client (one lock
+around request→response), and the server handles each connection on its
+own thread against the thread-safe backing bus — so two processes
+publishing concurrently can interleave *records* (fine: offsets stay
+monotonic, each process's order is preserved) but never *frames* (a
+torn frame would corrupt every later message on the connection; the
+router↔worker transport contract test asserts both properties).
+
+No jax anywhere near this module: a router host is a bus-only host.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
+from fmda_tpu.stream.bus import Consumer, Record
+
+log = logging.getLogger("fmda_tpu.fleet")
+
+_TRACER = default_tracer()
+
+#: Frame-size ceiling (4-byte length prefix allows 4 GiB; a frame this
+#: large is a bug, not a batch).
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+_LEN = struct.Struct(">I")
+
+
+class _FrameIO:
+    """Buffered length-prefixed-JSON framing over one socket.
+
+    Receives into a process-side buffer with large ``recv`` calls, so a
+    frame costs O(frame/1MB) syscalls instead of one per header/body —
+    on sandboxed kernels a syscall runs ~100µs, and syscall count IS the
+    transport's latency budget.  One ``sendall`` per outgoing frame.
+    """
+
+    __slots__ = ("sock", "_buf")
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self._buf = bytearray()
+
+    def send_frame(self, obj: object) -> None:
+        payload = json.dumps(obj).encode("utf-8")
+        if len(payload) > MAX_FRAME_BYTES:
+            raise RuntimeError(
+                f"frame of {len(payload)}B exceeds the {MAX_FRAME_BYTES}B "
+                "transport limit")
+        self.sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def _fill(self, need: int) -> bool:
+        """Grow the buffer to ``need`` bytes; False on clean EOF with an
+        empty buffer, raises on EOF mid-frame."""
+        while len(self._buf) < need:
+            chunk = self.sock.recv(1 << 20)
+            if not chunk:
+                if not self._buf:
+                    return False
+                raise ConnectionError(
+                    f"peer closed mid-frame ({len(self._buf)}/{need} "
+                    "bytes)")
+            self._buf += chunk
+        return True
+
+    def recv_frame(self) -> Optional[object]:
+        if not self._fill(_LEN.size):
+            return None
+        (length,) = _LEN.unpack(self._buf[:_LEN.size])
+        if length > MAX_FRAME_BYTES:
+            raise ConnectionError(
+                f"peer announced a {length}B frame (> {MAX_FRAME_BYTES}B "
+                "limit) — stream corrupt or not speaking this protocol")
+        total = _LEN.size + length
+        if not self._fill(total):
+            raise ConnectionError("peer closed between header and body")
+        body = bytes(self._buf[_LEN.size:total])
+        del self._buf[:total]
+        return json.loads(body)
+
+
+def parse_address(address: str) -> Tuple[str, int]:
+    """``"host:port"`` -> (host, port); bare ``":port"`` means localhost."""
+    host, sep, port = address.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"bus address {address!r} is not of the form host:port")
+    return host or "127.0.0.1", int(port)
+
+
+class BusServer:
+    """Serves a backing MessageBus to SocketBus clients.
+
+    One accept-loop thread plus one thread per connection; every op maps
+    1:1 onto the backing bus's method, so the server adds transport, not
+    semantics.  Op errors travel back as ``{"err", "kind"}`` frames and
+    re-raise client-side; transport errors drop only the one connection.
+    """
+
+    def __init__(
+        self, bus, *, host: str = "127.0.0.1", port: int = 0
+    ) -> None:
+        self.bus = bus
+        self._host = host
+        self._requested_port = port
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._lock = threading.Lock()
+        self._closing = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "BusServer":
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((self._host, self._requested_port))
+        listener.listen(64)
+        self._listener = listener
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fmda-bus-server", daemon=True)
+        self._accept_thread.start()
+        log.info("bus server listening on %s:%d", self._host, self.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        if self._listener is None:
+            raise RuntimeError("server not started")
+        return self._listener.getsockname()[1]
+
+    @property
+    def address(self) -> str:
+        return f"{self._host}:{self.port}"
+
+    def stop(self) -> None:
+        self._closing = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+
+    # -- the serve loops ----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed (stop)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._serve_client, args=(conn,),
+                name="fmda-bus-client", daemon=True).start()
+
+    def _serve_client(self, conn: socket.socket) -> None:
+        io = _FrameIO(conn)
+        try:
+            while True:
+                try:
+                    req = io.recv_frame()
+                except (ConnectionError, OSError, json.JSONDecodeError):
+                    return
+                if req is None:
+                    return  # clean disconnect
+                resp = self._respond(req)
+                try:
+                    io.send_frame(resp)
+                except (OSError, RuntimeError):
+                    return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _respond(self, req: dict) -> dict:
+        try:
+            return {"ok": self._dispatch(req)}
+        except KeyError as e:
+            return {"err": str(e), "kind": "KeyError"}
+        except Exception as e:  # noqa: BLE001 — op failure is the
+            # client's problem; the connection stays usable
+            return {"err": f"{e!r}", "kind": type(e).__name__}
+
+    def _dispatch(self, req: dict) -> object:
+        op = req.get("op")
+        bus = self.bus
+        if op == "batch":
+            # several ops, one frame, one round trip: on high-syscall-
+            # latency hosts the RT count — not bytes or CPU — is the
+            # throughput ceiling, so router pumps and worker steps ride
+            # one frame each.  Sub-ops run in order; each fails alone.
+            return [self._respond(sub) for sub in req["ops"]]
+        if op == "publish":
+            return bus.publish(req["topic"], req["value"])
+        if op == "publish_many":
+            return bus.publish_many(req["topic"], req["values"])
+        if op == "read":
+            records = bus.read(
+                req["topic"], int(req["offset"]), req.get("max_records"))
+            return [[r.offset, r.value] for r in records]
+        if op == "end_offset":
+            return bus.end_offset(req["topic"])
+        if op == "base_offset":
+            base = getattr(bus, "base_offset", None)
+            return base(req["topic"]) if base is not None else 0
+        if op == "topics":
+            return list(bus.topics())
+        if op == "ping":
+            return "pong"
+        raise RuntimeError(f"unknown bus op {op!r}")
+
+
+class SocketBus:
+    """MessageBus client over one BusServer connection.
+
+    Same contract as InProcessBus/NativeBus/KafkaBus — topics, monotonic
+    offsets, independent consumers — with each call one request/response
+    round trip (reads are batched server-side, so a backlogged consumer
+    drains hundreds of records per round trip).  Thread-safe: a lock
+    serializes frames on the connection.  No auto-reconnect — a broken
+    connection raises, and the owner (worker loop) decides whether that
+    is fatal (it is: a worker that lost its router must stop serving).
+    """
+
+    def __init__(
+        self, host: str, port: int, *, timeout_s: Optional[float] = 60.0
+    ) -> None:
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._io = _FrameIO(self._sock)
+        self._lock = threading.Lock()
+        self._topics: Optional[Tuple[str, ...]] = None
+        self._publish_counters = None
+        self._consumed_cb = None
+        self.address = f"{host}:{port}"
+
+    @classmethod
+    def connect(cls, address: str, **kwargs) -> "SocketBus":
+        host, port = parse_address(address)
+        return cls(host, port, **kwargs)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "SocketBus":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def bind_metrics(self, registry) -> None:
+        """Same per-topic publish/consume counters as the other
+        backends, counted client-side."""
+        topics = self.topics()
+        self._publish_counters = {
+            t: registry.counter("bus_published_total", topic=t)
+            for t in topics
+        }
+        consume_counters = {
+            t: registry.counter("bus_consumed_total", topic=t)
+            for t in topics
+        }
+        self._consumed_cb = (
+            lambda topic, n: consume_counters[topic].inc(n)
+        )
+
+    # -- request plumbing ---------------------------------------------------
+
+    def _request(self, req: dict) -> object:
+        with self._lock:
+            try:
+                self._io.send_frame(req)
+                resp = self._io.recv_frame()
+            except OSError as e:
+                raise ConnectionError(
+                    f"bus connection to {self.address} failed: {e}") from e
+        if resp is None:
+            raise ConnectionError(
+                f"bus server at {self.address} closed the connection")
+        return self._unwrap(req, resp)
+
+    @staticmethod
+    def _unwrap(req: dict, resp: dict) -> object:
+        if "err" in resp:
+            if resp.get("kind") == "KeyError":
+                raise KeyError(resp["err"])
+            raise RuntimeError(
+                f"bus op {req.get('op')!r} failed remotely: {resp['err']}")
+        return resp["ok"]
+
+    def batch(self, ops: List[dict]) -> List[dict]:
+        """Execute several ops in order in ONE round trip; returns the
+        raw per-op ``{"ok": ...}`` / ``{"err", "kind"}`` dicts (each op
+        fails alone — callers unwrap with :meth:`unwrap_op`).  The
+        round-trip count is the transport's real cost on high-syscall-
+        latency hosts, so hot loops bundle their whole cycle here."""
+        if not ops:
+            return []
+        return self._request({"op": "batch", "ops": ops})
+
+    def unwrap_op(self, op: dict, resp: dict) -> object:
+        return self._unwrap(op, resp)
+
+    # -- MessageBus ---------------------------------------------------------
+
+    def publish(self, topic: str, value: dict) -> int:
+        if _TRACER.enabled:  # in-band trace context, like every backend
+            value = stamp_message(value)
+        offset = self._request(
+            {"op": "publish", "topic": topic, "value": value})
+        if self._publish_counters is not None:
+            counter = self._publish_counters.get(topic)
+            if counter is not None:
+                counter.inc()
+        return int(offset)
+
+    def publish_many(self, topic: str, values) -> List[int]:
+        values = list(values)
+        if not values:
+            return []
+        if _TRACER.enabled:
+            values = stamp_messages(values)
+        offsets = self._request(
+            {"op": "publish_many", "topic": topic, "values": values})
+        if self._publish_counters is not None and offsets:
+            counter = self._publish_counters.get(topic)
+            if counter is not None:
+                counter.inc(len(offsets))
+        return [int(o) for o in offsets]
+
+    def read(
+        self, topic: str, offset: int, max_records: Optional[int] = None
+    ) -> List[Record]:
+        rows = self._request({
+            "op": "read", "topic": topic, "offset": int(offset),
+            "max_records": max_records,
+        })
+        return [Record(topic, int(o), v) for o, v in rows]
+
+    def end_offset(self, topic: str) -> int:
+        return int(self._request({"op": "end_offset", "topic": topic}))
+
+    def base_offset(self, topic: str) -> int:
+        return int(self._request({"op": "base_offset", "topic": topic}))
+
+    def topics(self) -> Sequence[str]:
+        if self._topics is None:
+            self._topics = tuple(self._request({"op": "topics"}))
+        return self._topics
+
+    def consumer(self, topic: str, *, from_end: bool = False) -> Consumer:
+        c = Consumer(self, topic)
+        if from_end:
+            c.seek_to_end()
+        return c
+
+    def ping(self) -> bool:
+        return self._request({"op": "ping"}) == "pong"
+
+
+class BufferedPublisher:
+    """A publish-only bus front that coalesces into batch ops.
+
+    The fleet worker's gateway publishes one ``publish_many`` per flush
+    and its heartbeater one ``publish`` per beat; over a SocketBus each
+    would be its own round trip.  This buffer queues them (preserving
+    call order) and the worker's step flushes everything — plus its
+    inbox read — in one batched frame.  Same ``publish``/
+    ``publish_many``/``topics`` surface the gateway already speaks, so
+    it drops in unchanged.
+    """
+
+    def __init__(self, bus: SocketBus) -> None:
+        self._bus = bus
+        #: (topic, [values]) in call order — order across topics is
+        #: preserved (the migration protocol publishes results BEFORE
+        #: the exported state; the broker must apply them that way)
+        self._pending: List[Tuple[str, List[dict]]] = []
+
+    def topics(self) -> Sequence[str]:
+        return self._bus.topics()
+
+    def publish(self, topic: str, value: dict) -> None:
+        if _TRACER.enabled:
+            value = stamp_message(value)
+        self._pending.append((topic, [value]))
+
+    def publish_many(self, topic: str, values) -> None:
+        values = list(values)
+        if not values:
+            return
+        if _TRACER.enabled:
+            values = stamp_messages(values)
+        self._pending.append((topic, values))
+
+    @property
+    def pending(self) -> int:
+        return sum(len(v) for _, v in self._pending)
+
+    def take_ops(self) -> List[dict]:
+        """Drain the buffer into batch ops (coalescing consecutive
+        same-topic entries into one publish_many)."""
+        ops: List[dict] = []
+        for topic, values in self._pending:
+            if ops and ops[-1]["topic"] == topic:
+                ops[-1]["values"].extend(values)
+            else:
+                ops.append({"op": "publish_many", "topic": topic,
+                            "values": list(values)})
+        self._pending.clear()
+        return ops
+
+    def flush(self) -> None:
+        """Publish everything buffered in one round trip (shutdown and
+        migration-export paths call this directly)."""
+        ops = self.take_ops()
+        for op, resp in zip(ops, self._bus.batch(ops)):
+            self._bus.unwrap_op(op, resp)

@@ -1,0 +1,379 @@
+"""A fleet worker process: one FleetGateway/SessionPool behind an inbox.
+
+The worker is today's single-process fleet runtime embedded unchanged —
+the same :class:`~fmda_tpu.runtime.gateway.FleetGateway` admission/
+batching/publish path, the same :class:`~fmda_tpu.runtime.session_pool
+.SessionPool` carried state — driven by its **inbox topic** instead of
+direct calls.  Everything the router sends (opens, ticks, closes,
+migration drains) arrives on one FIFO topic and is applied in offset
+order, which is the whole ordering argument (see
+:mod:`fmda_tpu.fleet.router`); results flow back on the shared
+prediction topic exactly as in-process serving publishes them.
+
+This module is worker-role code: jax (via the runtime) is imported
+freely — it runs on hosts that own accelerators.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from fmda_tpu.config import (
+    FleetTopologyConfig,
+    RuntimeConfig,
+    TOPIC_FLEET_CONTROL,
+    fleet_worker_topic,
+)
+from fmda_tpu.fleet.membership import Heartbeater
+from fmda_tpu.fleet.state import (
+    decode_norm,
+    decode_row,
+    decode_session_state,
+    encode_session_state,
+)
+from fmda_tpu.runtime.batcher import BatcherConfig
+from fmda_tpu.runtime.gateway import FleetGateway
+from fmda_tpu.runtime.session_pool import PoolExhausted, SessionPool
+
+log = logging.getLogger("fmda_tpu.fleet")
+
+
+class FleetWorker:
+    """Owns one slot-range of the session space; serves its inbox."""
+
+    def __init__(
+        self,
+        worker_id: str,
+        bus,
+        model_cfg,
+        params,
+        *,
+        config: Optional[FleetTopologyConfig] = None,
+        runtime: Optional[RuntimeConfig] = None,
+        capacity: Optional[int] = None,
+        control_topic: str = TOPIC_FLEET_CONTROL,
+        clock: Callable[[], float] = time.monotonic,
+        precompile: bool = True,
+        gateway_kwargs: Optional[dict] = None,
+        data_bus=None,
+        data_address: Optional[str] = None,
+    ) -> None:
+        self.worker_id = worker_id
+        self.bus = bus
+        #: the worker's data plane: its inbox + its results.  Defaults
+        #: to the control bus (one shared broker).  The scaling shape is
+        #: a **worker-hosted** data bus (``data_bus`` = a local bus this
+        #: process serves to the router via BusServer, ``data_address``
+        #: announced in every heartbeat): the serving hot path then
+        #: never crosses a socket — only the router's pump does, once
+        #: per worker — so adding workers adds data-plane capacity
+        #: instead of contending for one broker.
+        self.data_bus = data_bus if data_bus is not None else bus
+        self._split = self.data_bus is not bus
+        self.cfg = config or FleetTopologyConfig()
+        rc = runtime or RuntimeConfig()
+        capacity = capacity if capacity is not None else rc.capacity
+        self.pool = SessionPool(
+            model_cfg, params, capacity=capacity, window=rc.window)
+        kwargs = dict(
+            batcher_config=BatcherConfig(
+                bucket_sizes=tuple(rc.bucket_sizes),
+                max_linger_s=rc.max_linger_ms / 1e3),
+            queue_bound=rc.queue_bound,
+            pipeline_depth=rc.pipeline_depth,
+        )
+        kwargs.update(gateway_kwargs or {})
+        # on a shared SocketBus, everything this worker publishes
+        # (results, heartbeats, migration state) buffers and rides the
+        # step's ONE batched frame together with the inbox read — round
+        # trips, not bytes, are the transport's cost (fmda_tpu.fleet
+        # .wire).  With a worker-hosted data bus, publishes are local
+        # and only the rare control messages cross the socket.
+        self._batch_bus = (
+            bus if not self._split and hasattr(bus, "batch") else None)
+        if self._batch_bus is not None:
+            from fmda_tpu.fleet.wire import BufferedPublisher
+
+            self._pub = BufferedPublisher(bus)
+        else:
+            self._pub = bus  # control messages go straight out
+        self.gateway = FleetGateway(
+            self.pool,
+            self.data_bus if self._split else self._pub,
+            **kwargs)
+        self.metrics = self.gateway.metrics
+        self._inbox = self.data_bus.consumer(fleet_worker_topic(worker_id))
+        announce = {"address": data_address} if data_address else None
+        self.heartbeater = Heartbeater(
+            self._pub, worker_id, control_topic=control_topic,
+            interval_s=self.cfg.heartbeat_interval_s,
+            capacity=capacity, clock=clock, announce=announce)
+        self.control_topic = control_topic
+        self.clock = clock
+        self.stopped = False
+        #: next inbox offset we expect (gap ⇒ records evicted unread)
+        self._next_offset: Optional[int] = None
+        if precompile:
+            # one padding-only flush per bucket: every program the tick
+            # path can need exists before the first real tick, so
+            # compile_count stays len(bucket_sizes) forever (the
+            # multihost bench gates on exactly this)
+            feats = model_cfg.n_features
+            for b in self.gateway.batcher.config.bucket_sizes:
+                self.pool.step(
+                    np.full(b, self.pool.padding_slot, np.int32),
+                    np.zeros((b, feats), np.float32))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Announce membership (the router rebalances on the hello)."""
+        self.heartbeater.hello(self.stats())
+        if self._batch_bus is not None:
+            self._pub.flush()  # the hello must not wait for a step
+
+    def stats(self) -> Dict[str, object]:
+        """The serving stats every heartbeat carries."""
+        c = self.metrics.counters
+        return {
+            "active_sessions": self.pool.n_active,
+            "ticks_served": c.get("ticks_served", 0),
+            "flushes": c.get("flushes", 0),
+            "shed_oldest": c.get("shed_oldest", 0),
+            # rides the beat so the router (and the bench's zero-loss
+            # gate) can see a worker-side inbox overrun — the counter
+            # lives in this process, not the router's
+            "inbox_records_lost": c.get("inbox_records_lost", 0),
+            "compile_count": self.pool.compile_count,
+            "queue_depth": len(self.gateway.batcher),
+        }
+
+    def step(self) -> int:
+        """One worker cycle: apply a bounded slice of the inbox, pump
+        the gateway, heartbeat if due.  Returns an activity count
+        (inbox records applied + results published) — zero means idle,
+        which the run loop's poll backoff keys on."""
+        # beat first: a long pump last cycle must not push two beats
+        # more than one step duration apart
+        self.heartbeater.beat(self.stats())
+        processed = 0
+        for rec in self._poll_inbox():
+            processed += 1
+            if self._next_offset is not None and rec.offset > self._next_offset:
+                # records fell off the inbox's retention before we read
+                # them (backlog outran the bus arena) — the contract is
+                # counted degradation, never a silent skip
+                lost = rec.offset - self._next_offset
+                self.metrics.count("inbox_records_lost", lost)
+                log.error(
+                    "worker %s: %d inbox records evicted unread "
+                    "(offsets %d..%d) — raise the bus arena or slow "
+                    "the producer", self.worker_id, lost,
+                    self._next_offset, rec.offset - 1)
+            self._next_offset = rec.offset + 1
+            self._apply(rec.value)
+            if self.stopped:
+                break
+        served = len(self.gateway.pump())
+        return processed + served
+
+    def _poll_inbox(self):
+        """Inbox records for this step.  Over a batched SocketBus, one
+        frame carries every buffered publish (last pump's results,
+        heartbeats, migration state — in publish order) AND the inbox
+        read; otherwise a plain consumer poll."""
+        if self._batch_bus is None:
+            return self._inbox.poll(
+                max_records=self.cfg.worker_poll_max_records)
+        bus = self._batch_bus
+        ops = self._pub.take_ops()
+        read_op = {
+            "op": "read",
+            "topic": self._inbox.topic,
+            "offset": self._inbox.offset,
+            "max_records": self.cfg.worker_poll_max_records,
+        }
+        ops.append(read_op)
+        resps = bus.batch(ops)
+        for op, resp in zip(ops[:-1], resps[:-1]):
+            if "err" in resp:
+                # a failed publish loses results — counted, never silent
+                self.metrics.count(
+                    "publish_errors", len(op.get("values", ())))
+                log.error("worker %s: batched publish to %r failed: %s",
+                          self.worker_id, op.get("topic"), resp["err"])
+        rows = bus.unwrap_op(read_op, resps[-1])
+        from fmda_tpu.stream.bus import Record
+
+        records = [Record(self._inbox.topic, int(o), v) for o, v in rows]
+        if records:
+            self._inbox.offset = records[-1].offset + 1
+        return records
+
+    def run(
+        self,
+        *,
+        poll_interval_s: float = 0.0005,
+        duration_s: Optional[float] = None,
+        should_stop: Optional[Callable[[], bool]] = None,
+        sleep_fn: Callable[[float], None] = time.sleep,
+    ) -> Dict[str, object]:
+        """Serve until a ``stop``/``drain_all`` arrives (or the optional
+        duration/should_stop safety valves fire); returns final stats."""
+        self.start()
+        deadline = (self.clock() + duration_s
+                    if duration_s is not None else None)
+        idle_sleep = poll_interval_s
+        while not self.stopped:
+            if should_stop is not None and should_stop():
+                self._shutdown()
+                break
+            if deadline is not None and self.clock() >= deadline:
+                log.warning(
+                    "worker %s exiting on duration safety valve",
+                    self.worker_id)
+                self._shutdown()
+                break
+            activity = self.step()
+            if activity:
+                idle_sleep = poll_interval_s
+            else:
+                # adaptive idle backoff: an idle worker polling flat-out
+                # is pure load on the broker (N workers × empty reads);
+                # back off to a few ms, snap back on the first record
+                idle_sleep = min(idle_sleep * 2, 0.005)
+                sleep_fn(idle_sleep)
+        return self.stats()
+
+    def _shutdown(self) -> None:
+        """Serve everything queued, say goodbye with final stats, stop.
+        The goodbye is best-effort: a router that sends ``stop`` and
+        tears its bus server down immediately (or died outright) must
+        not turn this worker's clean exit into a crash."""
+        self.gateway.drain()
+        try:
+            self.heartbeater.goodbye(self.stats())
+            if self._batch_bus is not None:
+                self._pub.flush()  # last results + goodbye actually leave
+        except (ConnectionError, OSError) as e:
+            self.metrics.count("goodbye_failed")
+            log.warning(
+                "worker %s: goodbye publish failed (%s) — router gone; "
+                "exiting anyway", self.worker_id, e)
+        self.stopped = True
+
+    # -- inbox handlers ------------------------------------------------------
+
+    def _apply(self, msg: dict) -> None:
+        kind = msg.get("kind")
+        if kind == "tick":
+            self._on_tick(msg)
+        elif kind == "open":
+            self._on_open(msg)
+        elif kind == "close":
+            self._on_close(msg)
+        elif kind == "drain_session":
+            self._on_drain_session(msg)
+        elif kind == "leave":
+            # operator-initiated graceful leave: tell the router, which
+            # migrates our sessions off and stops us when none remain
+            self._pub.publish(self.control_topic, {
+                "kind": "leaving", "worker": self.worker_id})
+            self.metrics.count("leave_requested")
+        elif kind in ("drain_all", "stop"):
+            self._shutdown()
+        else:
+            self.metrics.count("unknown_inbox_messages")
+            log.warning(
+                "worker %s: unknown inbox message kind %r",
+                self.worker_id, kind)
+
+    def _on_open(self, msg: dict) -> None:
+        sid = msg["session"]
+        if self.pool.handle_for(sid) is not None:
+            # a duplicate open is a protocol violation upstream; recover
+            # by replacing (the router's registry is authoritative)
+            self.metrics.count("duplicate_opens")
+            log.warning(
+                "worker %s: duplicate open for %s — replacing",
+                self.worker_id, sid)
+            self.gateway.close_session(sid)
+        try:
+            if msg.get("state") is not None:
+                self.gateway.import_session(
+                    sid, decode_session_state(msg["state"]))
+                self.metrics.count("sessions_migrated_in")
+            else:
+                self.gateway.open_session(
+                    sid, decode_norm(msg.get("norm")),
+                    seq=int(msg.get("seq", 0)))
+        except PoolExhausted:
+            # counted at the gateway too (rejected_sessions); tell the
+            # router so the failure is visible fleet-wide
+            self._pub.publish(self.control_topic, {
+                "kind": "open_failed",
+                "worker": self.worker_id,
+                "session": sid,
+                "error": f"pool exhausted ({self.pool.capacity} slots)",
+            })
+
+    def _on_tick(self, msg: dict) -> None:
+        sid = msg["session"]
+        if self.pool.handle_for(sid) is None:
+            # close/tick race or an open that failed: visible skip
+            self.metrics.count("ticks_for_unknown_session")
+            return
+        row = decode_row(msg["row"], self.pool.cfg.n_features)
+        if self.gateway.saturated:
+            # well-behaved consumer: serve the backlog instead of
+            # racing the gateway's shedder (no tick is ever dropped on
+            # the floor by the worker itself)
+            self.gateway.pump(force=True)
+            self.metrics.count("forced_pumps")
+        seq = self.gateway.submit(sid, row, wire=msg.get("trace"))
+        if seq != msg.get("seq", seq):
+            # the router's and gateway's per-session counters are in
+            # lockstep by construction — divergence means a protocol
+            # bug, worth a loud counter while results still flow
+            self.metrics.count("seq_mismatch")
+
+    def _on_close(self, msg: dict) -> None:
+        sid = msg["session"]
+        if self.pool.handle_for(sid) is None:
+            self.metrics.count("close_for_unknown_session")
+            return
+        self.gateway.close_session(sid)
+
+    def _on_drain_session(self, msg: dict) -> None:
+        """Migration source side: serve everything queued, export the
+        session bit-exact, hand the state to the router via the control
+        topic, release the slot."""
+        sid = msg["session"]
+        if self.pool.handle_for(sid) is None:
+            self.metrics.count("drain_for_unknown_session")
+            log.warning(
+                "worker %s: drain_session for unknown %s",
+                self.worker_id, sid)
+            return
+        # drain the WHOLE gateway: the batcher may hold this session's
+        # ticks behind other sessions', and a flush is all-or-nothing —
+        # serving everything queued guarantees the exported state is
+        # current and every pre-drain result is published
+        self.gateway.drain()
+        state = encode_session_state(self.gateway.export_session(sid))
+        # buffered AFTER the drained results, so the broker lands every
+        # pre-drain result before the state (the router's ordering
+        # argument leans on exactly this)
+        self._pub.publish(self.control_topic, {
+            "kind": "session_state",
+            "worker": self.worker_id,
+            "session": sid,
+            "mig": msg.get("mig"),
+            "state": state,
+        })
+        self.gateway.close_session(sid)
+        self.metrics.count("sessions_migrated_out")

@@ -131,6 +131,7 @@ class Observability:
         *,
         registry: Optional[MetricsRegistry] = None,
         clock=time.monotonic,
+        process: Optional[str] = None,
     ) -> None:
         # deferred import: config imports nothing from obs, but keep the
         # dependency one-way regardless
@@ -142,6 +143,10 @@ class Observability:
             registry if registry is not None
             else MetricsRegistry(enabled=enabled)
         )
+        if process is not None:
+            # fleet worker processes label every exported series with
+            # their worker id, so a multi-process scrape never collides
+            self.registry.set_process(process)
         if enabled:
             # module-level instrumentation (ingest transports, trainer)
             # reports to the process-default registry; fold it in so one

@@ -278,6 +278,15 @@ class MetricsRegistry:
         self._histograms: Dict[Tuple[str, _LabelKey], LatencyHistogram] = {}
         self._collectors: List[Tuple[str, Callable[[], Snapshot]]] = []
         self._included: List["MetricsRegistry"] = []
+        self._process: Optional[str] = None
+
+    def set_process(self, name: Optional[str]) -> None:
+        """Stamp every exported sample with a ``process`` label (worker
+        id, role) — a multi-process fleet scraped into one Prometheus
+        must not collide series names across its workers.  Applied at
+        snapshot time over instruments, collectors, AND included
+        registries, so the whole process's export is labelled."""
+        self._process = name
 
     # -- instruments ---------------------------------------------------------
 
@@ -371,6 +380,14 @@ class MetricsRegistry:
             part = reg.snapshot()
             for kind in out:
                 out[kind].extend(part.get(kind, ()))
+        if self._process is not None:
+            # rebind, never mutate: instrument samples share the
+            # instrument's own labels dict
+            for kind in out:
+                for s in out[kind]:
+                    labels = s.get("labels") or {}
+                    if "process" not in labels:
+                        s["labels"] = {**labels, "process": self._process}
         return out
 
 

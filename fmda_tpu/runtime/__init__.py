@@ -10,41 +10,48 @@ shedding serves results back per-session over the framework's MessageBus
 (:mod:`~fmda_tpu.runtime.gateway`).  ``python -m fmda_tpu serve-fleet``
 runs the whole stack against a synthetic multi-ticker load
 (:mod:`~fmda_tpu.runtime.loadgen`).  Architecture: docs/runtime.md.
+
+Exports resolve lazily (PEP 562): the session pool pulls in jax at
+import, and the multi-host router (:mod:`fmda_tpu.fleet`) must be able
+to import the jax-free submodules (``runtime.metrics``) on a bus-only
+host without dragging the whole accelerator stack in.
 """
 
-from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
-from fmda_tpu.runtime.gateway import FleetGateway, FleetResult
-from fmda_tpu.runtime.loadgen import (
-    FleetLoadConfig,
-    PredictorLoadConfig,
-    run_fleet_load,
-    run_predictor_load,
-)
-from fmda_tpu.runtime.metrics import LatencyHistogram, RuntimeMetrics
-from fmda_tpu.runtime.predictor_pool import PredictorGateway, PredictorPool
-from fmda_tpu.runtime.session_pool import (
-    PoolExhausted,
-    SessionHandle,
-    SessionPool,
-    StaleSessionError,
-)
+#: public name -> defining submodule; resolved on first attribute access
+_EXPORTS = {
+    "BatcherConfig": "fmda_tpu.runtime.batcher",
+    "MicroBatcher": "fmda_tpu.runtime.batcher",
+    "Tick": "fmda_tpu.runtime.batcher",
+    "FleetGateway": "fmda_tpu.runtime.gateway",
+    "FleetResult": "fmda_tpu.runtime.gateway",
+    "FleetLoadConfig": "fmda_tpu.runtime.loadgen",
+    "PredictorLoadConfig": "fmda_tpu.runtime.loadgen",
+    "run_fleet_load": "fmda_tpu.runtime.loadgen",
+    "run_predictor_load": "fmda_tpu.runtime.loadgen",
+    "LatencyHistogram": "fmda_tpu.runtime.metrics",
+    "RuntimeMetrics": "fmda_tpu.runtime.metrics",
+    "PredictorGateway": "fmda_tpu.runtime.predictor_pool",
+    "PredictorPool": "fmda_tpu.runtime.predictor_pool",
+    "PoolExhausted": "fmda_tpu.runtime.session_pool",
+    "SessionHandle": "fmda_tpu.runtime.session_pool",
+    "SessionPool": "fmda_tpu.runtime.session_pool",
+    "StaleSessionError": "fmda_tpu.runtime.session_pool",
+}
 
-__all__ = [
-    "BatcherConfig",
-    "MicroBatcher",
-    "Tick",
-    "FleetGateway",
-    "FleetResult",
-    "FleetLoadConfig",
-    "PredictorLoadConfig",
-    "run_fleet_load",
-    "run_predictor_load",
-    "LatencyHistogram",
-    "RuntimeMetrics",
-    "PredictorGateway",
-    "PredictorPool",
-    "PoolExhausted",
-    "SessionHandle",
-    "SessionPool",
-    "StaleSessionError",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module 'fmda_tpu.runtime' has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

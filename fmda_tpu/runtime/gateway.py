@@ -64,7 +64,7 @@ from fmda_tpu.config import (
     TOPIC_FLEET_PREDICTION,
 )
 from fmda_tpu.data.normalize import NormParams
-from fmda_tpu.obs.trace import default_tracer, now_ns
+from fmda_tpu.obs.trace import TraceRef, default_tracer, now_ns, parse_wire
 from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
 from fmda_tpu.runtime.metrics import RuntimeMetrics
 from fmda_tpu.runtime.session_pool import (
@@ -175,11 +175,16 @@ class FleetGateway:
     # -- admission ----------------------------------------------------------
 
     def open_session(
-        self, session_id: str, norm: Optional[NormParams] = None
+        self, session_id: str, norm: Optional[NormParams] = None,
+        *, seq: int = 0,
     ) -> SessionHandle:
         """Admit a session (raises :class:`PoolExhausted` when the fleet
         is full — counted, so rejected admissions show up on dashboards,
-        and the caller decides whether to retry, evict, or scale)."""
+        and the caller decides whether to retry, evict, or scale).
+
+        ``seq`` starts the session's result sequence above 0 — the
+        multi-host router reopens a lost-state session mid-stream and
+        must not emit colliding (session, seq) pairs."""
         try:
             handle = self.pool.alloc(session_id, norm)
         except PoolExhausted:
@@ -187,6 +192,8 @@ class FleetGateway:
             # ValueError is a client bug, not a fleet-is-full signal
             self.metrics.count("rejected_sessions")
             raise
+        if seq:
+            self._seq[session_id] = int(seq)
         self._sessions_changed()
         return handle
 
@@ -205,13 +212,53 @@ class FleetGateway:
         # linger on every steady-state flush
         self.batcher.full_target = self.pool.n_active
 
+    # -- session migration (fmda_tpu.fleet; docs/multihost.md) --------------
+
+    def export_session(self, session_id: str) -> dict:
+        """Snapshot a session for migration: its pooled carried state
+        (:meth:`SessionPool.export_slot`) plus the gateway's per-session
+        sequence counter, so the new owner's results continue the same
+        ``seq`` stream with no gap or collision.  Caller contract: the
+        session's queued ticks are already flushed (``drain``) — ticks
+        still queued here would be lost to the snapshot."""
+        handle = self.pool.handle_for(session_id)
+        if handle is None:
+            raise KeyError(f"no open session {session_id!r}")
+        state = self.pool.export_slot(handle)
+        state["seq"] = self._seq.get(session_id, 0)
+        return state
+
+    def import_session(self, session_id: str, state: dict) -> SessionHandle:
+        """Open a session from an :meth:`export_session` snapshot (the
+        receiving end of a migration): allocates a slot, loads the
+        carried state bit-exact, and resumes the sequence counter."""
+        handle = self.open_session(session_id)
+        try:
+            self.pool.import_slot(handle, state)
+        except Exception:
+            # a malformed snapshot must not leak the slot it claimed
+            self.pool.free(handle)
+            self._sessions_changed()
+            raise
+        self._seq[session_id] = int(state.get("seq", 0))
+        return handle
+
     # -- the request path ---------------------------------------------------
 
-    def submit(self, session_id: str, row: np.ndarray) -> int:
+    def submit(
+        self, session_id: str, row: np.ndarray,
+        wire: Optional[str] = None,
+    ) -> int:
         """Enqueue a session's newest feature row; returns the tick's
         per-session sequence number.  Overload sheds the oldest queued
         tick (counted + heartbeat-logged), never blocks, never grows the
-        queue past ``queue_bound``."""
+        queue past ``queue_bound``.
+
+        ``wire`` is in-band trace context the tick arrived with (a
+        multi-host router's ``trace`` field — fmda_tpu.fleet): the
+        flush spans then stitch under a ``serve`` span on *that* trace
+        instead of opening a fresh root, so a cross-process journey
+        groups as one trace after ``trace --merge``."""
         handle = self.pool.handle_for(session_id)
         if handle is None:
             raise KeyError(f"no open session {session_id!r}")
@@ -233,12 +280,21 @@ class FleetGateway:
                     self.queue_bound, shed.handle.session_id, shed.seq, n)
         seq = self._seq.get(session_id, 0)
         self._seq[session_id] = seq + 1
-        # one branch when tracing is off; when sampled, the returned ref
-        # is this tick's trace root, closed at publish in _complete
-        ref = self._tracer.maybe_trace()
+        ref = None
+        if wire is None:
+            # one branch when tracing is off; when sampled, the returned
+            # ref is this tick's trace root, closed at publish in
+            # _complete
+            ref = self._tracer.maybe_trace()
+        elif self._tracer.enabled:
+            ctx = parse_wire(wire)
+            if ctx is not None:
+                # ride the router's journey: flush spans parent on the
+                # publisher's span, t0 stamps the serve stage start
+                ref = TraceRef(ctx[0], ctx[1], now_ns())
         self.batcher.add(Tick(
             handle=handle, row=row, t_enqueue=self.clock(), seq=seq,
-            trace=ref))
+            trace=ref, wire=wire))
         self.metrics.gauge("queue_depth", len(self.batcher))
         return seq
 
@@ -437,10 +493,16 @@ class FleetGateway:
                         "pred_labels": list(labels),
                         "prob_threshold": self.threshold,
                     }
-                    if tick.trace is not None:
-                        # the tick's own context in-band, so downstream
-                        # consumers stitch into the same trace
-                        msg["trace"] = tick.trace.wire
+                    # the tick's context in-band, so downstream
+                    # consumers stitch into the same trace; an incoming
+                    # wire (multi-host router) is forwarded even when
+                    # this process's tracer is off — the router still
+                    # closes its root off the result
+                    wire = tick.wire if tick.wire is not None else (
+                        tick.trace.wire if tick.trace is not None
+                        else None)
+                    if wire is not None:
+                        msg["trace"] = wire
                     messages.append(msg)
             if messages:
                 # one batched publish per flush: one lock acquisition /
@@ -484,7 +546,16 @@ class FleetGateway:
             ref = tick.trace
             if ref is None:
                 continue
-            tid, root = ref.trace_id, ref.span_id
+            tid = ref.trace_id
+            if tick.wire is not None:
+                # the tick arrived with a router's context: group this
+                # process's stage spans under one "serve" span on the
+                # ROUTER's trace (no second root, no double e2e count —
+                # the router's finish_root owns the journey)
+                root = tr.add_span(tid, ref.span_id, "serve", "serve",
+                                   ref.t0_ns, t_publish_ns)
+            else:
+                root = ref.span_id
             tr.add_span(tid, root, "queued", "gateway",
                         ref.t0_ns, inflight.t_dispatch_ns)
             tr.add_span(tid, root, "dispatch", "gateway",
@@ -496,4 +567,5 @@ class FleetGateway:
             if t_pub0_ns:
                 tr.add_span(tid, pub, "bus_publish", "bus",
                             t_pub0_ns, t_publish_ns)
-            tr.finish_root(ref, "tick", "ingest", t_publish_ns)
+            if tick.wire is None:
+                tr.finish_root(ref, "tick", "ingest", t_publish_ns)

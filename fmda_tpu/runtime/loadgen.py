@@ -31,14 +31,29 @@ class FleetLoadConfig:
     #: lower values exercise ragged arrival + padded buckets).
     duty: float = 1.0
     seed: int = 0
+    #: Adversarial reconnect storm: every ``storm_every`` rounds, a
+    #: burst of sessions closes and immediately reopens (the traffic
+    #: shape a fleet membership change produces — clients stampeding
+    #: back).  0 disables.  Reopened sessions restart their stream:
+    #: fresh carried state, seq back to 0.
+    storm_every: int = 0
+    #: Fraction of sessions hit per storm burst.
+    storm_fraction: float = 0.25
 
 
 def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
     """Run the synthetic fleet to completion; returns a result dict with
-    throughput, per-stage latency summaries, and the loss counters."""
+    throughput, per-stage latency summaries, and the loss counters.
+
+    ``gateway`` is anything speaking the gateway serving API —
+    :class:`~fmda_tpu.runtime.gateway.FleetGateway` in-process, or a
+    :class:`~fmda_tpu.fleet.router.FleetRouter` fronting a multi-host
+    topology (same open/submit/pump/drain surface; results then arrive
+    asynchronously and ``drain`` blocks until the fleet answers).
+    """
     load = load or FleetLoadConfig()
-    pool = gateway.pool
-    feats = pool.cfg.n_features
+    pool = getattr(gateway, "pool", None)
+    feats = pool.cfg.n_features if pool is not None else gateway.n_features
     rng = np.random.default_rng(load.seed)
 
     session_ids = [f"T{i:04d}" for i in range(load.n_sessions)]
@@ -55,18 +70,36 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
     walk = rng.normal(size=(load.n_sessions, feats)).astype(np.float32)
     submitted = 0
     served = 0
+    reopened = 0
     t0 = time.perf_counter()
-    for _ in range(load.n_ticks):
+    for r in range(load.n_ticks):
+        if load.storm_every and r and r % load.storm_every == 0:
+            # reconnect storm: close + instantly reopen a burst of
+            # sessions (keeps their norm stats — same client, new
+            # connection), the shape that drives the migration/reopen
+            # machinery hardest
+            n_hit = max(1, int(load.n_sessions * load.storm_fraction))
+            for i in rng.choice(load.n_sessions, size=n_hit,
+                                replace=False):
+                sid = session_ids[i]
+                gateway.close_session(sid)
+                gateway.open_session(sid, NormParams(mins[i], maxs[i]))
+                reopened += 1
         ticking = rng.random(load.n_sessions) < load.duty
         steps = rng.normal(
             scale=0.1, size=(load.n_sessions, feats)).astype(np.float32)
         walk[ticking] += steps[ticking]
         for i in np.flatnonzero(ticking):
-            if gateway.saturated:
+            while gateway.saturated:
                 # well-behaved producer: drain instead of racing the
                 # shedder (fleets larger than queue_bound would otherwise
-                # lose ticks before pump() ever ran)
-                served += len(gateway.pump(force=True))
+                # lose ticks before pump() ever ran).  A multi-host
+                # router stays saturated until its workers catch up —
+                # yield the GIL so the bus-server threads can serve them
+                drained = gateway.pump(force=True)
+                served += len(drained)
+                if not drained and gateway.saturated:
+                    time.sleep(0.002)
             gateway.submit(session_ids[i], walk[i])
             submitted += 1
         served += len(gateway.pump())
@@ -74,16 +107,19 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
     wall_s = time.perf_counter() - t0
 
     summary = gateway.metrics.summary()
-    return {
+    out = {
         "sessions": load.n_sessions,
         "rounds": load.n_ticks,
         "ticks_submitted": submitted,
         "ticks_served": served,
         "wall_s": round(wall_s, 3),
         "ticks_per_s": round(served / wall_s, 1) if wall_s > 0 else None,
-        "compile_count": pool.compile_count,
+        "compile_count": pool.compile_count if pool is not None else None,
         **summary,
     }
+    if load.storm_every:
+        out["sessions_reopened"] = reopened
+    return out
 
 
 @dataclass(frozen=True)

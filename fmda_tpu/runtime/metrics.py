@@ -34,6 +34,9 @@ STAGES: Tuple[str, ...] = (
                             # predictor gateway's id lookup + fetch;
                             # unused — and therefore unreported — by the
                             # carried-state fleet gateway)
+    "route",                # multi-host router: submit -> tick batch
+                            # published on the owner's inbox topic
+                            # (fmda_tpu.fleet; unused in-process)
     "dispatch",             # stale filter + staging assembly + async
                             # enqueue of the batched jit step
     "device",               # host transfer block in _complete; under the

@@ -304,6 +304,53 @@ class SessionPool:
         self._ring = place(self._ring.at[slot].set(0.0))
         self._pos = place(self._pos.at[slot].set(0))
 
+    def export_slot(self, handle: SessionHandle) -> dict:
+        """Snapshot one session's carried state as host numpy arrays —
+        the migration payload (fmda_tpu.fleet): carry per layer, ring,
+        tick position, and the per-slot normalization stats.  Raw-dtype
+        copies, so an :meth:`import_slot` on another pool (same model
+        config) reproduces the slot bit for bit."""
+        self.check(handle)
+        s = handle.slot
+        return {
+            "carry": [
+                [np.asarray(c[s]) for c in layer] for layer in self._carry
+            ],
+            "ring": np.asarray(self._ring[s]),
+            "pos": int(self._pos[s]),
+            "x_min": np.asarray(self._x_min[s]),
+            "x_range": np.asarray(self._x_range[s]),
+        }
+
+    def import_slot(self, handle: SessionHandle, state: dict) -> None:
+        """Load an :meth:`export_slot` snapshot into this slot (the
+        receiving end of a migration).  Functional ``.at[slot].set``
+        writes of same-dtype arrays — bit-exact, same cost class as
+        ``alloc``/``reset`` (host-side, off the hot path)."""
+        self.check(handle)
+        s = handle.slot
+        if len(state["carry"]) != self.cfg.n_layers:
+            raise ValueError(
+                f"state has {len(state['carry'])} carry layers, pool "
+                f"expects {self.cfg.n_layers} (model config mismatch?)")
+        place = self._place_state
+        self._carry = tuple(
+            tuple(
+                place(c.at[s].set(jnp.asarray(arr, c.dtype)))
+                for c, arr in zip(layer, state_layer)
+            )
+            for layer, state_layer in zip(self._carry, state["carry"])
+        )
+        self._ring = place(
+            self._ring.at[s].set(jnp.asarray(state["ring"],
+                                             self._ring.dtype)))
+        self._pos = place(self._pos.at[s].set(int(state["pos"])))
+        self._x_min = place(
+            self._x_min.at[s].set(jnp.asarray(state["x_min"], jnp.float32)))
+        self._x_range = place(
+            self._x_range.at[s].set(
+                jnp.asarray(state["x_range"], jnp.float32)))
+
     def is_live(self, handle: SessionHandle) -> bool:
         return (
             0 <= handle.slot < self.capacity

@@ -1,12 +1,34 @@
-from fmda_tpu.stream.bus import Consumer, InProcessBus, MessageBus, Record
-from fmda_tpu.stream.warehouse import Warehouse
-from fmda_tpu.stream.engine import StreamEngine
+"""fmda_tpu.stream — message bus, streaming engine, warehouse.
 
-__all__ = [
-    "Record",
-    "Consumer",
-    "MessageBus",
-    "InProcessBus",
-    "Warehouse",
-    "StreamEngine",
-]
+Exports resolve lazily (PEP 562): the warehouse/engine pull the jax
+feature kernels at import, while the multi-host fleet's router-role code
+(fmda_tpu.fleet) needs only the bus contract from this package and must
+import on a bus-only host without the accelerator stack.
+"""
+
+_EXPORTS = {
+    "Record": "fmda_tpu.stream.bus",
+    "Consumer": "fmda_tpu.stream.bus",
+    "MessageBus": "fmda_tpu.stream.bus",
+    "InProcessBus": "fmda_tpu.stream.bus",
+    "Warehouse": "fmda_tpu.stream.warehouse",
+    "StreamEngine": "fmda_tpu.stream.engine",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module 'fmda_tpu.stream' has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module_name), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -68,6 +68,7 @@ class TestPhaseRegistry:
             "replay",
             "runtime_fleet_smoke",
             "predictor_fleet_smoke",
+            "runtime_multihost_smoke",
             "obs_overhead",
             "trace_overhead",
         }

@@ -1,0 +1,620 @@
+"""fmda_tpu.fleet — the multi-host distributed serving tier (ISSUE 6).
+
+Covers the acceptance surface in-process (router + workers sharing one
+InProcessBus, driven deterministically with a fake clock): ownership
+hashing, heartbeat membership, and the migration protocol's headline
+contract — a session drained from one worker and resumed on another
+produces the bit-identical output sequence an unmigrated single-process
+gateway produces over the same ticks, with no drop, duplicate, or
+reorder.  The cross-process topology itself is exercised by
+``test_multihost_topology`` (spawned workers, worker-hosted data
+buses) and the ``runtime_multihost_smoke`` bench phase.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    FleetTopologyConfig,
+    ModelConfig,
+    RuntimeConfig,
+    fleet_topics,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.fleet.hashring import OwnershipTable, hash_session
+from fmda_tpu.fleet.membership import Heartbeater, MembershipView
+from fmda_tpu.fleet.router import FleetRouter, NoLiveWorkers
+from fmda_tpu.fleet.state import (
+    decode_array,
+    decode_row,
+    decode_session_state,
+    encode_array,
+    encode_row,
+    encode_session_state,
+)
+from fmda_tpu.fleet.worker import FleetWorker
+from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+from fmda_tpu.stream.bus import InProcessBus
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _setup(feats=6, hidden=5, window=4, seed=0):
+    cfg = ModelConfig(hidden_size=hidden, n_features=feats, output_size=4,
+                      dropout=0.0, bidirectional=False, use_pallas=False)
+    from fmda_tpu.models import build_model
+
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(seed)},
+        jnp.zeros((1, window, feats)))["params"]
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# ownership hashing
+# ---------------------------------------------------------------------------
+
+
+def test_hash_session_is_stable_and_bounded():
+    assert hash_session("SPY") == hash_session("SPY")
+    assert 0 <= hash_session("SPY", 1024) < 1024
+    # crc32-based: stable across processes (unlike salted hash())
+    assert hash_session("SPY", 1 << 16) == (
+        __import__("zlib").crc32(b"SPY") % (1 << 16))
+
+
+def test_ownership_table_contiguous_cover_and_determinism():
+    table = OwnershipTable.derive(3, ["w2", "w0", "w1"], space=1000)
+    assert table.version == 3
+    assert table.workers == ("w0", "w1", "w2")  # sorted: pure function
+    # contiguous, disjoint, covering exactly [0, space)
+    lo = 0
+    for _w, r_lo, r_hi in table.ranges:
+        assert r_lo == lo
+        lo = r_hi
+    assert lo == 1000
+    # remainder spread one point at a time
+    sizes = [hi - lo for _w, lo, hi in table.ranges]
+    assert sum(sizes) == 1000 and max(sizes) - min(sizes) <= 1
+    # every point owned; same derivation from any observer
+    assert table.owner_of_point(0) == "w0"
+    assert table.owner_of_point(999) == "w2"
+    again = OwnershipTable.derive(3, ["w0", "w1", "w2"], space=1000)
+    assert again == table
+    assert OwnershipTable.from_wire(table.to_wire()) == table
+
+
+def test_ownership_empty_fleet():
+    table = OwnershipTable.derive(1, [], space=100)
+    assert table.owner_of("anything") is None
+
+
+# ---------------------------------------------------------------------------
+# membership
+# ---------------------------------------------------------------------------
+
+
+def test_membership_join_heartbeat_reap_goodbye():
+    clock = FakeClock()
+    view = MembershipView(timeout_s=2.0, clock=clock)
+    assert view.observe({"kind": "hello", "worker": "w0",
+                         "capacity": 8}) == "join"
+    assert view.observe({"kind": "heartbeat", "worker": "w0",
+                         "stats": {"ticks_served": 5}}) is None
+    assert view.workers["w0"].stats == {"ticks_served": 5}
+    clock.advance(1.0)
+    assert view.reap() == []
+    clock.advance(2.5)
+    assert view.reap() == ["w0"]
+    assert view.live() == []
+    assert "w0" in view.departed  # final stats stay inspectable
+    # a heartbeat from a reaped worker re-joins it
+    assert view.observe({"kind": "heartbeat", "worker": "w1"}) == "join"
+    assert view.observe({"kind": "goodbye", "worker": "w1"}) == "leave"
+    assert view.live() == []
+
+
+def test_membership_leaving_excluded_from_live_but_present():
+    clock = FakeClock()
+    view = MembershipView(timeout_s=5.0, clock=clock)
+    view.observe({"kind": "hello", "worker": "w0"})
+    view.observe({"kind": "hello", "worker": "w1"})
+    assert view.mark_leaving("w0")
+    assert view.live() == ["w1"]
+    assert "w0" in view.workers  # still present: drains its sessions
+    # goodbye of an already-leaving worker is not a second leave event
+    assert view.observe({"kind": "goodbye", "worker": "w0"}) is None
+
+
+def test_hello_cancelling_leave_rebalances_like_a_join():
+    clock = FakeClock()
+    view = MembershipView(timeout_s=5.0, clock=clock)
+    view.observe({"kind": "hello", "worker": "w0"})
+    view.observe({"kind": "hello", "worker": "w1"})
+    assert view.mark_leaving("w0")
+    assert view.live() == ["w1"]
+    # the re-hello re-enters live() — the router must see a join event
+    # (rebalance), or w0 stays live but owns no hash range forever
+    assert view.observe({"kind": "hello", "worker": "w0"}) == "join"
+    assert view.live() == ["w0", "w1"]
+    # a heartbeat does NOT cancel a pending leave
+    assert view.mark_leaving("w0")
+    assert view.observe({"kind": "heartbeat", "worker": "w0"}) is None
+    assert view.live() == ["w1"]
+
+
+def test_heartbeater_cadence_and_announce():
+    clock = FakeClock()
+    bus = InProcessBus(("fleet_control",))
+    hb = Heartbeater(bus, "w7", control_topic="fleet_control",
+                     interval_s=1.0, capacity=4, clock=clock,
+                     announce={"address": "127.0.0.1:1234"})
+    hb.hello({"ticks_served": 0})
+    assert not hb.beat()          # not due yet
+    clock.advance(1.5)
+    assert hb.beat({"ticks_served": 3})
+    hb.goodbye()
+    msgs = [r.value for r in bus.read("fleet_control", 0)]
+    assert [m["kind"] for m in msgs] == ["hello", "heartbeat", "goodbye"]
+    assert all(m["worker"] == "w7" for m in msgs)
+    # the data-plane address rides EVERY message (re-join after a reap
+    # must re-link)
+    assert all(m["address"] == "127.0.0.1:1234" for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# state codec
+# ---------------------------------------------------------------------------
+
+
+def test_array_and_row_codec_bit_exact():
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(3, 5)).astype(np.float32)
+    b = decode_array(encode_array(a))
+    assert b.dtype == a.dtype and np.array_equal(a, b)
+    row = rng.normal(size=108).astype(np.float32)
+    assert np.array_equal(decode_row(encode_row(row), 108), row)
+    with pytest.raises(ValueError, match="shape"):
+        decode_row(encode_row(row), 64)
+
+
+def test_session_state_round_trips_through_gateway_bit_exact():
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=4, window=4)
+    gw = FleetGateway(
+        pool, None,
+        batcher_config=BatcherConfig(bucket_sizes=(2,), max_linger_s=0.0),
+        pipeline_depth=0)
+    rng = np.random.default_rng(1)
+    norm = NormParams(rng.normal(size=6).astype(np.float32),
+                      rng.normal(size=6).astype(np.float32) + 3.0)
+    gw.open_session("S", norm)
+    for _ in range(5):
+        gw.submit("S", rng.normal(size=6).astype(np.float32))
+        gw.drain()
+    state = gw.export_session("S")
+    wire = encode_session_state(state)
+    # survives the bus's own JSON round trip
+    import json
+
+    restored = decode_session_state(json.loads(json.dumps(wire)))
+    assert restored["seq"] == state["seq"] == 5
+    assert restored["pos"] == state["pos"]
+    np.testing.assert_array_equal(restored["ring"], state["ring"])
+    for layer_a, layer_b in zip(restored["carry"], state["carry"]):
+        for a, b in zip(layer_a, layer_b):
+            np.testing.assert_array_equal(a, b)
+
+    # import into a DIFFERENT pool: continues the same stream bit-exact
+    pool2 = SessionPool(cfg, params, capacity=4, window=4)
+    gw2 = FleetGateway(
+        pool2, None,
+        batcher_config=BatcherConfig(bucket_sizes=(2,), max_linger_s=0.0),
+        pipeline_depth=0)
+    gw2.import_session("S", restored)
+    row = rng.normal(size=6).astype(np.float32)
+    gw.submit("S", row)
+    gw2.submit("S", row)
+    r1 = gw.drain()[0]
+    r2 = gw2.drain()[0]
+    assert r1.seq == r2.seq == 5
+    np.testing.assert_array_equal(r1.probabilities, r2.probabilities)
+
+
+# ---------------------------------------------------------------------------
+# in-process topology helpers
+# ---------------------------------------------------------------------------
+
+
+def _topology(worker_ids, *, feats=6, window=4, capacity=8,
+              bucket_sizes=(1,), start=True, all_ids=None):
+    cfg, params = _setup(feats=feats, window=window)
+    clock = FakeClock()
+    bus = InProcessBus(
+        tuple(DEFAULT_TOPICS) + fleet_topics(all_ids or worker_ids))
+    fleet_cfg = FleetTopologyConfig(
+        heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0)
+    rc = RuntimeConfig(capacity=capacity, window=window,
+                       bucket_sizes=bucket_sizes, max_linger_ms=0.0,
+                       pipeline_depth=0)
+    workers = {
+        w: FleetWorker(w, bus, cfg, params, config=fleet_cfg, runtime=rc,
+                       clock=clock, precompile=False)
+        for w in worker_ids
+    }
+    router = FleetRouter(bus, fleet_cfg, n_features=feats, clock=clock)
+    if start:
+        for w in workers.values():
+            w.start()
+        router.pump()
+    return router, workers, bus, clock, (cfg, params, rc)
+
+
+def _cycle(router, workers, results_by_session):
+    router.pump()
+    for w in workers:
+        if not w.stopped:
+            w.step()
+    for res in router.pump():
+        results_by_session.setdefault(res.session_id, []).append(res)
+
+
+# ---------------------------------------------------------------------------
+# routing basics
+# ---------------------------------------------------------------------------
+
+
+def test_router_routes_by_ownership_and_preserves_per_session_order():
+    router, workers, _bus, _clock, _ = _topology(
+        ["w0", "w1"], bucket_sizes=(1, 4))
+    assert router.membership.live() == ["w0", "w1"]
+    rng = np.random.default_rng(0)
+    sids = [f"T{i}" for i in range(6)]
+    for sid in sids:
+        mn = rng.normal(size=6).astype(np.float32)
+        router.open_session(sid, NormParams(mn, mn + 1.0))
+    got = {}
+    for _ in range(8):
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    for _ in range(4):
+        _cycle(router, workers.values(), got)
+    for sid in sids:
+        seqs = [r.seq for r in got[sid]]
+        assert seqs == list(range(8)), (sid, seqs)
+    # both workers actually own sessions (6 sessions, 2 ranges)
+    owners = {router.table.owner_of(sid) for sid in sids}
+    assert owners == {"w0", "w1"}
+    # ticks landed on the owner's inbox, not broadcast
+    assert workers["w0"].pool.n_active + workers["w1"].pool.n_active == 6
+
+
+def test_open_session_without_workers_rejects_loudly():
+    router, _workers, _bus, _clock, _ = _topology([], start=False)
+    with pytest.raises(NoLiveWorkers):
+        router.open_session("S")
+    assert router.metrics.counters["rejected_sessions"] == 1
+
+
+def test_router_backpressure_saturates_on_inflight_bound():
+    router, workers, _bus, _clock, _ = _topology(["w0"])
+    router.cfg = FleetTopologyConfig(
+        heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0,
+        max_inflight_ticks=10)
+    router.open_session("S")
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        router.submit("S", rng.normal(size=6).astype(np.float32))
+    assert router.saturated
+    got = {}
+    for _ in range(12):
+        _cycle(router, workers.values(), got)
+    assert not router.saturated
+    assert [r.seq for r in got["S"]] == list(range(10))
+
+
+# ---------------------------------------------------------------------------
+# live migration: the bit-identity acceptance test
+# ---------------------------------------------------------------------------
+
+
+def test_live_migration_output_bit_identical_to_unmigrated_run():
+    """Kill/drain a worker's ownership mid-stream (here: a second worker
+    joins, so half the sessions drain off w0 and resume on w1 with
+    carried state + buffered-tick replay) and assert every migrated
+    session's output sequence is bit-identical to an unmigrated
+    single-process run over the same tick sequence — no dropped,
+    duplicated, or reordered ticks.  Bucket size 1 on both sides keeps
+    the comparison free of XLA's B>1 reduction-order noise (the same
+    discipline the solo-vs-multiplexed identity tests use)."""
+    feats, window, n_rounds = 6, 4, 12
+    cfg, params = _setup(feats=feats, window=window)
+    rng = np.random.default_rng(1)
+    sids = [f"T{i}" for i in range(5)]
+    norms = {}
+    rows = {}
+    for sid in sids:
+        mn = rng.normal(size=feats).astype(np.float32)
+        norms[sid] = NormParams(mn, mn + 2.0)
+        rows[sid] = rng.normal(size=(n_rounds, feats)).astype(np.float32)
+
+    # reference: one FleetGateway, strictly serial, bucket 1
+    pool = SessionPool(cfg, params, capacity=8, window=window)
+    gw = FleetGateway(
+        pool, None,
+        batcher_config=BatcherConfig(bucket_sizes=(1,), max_linger_s=0.0),
+        pipeline_depth=0)
+    ref = {sid: [] for sid in sids}
+    for sid in sids:
+        gw.open_session(sid, norms[sid])
+    for r in range(n_rounds):
+        for sid in sids:
+            gw.submit(sid, rows[sid][r])
+            for res in gw.drain():
+                ref[res.session_id].append(res.probabilities)
+
+    # topology: w0 alone; w1 joins mid-stream -> live migration with
+    # ticks submitted DURING the handoff (exercises the router buffer)
+    router, workers, bus, clock, (mcfg, mparams, rc) = _topology(
+        ["w0"], all_ids=["w0", "w1"])
+    for sid in sids:
+        router.open_session(sid, norms[sid])
+    got = {}
+    live = list(workers.values())
+    for r in range(n_rounds):
+        if r == 5:
+            w1 = FleetWorker(
+                "w1", bus, mcfg, mparams,
+                config=router.cfg, runtime=rc, clock=clock,
+                precompile=False)
+            workers["w1"] = w1
+            live.append(w1)
+            w1.start()
+            router.pump()  # hello -> rebalance -> drain markers enqueued
+            # submit a round BEFORE the drains/exports are processed:
+            # these ticks must buffer at the router and replay in order
+            for sid in sids:
+                router.submit(sid, rows[sid][r])
+            for _ in range(4):
+                _cycle(router, live, got)
+            continue
+        for sid in sids:
+            router.submit(sid, rows[sid][r])
+        _cycle(router, live, got)
+    for _ in range(8):
+        _cycle(router, live, got)
+
+    counters = router.metrics.counters
+    assert counters["migrations_completed"] >= 1
+    assert counters.get("migration_replayed_ticks", 0) >= 1  # buffer used
+    assert counters.get("sessions_lost_state", 0) == 0
+    migrated = [sid for sid in sids if router.table.owner_of(sid) == "w1"]
+    assert migrated  # the rebalance actually moved sessions
+    for sid in sids:
+        seqs = [r_.seq for r_ in got[sid]]
+        assert seqs == list(range(n_rounds)), (sid, seqs)
+        for r in range(n_rounds):
+            np.testing.assert_array_equal(
+                got[sid][r].probabilities, ref[sid][r],
+                err_msg=f"{sid} tick {r} diverged after migration")
+
+
+def test_graceful_leave_migrates_everything_and_stops_the_worker():
+    router, workers, _bus, _clock, _ = _topology(["w0", "w1"])
+    rng = np.random.default_rng(0)
+    sids = [f"T{i}" for i in range(6)]
+    for sid in sids:
+        router.open_session(sid)
+    got = {}
+    for _ in range(3):
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    router.request_leave("w0")
+    for _ in range(10):
+        _cycle(router, workers.values(), got)
+    assert workers["w0"].stopped          # released once it owned nothing
+    assert workers["w0"].pool.n_active == 0
+    assert all(router.table.owner_of(sid) == "w1" for sid in sids)
+    assert router.metrics.counters.get("sessions_lost_state", 0) == 0
+    # the stream keeps flowing afterwards, seqs intact
+    for sid in sids:
+        router.submit(sid, rng.normal(size=6).astype(np.float32))
+    for _ in range(4):
+        _cycle(router, workers.values(), got)
+    for sid in sids:
+        assert [r.seq for r in got[sid]] == list(range(4))
+
+
+def test_worker_death_reopens_sessions_fresh_and_counted():
+    router, workers, _bus, clock, _ = _topology(["w0", "w1"])
+    rng = np.random.default_rng(0)
+    sids = [f"T{i}" for i in range(6)]
+    for sid in sids:
+        router.open_session(sid)
+    got = {}
+    for _ in range(3):
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    victim = router.table.owner_of(sids[0])
+    survivor = "w1" if victim == "w0" else "w0"
+    lost_sids = [s for s in sids if router.table.owner_of(s) == victim]
+    # the victim dies silently: stops stepping, no goodbye
+    workers[victim].stopped = True
+    clock.advance(60.0)                   # past heartbeat_timeout_s=50
+    workers[survivor].step()              # survivor beats at the new now
+    router.pump()                         # beat observed, victim reaped
+    counters = router.metrics.counters
+    assert counters["workers_dead"] == 1
+    assert counters["sessions_lost_state"] == len(lost_sids)
+    assert all(router.table.owner_of(s) == survivor for s in sids)
+    # streams continue on the survivor: fresh state but NO seq collision
+    for sid in sids:
+        router.submit(sid, rng.normal(size=6).astype(np.float32))
+    for _ in range(5):
+        _cycle(router, [workers[survivor]], got)
+    for sid in sids:
+        seqs = [r.seq for r in got[sid]]
+        assert seqs == sorted(set(seqs)), (sid, seqs)  # no dupes/reorder
+        assert seqs[-1] == 3              # the post-death tick answered
+
+
+def test_sessions_lost_state_counted_once_across_ownerless_gap():
+    # owner dies with NO survivor: sessions park ownerless (counted
+    # lost once); the later join that finally places them must not
+    # count the same loss again
+    router, workers, _bus, clock, _ = _topology(["w0", "w1"], start=False)
+    workers["w0"].start()
+    router.pump()
+    sids = [f"T{i}" for i in range(3)]
+    for sid in sids:
+        router.open_session(sid)
+    workers["w0"].stopped = True          # silent death, no goodbye
+    clock.advance(60.0)                   # past heartbeat_timeout_s=50
+    router.pump()                         # reaped; fleet is empty
+    counters = router.metrics.counters
+    assert counters["sessions_lost_state"] == len(sids)
+    assert all(s.owner is None for s in router._sessions.values())
+    workers["w1"].start()                 # a replacement finally joins
+    router.pump()
+    assert counters["sessions_lost_state"] == len(sids)  # NOT doubled
+    assert all(s.owner == "w1" and s.status == "active"
+               for s in router._sessions.values())
+
+
+def test_relink_after_transient_error_resumes_results_offset():
+    from fmda_tpu.stream.bus import Record
+
+    class FakeLinkBus:
+        """A worker-hosted bus whose link can blip while its retained
+        records survive (what a socket error on a live worker means)."""
+
+        def __init__(self):
+            self.rows = []
+            self.fail = False
+
+        def publish_many(self, topic, values):
+            if self.fail:
+                raise ConnectionError("link down")
+
+        def read(self, topic, offset):
+            if self.fail:
+                raise ConnectionError("link down")
+            return [Record(topic, o, v) for o, v in self.rows
+                    if o >= offset]
+
+        def close(self):
+            pass
+
+    clock = FakeClock()
+    bus = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    link_bus = FakeLinkBus()
+    router = FleetRouter(
+        bus, FleetTopologyConfig(heartbeat_timeout_s=50.0),
+        n_features=4, clock=clock, connect_fn=lambda addr: link_bus)
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w0",
+                                  "address": "addr:1"})
+    router.pump()
+    link_bus.rows = [(0, {"session": "X", "seq": 0}),
+                     (1, {"session": "X", "seq": 1})]
+    assert len(router.pump()) == 2
+    assert router._links["w0"].results_offset == 2
+    # transient blip: the link drops but the worker's bus survives
+    link_bus.fail = True
+    router.pump()
+    assert "w0" not in router._links
+    link_bus.fail = False
+    bus.publish("fleet_control", {"kind": "heartbeat", "worker": "w0",
+                                  "address": "addr:1"})
+    # re-linked at the SAVED offset: the retained rows are not
+    # re-delivered as duplicate results
+    assert router.pump() == []
+    assert router._links["w0"].results_offset == 2
+    # a fresh incarnation hellos — its new bus starts EMPTY at offset
+    # 0, so the saved resume position must be forgotten (resuming at 2
+    # on the new bus would silently skip its first two results)
+    link_bus.fail = True
+    router.pump()
+    link_bus.fail = False
+    link_bus.rows = []                    # the restart began a new bus
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w0",
+                                  "address": "addr:1"})
+    router.pump()
+    assert router._links["w0"].results_offset == 0
+    assert not router._link_resume
+
+
+# ---------------------------------------------------------------------------
+# reconnect storm (loadgen adversarial shape)
+# ---------------------------------------------------------------------------
+
+
+def test_reconnect_storm_on_gateway_counted_and_lossless_at_the_pool():
+    from fmda_tpu.runtime import FleetLoadConfig, run_fleet_load
+    from fmda_tpu.stream.bus import InProcessBus as Bus
+
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=16, window=4)
+    gw = FleetGateway(
+        pool, Bus(DEFAULT_TOPICS),
+        batcher_config=BatcherConfig(bucket_sizes=(4, 16),
+                                     max_linger_s=0.0))
+    out = run_fleet_load(gw, FleetLoadConfig(
+        n_sessions=8, n_ticks=30, seed=0,
+        storm_every=10, storm_fraction=0.5))
+    assert out["sessions_reopened"] == 8  # 2 storms x 4 sessions
+    # a reopened session restarts at seq 0 with a fresh slot; nothing
+    # crashes and the pool never leaks slots
+    assert pool.n_active == 8
+    assert out["ticks_served"] > 0
+
+
+def test_reconnect_storm_through_the_router():
+    router, workers, _bus, _clock, _ = _topology(
+        ["w0", "w1"], capacity=16, bucket_sizes=(1, 4))
+    rng = np.random.default_rng(0)
+    sids = [f"T{i}" for i in range(6)]
+    for sid in sids:
+        router.open_session(sid)
+    got = {}
+    for r in range(9):
+        if r in (3, 6):
+            # burst: every session closes and instantly reopens
+            for sid in sids:
+                router.close_session(sid)
+                router.open_session(sid)
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    for _ in range(6):
+        _cycle(router, workers.values(), got)
+    c = router.metrics.counters
+    assert c["sessions_closed"] == 12 and c["sessions_opened"] == 18
+    # per-incarnation seqs stay ordered; dropped in-flight ticks of dead
+    # incarnations are counted, never silently lost
+    for sid in sids:
+        seqs = [r.seq for r in got[sid]]
+        incarnation_starts = [i for i, s in enumerate(seqs) if s == 0]
+        assert len(incarnation_starts) >= 1
+        for a, b in zip(incarnation_starts, incarnation_starts[1:]):
+            chunk = seqs[a:b]
+            assert chunk == list(range(len(chunk)))
+    total_answered = sum(len(v) for v in got.values())
+    dropped = (c.get("inflight_dropped_on_close", 0)
+               + c.get("results_missing", 0))
+    assert total_answered + dropped >= 9 * 6  # every tick accounted for

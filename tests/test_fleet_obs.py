@@ -1,0 +1,183 @@
+"""Fleet observability satellites (ISSUE 6): the per-process ``process``
+label, multi-endpoint ``status`` aggregation, and ``trace --merge`` over
+a directory of per-process trace files."""
+
+import json
+
+from fmda_tpu.config import ObservabilityConfig
+from fmda_tpu.obs import Observability
+from fmda_tpu.obs.prometheus import render_prometheus
+from fmda_tpu.obs.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# process label
+# ---------------------------------------------------------------------------
+
+
+def test_process_label_stamped_on_every_sample_kind():
+    reg = MetricsRegistry()
+    reg.counter("ticks_total", topic="a").inc(3)
+    reg.gauge("depth").set(2)
+    reg.histogram("lat").observe(0.01)
+    reg.register_collector("extra", lambda: {
+        "counters": [{"name": "col_total", "labels": {}, "value": 1}]})
+    inner = MetricsRegistry()
+    inner.counter("inner_total").inc()
+    reg.include(inner)
+    reg.set_process("w3")
+    snap = reg.snapshot()
+    for kind in ("counters", "gauges", "histograms"):
+        for s in snap[kind]:
+            assert s["labels"]["process"] == "w3", s
+    # instrument-owned label dicts must not be mutated (shared objects)
+    assert "process" not in reg.counter("ticks_total", topic="a").labels
+    # existing labels survive alongside
+    by_name = {(s["name"], s["labels"].get("topic"))
+               for s in snap["counters"]}
+    assert ("ticks_total", "a") in by_name
+    assert ("inner_total", None) in by_name
+
+
+def test_process_label_renders_in_prometheus_exposition():
+    reg = MetricsRegistry()
+    reg.counter("bus_published_total", topic="x").inc(7)
+    reg.set_process("w1")
+    text = render_prometheus(reg.snapshot())
+    assert 'fmda_bus_published_total{process="w1",topic="x"} 7' in text
+
+
+def test_observability_process_kwarg_wires_the_label():
+    obs = Observability(ObservabilityConfig(), process="w9")
+    obs.registry.counter("x_total").inc()
+    assert all(
+        s["labels"].get("process") == "w9"
+        for s in obs.registry.snapshot()["counters"]
+        if s["name"] == "x_total"
+    )
+    obs.close()
+
+
+# ---------------------------------------------------------------------------
+# status --endpoint multi-worker aggregation
+# ---------------------------------------------------------------------------
+
+
+def _serve_worker_obs(process, healthy=True):
+    obs = Observability(ObservabilityConfig(), process=process)
+    obs.registry.counter("runtime_ticks_served_total").inc(5)
+    if not healthy:
+        obs.checks["stuck"] = lambda: (False, "wedged")
+    server = obs.start_server(port=0)
+    return obs, server
+
+
+def test_status_multiple_endpoints_reports_per_worker_and_aggregate(capsys):
+    from fmda_tpu.cli import main
+
+    obs0, srv0 = _serve_worker_obs("w0")
+    obs1, srv1 = _serve_worker_obs("w1")
+    try:
+        rc = main(["status", "--endpoint",
+                   f"127.0.0.1:{srv0.port}", f"127.0.0.1:{srv1.port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert f"127.0.0.1:{srv0.port}: ok" in out
+        assert f"127.0.0.1:{srv1.port}: ok" in out
+        assert "aggregate: ok (2/2 endpoints ok)" in out
+        # per-worker series visible with their process label
+        assert 'process=w0' in out and 'process=w1' in out
+    finally:
+        obs0.close()
+        obs1.close()
+
+
+def test_status_aggregate_degrades_on_one_bad_worker(capsys):
+    from fmda_tpu.cli import main
+
+    obs0, srv0 = _serve_worker_obs("w0")
+    obs1, srv1 = _serve_worker_obs("w1", healthy=False)
+    try:
+        rc = main(["status", "--endpoint",
+                   f"127.0.0.1:{srv0.port}", f"127.0.0.1:{srv1.port}"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "aggregate: degraded (1/2 endpoints ok)" in out
+        assert "wedged" in out
+    finally:
+        obs0.close()
+        obs1.close()
+
+
+def test_status_aggregate_counts_unreachable_worker(capsys):
+    import socket
+
+    from fmda_tpu.cli import main
+
+    obs0, srv0 = _serve_worker_obs("w0")
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    dead_port = sock.getsockname()[1]
+    sock.close()
+    try:
+        rc = main(["status", "--endpoint",
+                   f"127.0.0.1:{srv0.port}", f"127.0.0.1:{dead_port}"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert f"127.0.0.1:{dead_port}: unreachable" in out
+        assert "aggregate: degraded (1/2 endpoints ok)" in out
+    finally:
+        obs0.close()
+
+
+# ---------------------------------------------------------------------------
+# trace --merge over a directory / glob
+# ---------------------------------------------------------------------------
+
+
+def _chrome_doc(trace_id, spans, pid):
+    events = []
+    for name, stage, span_id, parent, ts, dur in spans:
+        events.append({
+            "name": name, "cat": stage, "ph": "X", "ts": ts, "dur": dur,
+            "pid": pid, "tid": 1,
+            "args": {"trace_id": trace_id, "span_id": span_id,
+                     "parent_id": parent},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def test_trace_merge_accepts_a_directory(tmp_path, capsys):
+    from fmda_tpu.cli import main
+
+    tdir = tmp_path / "traces"
+    tdir.mkdir()
+    # router's file: the root; worker's file: a child of the same trace
+    # on its own (shifted) timeline
+    (tdir / "router.json").write_text(json.dumps(_chrome_doc(
+        "t1", [("tick", "ingest", "r", None, 1000.0, 500.0)], 1)))
+    (tdir / "w0.json").write_text(json.dumps(_chrome_doc(
+        "t1", [("serve", "serve", "s", "r", 91000.0, 200.0)], 2)))
+    merged = tmp_path / "merged.json"
+    rc = main(["trace", "--merge", str(tdir), "--out", str(merged)])
+    assert rc == 0
+    assert "merged 2 trace files" in capsys.readouterr().err
+    doc = json.loads(merged.read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert names == {"tick", "serve"}
+    # shared-trace alignment pulled the worker's timeline onto the
+    # router's (both files' earliest span align at the shared journey)
+    ts = {e["name"]: e["ts"] for e in doc["traceEvents"]
+          if e.get("ph") == "X"}
+    assert ts["serve"] == 1000.0
+
+    # a glob works too
+    rc = main(["trace", "--merge", str(tdir / "*.json"),
+               "--out", str(merged)])
+    assert rc == 0
+
+    # an empty directory is a clean, loud error
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["trace", "--merge", str(empty)]) == 2
+    assert "no *.json trace files" in capsys.readouterr().err

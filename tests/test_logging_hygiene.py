@@ -127,3 +127,104 @@ def test_span_code_never_uses_wall_clock():
     # and the sanctioned clock is actually present
     text = (PACKAGE_DIR / "obs/trace.py").read_text()
     assert "perf_counter_ns" in text
+
+
+#: router-role fleet modules (ISSUE 6 satellite): a fleet router runs on
+#: a bus-only host, so NOTHING on its import path may pull jax in at
+#: module scope — only worker.py (which embeds the serving runtime) may
+ROUTER_ROLE_MODULES = (
+    "fleet/__init__.py",
+    "fleet/hashring.py",
+    "fleet/launcher.py",
+    "fleet/membership.py",
+    "fleet/router.py",
+    "fleet/state.py",
+    "fleet/wire.py",
+)
+
+
+def _module_scope_jax_imports(path: pathlib.Path):
+    """``import jax`` / ``from jax...`` statements at module scope
+    (anything not nested inside a function body)."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = path.relative_to(PACKAGE_DIR)
+    found = []
+
+    def walk(body):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # deferred imports are the sanctioned pattern
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    if root == "jax":
+                        found.append(
+                            f"{rel}:{node.lineno}: import {alias.name}")
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                if root == "jax":
+                    found.append(
+                        f"{rel}:{node.lineno}: from {node.module} import")
+            elif isinstance(node, (ast.If, ast.Try, ast.With,
+                                   ast.ClassDef)):
+                for attr in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(node, attr, None)
+                    if not sub:
+                        continue
+                    for item in sub:
+                        if isinstance(item, ast.excepthandler):
+                            walk(item.body)
+                    walk([s for s in sub
+                          if not isinstance(s, ast.excepthandler)])
+
+    walk(tree.body)
+    return found
+
+
+def test_fleet_router_modules_never_import_jax_at_module_scope():
+    """AST half of the bus-only-host contract: no router-role fleet
+    module imports jax (or a submodule) at module scope."""
+    violations = []
+    for rel in ROUTER_ROLE_MODULES:
+        path = PACKAGE_DIR / rel
+        assert path.is_file(), f"stale ROUTER_ROLE_MODULES entry {rel}"
+        violations.extend(_module_scope_jax_imports(path))
+    assert not violations, (
+        "router-role fleet modules must start on a bus-only host "
+        "(import jax lazily, in worker-role code only):\n"
+        + "\n".join(violations)
+    )
+
+
+def test_fleet_router_import_path_is_transitively_jax_free():
+    """Runtime half: actually import every router-role module in a
+    clean interpreter and assert jax never loaded — an AST check can't
+    see a transitive leak through a helper module's import chain."""
+    import subprocess
+    import sys
+
+    import pytest
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "pass"], timeout=60,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    except Exception:
+        probe = None
+    if probe is None or probe.returncode != 0:
+        pytest.skip("subprocess spawn unavailable")
+    mods = ", ".join(
+        "fmda_tpu." + rel[:-3].replace("/", ".").replace(".__init__", "")
+        for rel in ROUTER_ROLE_MODULES
+    )
+    code = (
+        "import sys; "
+        f"import {mods}; "
+        "sys.exit(1 if 'jax' in sys.modules else 0)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], timeout=120,
+        cwd=str(PACKAGE_DIR.parent), capture_output=True)
+    assert proc.returncode == 0, (
+        "importing the fleet router pulled jax in transitively:\n"
+        + proc.stderr.decode()[-2000:])
