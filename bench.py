@@ -1465,6 +1465,68 @@ def phase_runtime_multihost() -> dict:
     return result
 
 
+def phase_runtime_chaos_soak() -> dict:
+    """Chaos soak (ISSUE 7): the full local multi-host topology under a
+    seeded fault plan — a worker SIGKILLed and revived, a router
+    takeover (registry rebuilt from worker session reports), a
+    control-bus outage, a data-link partition, injected delays — while
+    a burst + slow-drip loadgen mix runs, hard-gating the never-abort
+    contract:
+
+    - this phase's subprocess exiting 0 is gate zero (nothing may
+      abort under the plan);
+    - zero uncounted losses: submitted == served + the loss counters
+      (every drop/reopen/replay appears in a metric);
+    - router failover rebuilds the registry with no orphaned session,
+      and every session serves ticks again after the last fault;
+    - surviving (untouched) sessions are bit-identical to an unfaulted
+      run of the same tick schedule (bucket 1 — composition cannot
+      perturb reduction order).
+
+    The plan is a pure function of the seed (FMDA_CHAOS_SEED) — a
+    failing soak is a reproduction recipe, not an anecdote.
+    """
+    from fmda_tpu.chaos.plan import FaultPlan
+    from fmda_tpu.chaos.soak import run_chaos_soak
+    from fmda_tpu.fleet.launcher import spawn_supported
+
+    if not spawn_supported():
+        return {"skipped": "subprocess spawn unavailable on this host"}
+    seed = int(os.environ.get("FMDA_CHAOS_SEED", "0"))
+    workers = ["w0", "w1"]
+    rounds = 60
+    plan = FaultPlan.generate(
+        seed, rounds, workers=workers,
+        worker_kills=1, revive_after=10, router_restarts=1,
+        link_partitions=1, bus_blips=1, delays=2, delay_s=0.02,
+        settle_steps=12)
+    out = run_chaos_soak(
+        plan, n_workers=len(workers), n_sessions=12, hidden=HIDDEN,
+        seed=seed, compare_unfaulted=True)
+    result = {
+        "seed": seed,
+        "rounds": rounds,
+        "plan": out["plan"],
+        "chaos_injected": out["chaos_injected"],
+        "ticks_submitted": out["ticks_submitted"],
+        "ticks_served": out["ticks_served"],
+        "losses": out["losses"],
+        "unaccounted": out["unaccounted"],
+        "takeovers": out["takeovers"],
+        "tainted_sessions": out["tainted_sessions"],
+        "identity": {k: v for k, v in out.get("identity", {}).items()},
+        "gates": out["gates"],
+        "degradation_counters": out["degradation_counters"],
+    }
+    failed = [g for g, ok in out["gates"].items() if not ok]
+    if failed:
+        result["error"] = (
+            f"never-abort gates failed: {failed} (seed {seed} "
+            "reproduces the plan; see degradation_counters and "
+            "docs/chaos.md)")
+    return result
+
+
 def phase_obs_overhead() -> dict:
     """Observability-plane cost on the engine.step hot loop: the same
     synthetic replay driven twice per repetition — once with the obs
@@ -1631,6 +1693,7 @@ _PHASES = {
     "runtime_fleet_smoke": phase_runtime_fleet,
     "predictor_fleet_smoke": phase_predictor_fleet,
     "runtime_multihost_smoke": phase_runtime_multihost,
+    "runtime_chaos_soak": phase_runtime_chaos_soak,
     "obs_overhead": phase_obs_overhead,
     "trace_overhead": phase_trace_overhead,
 }
@@ -2061,6 +2124,7 @@ def main() -> None:
         ("runtime_fleet_smoke", 240.0),
         ("predictor_fleet_smoke", 300.0),
         ("runtime_multihost_smoke", 420.0),
+        ("runtime_chaos_soak", 600.0),
         ("obs_overhead", 300.0),
         ("trace_overhead", 300.0),
         ("flagship_bf16", 300.0),

@@ -443,10 +443,17 @@ def _cmd_fleet_worker(args) -> int:
             (fleet_worker_topic(args.worker_id), TOPIC_FLEET_PREDICTION))
         data_server = BusServer(data_bus, host=cfg.fleet.host).start()
         data_address = data_server.address
+    # split-topology workers re-dial the control bus after a router/
+    # broker restart (the data plane is local, serving never stops);
+    # shared-bus workers exit cleanly after the grace instead — their
+    # whole transport is the one broker
+    reconnect = (None if args.shared_bus
+                 else (lambda: SocketBus.connect(args.connect)))
     worker = FleetWorker(
         args.worker_id, bus, model_cfg, params,
         config=cfg.fleet, runtime=cfg.runtime, capacity=args.sessions,
-        data_bus=data_bus, data_address=data_address)
+        data_bus=data_bus, data_address=data_address,
+        reconnect_fn=reconnect)
     # per-process observability: every series this worker exports
     # carries a `process` label, so a fleet-wide scrape never collides
     obs = Observability(cfg.observability, process=args.worker_id)
@@ -584,6 +591,38 @@ def _cmd_fleet_router(args) -> int:
     return 0
 
 
+def _cmd_fleet_chaos(args, cfg) -> int:
+    """serve-fleet --role local --chaos-plan: run the chaos soak — the
+    full topology under a fault plan (kill/revive workers, router
+    takeover, bus blips, link partitions), hard-gating the never-abort
+    contract (docs/chaos.md).  Exit 1 iff a gate fails."""
+    from fmda_tpu.chaos.plan import FaultPlan, plan_from_config
+    from fmda_tpu.chaos.soak import run_chaos_soak
+
+    n = args.workers if args.workers is not None else cfg.fleet.n_workers
+    worker_ids = [f"{cfg.fleet.worker_prefix}{i}" for i in range(n)]
+    if args.chaos_plan == "generate":
+        plan = plan_from_config(
+            cfg.chaos, worker_ids, n_steps=args.ticks)
+    else:
+        plan = FaultPlan.load(args.chaos_plan)
+    out = run_chaos_soak(
+        plan,
+        n_workers=n,
+        n_sessions=args.sessions,
+        hidden=args.hidden,
+        seed=args.seed,
+        duty=args.duty,
+        slow_fraction=args.slow_fraction,
+        slow_duty=args.slow_duty,
+        burst_every=args.burst_every,
+        compare_unfaulted=not args.chaos_no_reference,
+        config=cfg,
+    )
+    print(json.dumps(out, indent=2, default=str))
+    return 0 if out["gates_ok"] else 1
+
+
 def _cmd_fleet_local(args) -> int:
     """serve-fleet --role local: the single-command topology — spawn
     router (inline) + N worker processes, drive the synthetic fleet
@@ -596,6 +635,8 @@ def _cmd_fleet_local(args) -> int:
         print(json.dumps(
             {"skipped": "subprocess spawn unavailable on this host"}))
         return 0
+    if args.chaos_plan:
+        return _cmd_fleet_chaos(args, cfg)
     if args.trace or args.trace_out or args.trace_dir:
         from fmda_tpu.obs.trace import configure_tracing
 
@@ -619,7 +660,11 @@ def _cmd_fleet_local(args) -> int:
             n_sessions=args.sessions, n_ticks=args.ticks,
             duty=args.duty, seed=args.seed,
             storm_every=args.storm_every,
-            storm_fraction=args.storm_fraction))
+            storm_fraction=args.storm_fraction,
+            burst_every=args.burst_every,
+            burst_rounds=args.burst_rounds,
+            slow_fraction=args.slow_fraction,
+            slow_duty=args.slow_duty))
     finally:
         worker_stats = topo.shutdown()
     out["workers"] = n
@@ -769,7 +814,11 @@ def cmd_serve_fleet(args) -> int:
             n_sessions=args.sessions,
             n_ticks=args.ticks, duty=args.duty, seed=args.seed,
             storm_every=args.storm_every,
-            storm_fraction=args.storm_fraction)
+            storm_fraction=args.storm_fraction,
+            burst_every=args.burst_every,
+            burst_rounds=args.burst_rounds,
+            slow_fraction=args.slow_fraction,
+            slow_duty=args.slow_duty)
 
         def run_load():
             return run_fleet_load(gateway, load_cfg)
@@ -1196,6 +1245,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "sessions (0 = off)")
     p.add_argument("--storm-fraction", type=float, default=0.25,
                    help="fraction of sessions hit per reconnect storm")
+    p.add_argument("--burst-every", type=int, default=0,
+                   help="synchronized burst (market-open spike): every "
+                        "N rounds EVERY session ticks for "
+                        "--burst-rounds consecutive rounds (0 = off)")
+    p.add_argument("--burst-rounds", type=int, default=1,
+                   help="consecutive all-tick rounds per burst")
+    p.add_argument("--slow-fraction", type=float, default=0.0,
+                   help="fraction of sessions that are slow-drip "
+                        "stragglers ticking at --slow-duty instead of "
+                        "--duty (long-lived barely-ticking clients)")
+    p.add_argument("--slow-duty", type=float, default=0.05,
+                   help="tick probability per round for the slow-drip "
+                        "straggler set")
+    p.add_argument("--chaos-plan", default=None, metavar="FILE",
+                   help="--role local: run the chaos soak under this "
+                        "fault-plan JSON (fmda_tpu.chaos.FaultPlan; "
+                        "docs/chaos.md) instead of the plain load; "
+                        "'generate' derives a plan from the config's "
+                        "[chaos] knobs + seed.  Exits 1 iff a "
+                        "never-abort gate fails")
+    p.add_argument("--chaos-no-reference", action="store_true",
+                   help="skip the unfaulted reference run (and with it "
+                        "the bit-identity gate) — faster soak, "
+                        "accounting + failover gates only")
     p.add_argument("--trace-dir", default=None, metavar="DIR",
                    help="--role local: enable tracing in every process "
                         "and write one trace file per process into DIR "

@@ -576,6 +576,15 @@ class FleetTopologyConfig:
     #: deep tick backlogs (a ~700B tick message × max_inflight_ticks ×
     #: workers fits with wide margin).
     bus_arena_bytes: int = 1 << 26
+    #: How long a shared-bus worker retries a dead broker before exiting
+    #: cleanly (counted, rc 0 — the never-abort contract).  A
+    #: worker-hosted-bus worker never exits on control loss: its data
+    #: plane is local, so it keeps serving and re-dials instead.
+    bus_error_grace_s: float = 10.0
+    #: Control-plane re-dial cadence while the router/broker is
+    #: unreachable (split topology; reconnect re-hellos with the session
+    #: report, which is how a restarted router adopts the sessions).
+    control_retry_s: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -636,6 +645,46 @@ class TracingConfig:
 
 
 @dataclass(frozen=True)
+class ChaosConfig:
+    """Fault-injection knobs (fmda_tpu.chaos; docs/chaos.md).
+
+    Off by default: with ``enabled=False`` nothing is injected and every
+    compiled-in injection point costs exactly one branch (the tier-1 AST
+    check pins this).  The rate knobs parameterise
+    :meth:`~fmda_tpu.chaos.plan.FaultPlan.generate` when no explicit
+    ``--chaos-plan`` file is given — the plan is a pure function of
+    ``seed`` and these counts, so a run is its own reproduction recipe.
+    """
+
+    #: Master switch for the process chaos runtime.
+    enabled: bool = False
+    #: Seed the generated fault plan derives from.
+    seed: int = 0
+    #: Worker processes killed (and revived ``revive_after`` steps
+    #: later) per soak.
+    worker_kills: int = 1
+    #: Virtual steps a killed worker stays down before its replacement
+    #: spawns.
+    revive_after: int = 8
+    #: Router kill/takeover events per soak (each exercises the
+    #: registry-rebuild failover path).
+    router_restarts: int = 1
+    #: Router→worker data-link partition windows per soak.
+    link_partitions: int = 1
+    #: Control-bus outage windows per soak (the router keeps pumping its
+    #: links while its own bus is down — counted, never fatal).
+    bus_blips: int = 1
+    #: Injected per-op delay events per soak.
+    delays: int = 2
+    #: Sleep per delayed op (seconds).
+    delay_s: float = 0.02
+    #: Fault-free steps at both ends of the schedule: a clean warm-up,
+    #: and the post-chaos window the "ticks served after the last
+    #: fault" gate measures in.
+    settle_steps: int = 5
+
+
+@dataclass(frozen=True)
 class SessionConfig:
     """Ingestion-session driver knobs (ref: producer.py:257-263)."""
 
@@ -665,6 +714,7 @@ class FrameworkConfig:
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     def __post_init__(self) -> None:
         if self.model.n_features is None:
@@ -697,6 +747,7 @@ _SECTIONS = {
     "fleet": FleetTopologyConfig,
     "observability": ObservabilityConfig,
     "tracing": TracingConfig,
+    "chaos": ChaosConfig,
 }
 
 

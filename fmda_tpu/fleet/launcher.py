@@ -89,6 +89,8 @@ class LocalFleet:
         procs: List[subprocess.Popen],
         worker_ids: List[str],
         log_dir: str,
+        worker_argv: Optional[Dict[str, List[str]]] = None,
+        repo_root: Optional[str] = None,
     ) -> None:
         self.router = router
         self.server = server
@@ -96,6 +98,50 @@ class LocalFleet:
         self.procs = procs
         self.worker_ids = worker_ids
         self.log_dir = log_dir
+        #: exact spawn command per worker id — the chaos soak revives a
+        #: killed worker by replaying it (a fresh incarnation: same id,
+        #: fresh state, hellos its own way back into membership)
+        self.worker_argv = worker_argv or {}
+        self.repo_root = repo_root
+
+    def proc_for(self, worker_id: str) -> Optional[subprocess.Popen]:
+        try:
+            return self.procs[self.worker_ids.index(worker_id)]
+        except ValueError:
+            return None
+
+    def kill_worker(self, worker_id: str) -> bool:
+        """SIGKILL one worker process — no drain, no goodbye: the
+        silent-death failure the heartbeat timeout exists to catch
+        (the chaos soak's ``kill worker:<id>`` events land here)."""
+        proc = self.proc_for(worker_id)
+        if proc is None or proc.poll() is not None:
+            return False
+        proc.kill()
+        proc.wait(timeout=10.0)
+        log.warning("chaos: killed worker %s (pid %d)",
+                    worker_id, proc.pid)
+        return True
+
+    def revive_worker(self, worker_id: str) -> bool:
+        """Spawn a fresh incarnation of a killed worker (same id, same
+        argv).  It hellos on its own; the router treats it as any other
+        join — rebalance, fresh bus at offset 0 (the hello purges any
+        saved resume position)."""
+        argv = self.worker_argv.get(worker_id)
+        proc = self.proc_for(worker_id)
+        if argv is None or self.repo_root is None:
+            return False
+        if proc is not None and proc.poll() is None:
+            return False  # still alive — nothing to revive
+        new = _spawn(
+            argv,
+            os.path.join(self.log_dir, f"{worker_id}.revived.log"),
+            self.repo_root)
+        self.procs[self.worker_ids.index(worker_id)] = new
+        log.warning("chaos: revived worker %s (pid %d)",
+                    worker_id, new.pid)
+        return True
 
     def __enter__(self) -> "LocalFleet":
         return self
@@ -169,6 +215,7 @@ def launch_local_fleet(
     wait_timeout_s: float = 180.0,
     python: str = sys.executable,
     log_dir: Optional[str] = None,
+    wrap_bus=None,
 ) -> LocalFleet:
     """Spawn the whole topology and block until every worker joined.
 
@@ -187,6 +234,14 @@ def launch_local_fleet(
         os.makedirs(trace_dir, exist_ok=True)
     repo_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
+    # ship the WHOLE config to every worker process: topology knobs
+    # (heartbeat cadence, grace windows — the chaos soak shortens them)
+    # must match across the fleet, and CLI flags only cover the model/
+    # batching subset
+    from fmda_tpu.config import save_config
+
+    config_path = os.path.join(log_dir, "fleet_config.json")
+    save_config(config, config_path)
 
     # the router's own bus: the control plane, plus shared-mode inbox/
     # results topics so --shared-bus workers (and tests) still work
@@ -198,6 +253,7 @@ def launch_local_fleet(
                        port=fleet_cfg.port).start()
     address = server.address
     procs: List[subprocess.Popen] = []
+    worker_argv: Dict[str, List[str]] = {}
     try:
         for wid in worker_ids:
             argv = [
@@ -208,6 +264,7 @@ def launch_local_fleet(
                 "--connect", address,
                 "--hidden", str(hidden),
                 "--seed", str(seed),
+                "--config", config_path,
             ]
             if capacity_per_worker is not None:
                 argv += ["--sessions", str(capacity_per_worker)]
@@ -221,11 +278,17 @@ def launch_local_fleet(
             if trace_dir:
                 argv += ["--trace", "--trace-out",
                          os.path.join(trace_dir, f"{wid}.json")]
+            worker_argv[wid] = argv
             procs.append(_spawn(
                 argv, os.path.join(log_dir, f"{wid}.log"), repo_root))
 
+        # `wrap_bus` interposes on the ROUTER's bus handle only (the
+        # BusServer keeps serving the raw bus to workers) — the chaos
+        # soak wraps a ChaosBus here so control-plane faults hit the
+        # router without perturbing the workers' transport
         router = FleetRouter(
-            bus, fleet_cfg, n_features=config.features.n_features)
+            wrap_bus(bus) if wrap_bus is not None else bus,
+            fleet_cfg, n_features=config.features.n_features)
 
         def _sleep_and_check(dt: float) -> None:
             time.sleep(dt)
@@ -253,4 +316,5 @@ def launch_local_fleet(
         raise
     return LocalFleet(
         router=router, server=server, bus=bus, procs=procs,
-        worker_ids=worker_ids, log_dir=log_dir)
+        worker_ids=worker_ids, log_dir=log_dir,
+        worker_argv=worker_argv, repo_root=repo_root)

@@ -174,7 +174,10 @@ class Heartbeater:
         self.announce = dict(announce or {})
         self._last_beat: Optional[float] = None
 
-    def _publish(self, kind: str, stats: Optional[dict]) -> None:
+    def _publish(
+        self, kind: str, stats: Optional[dict],
+        extra: Optional[dict] = None,
+    ) -> None:
         msg = {
             "kind": kind,
             "worker": self.worker_id,
@@ -183,11 +186,21 @@ class Heartbeater:
         }
         if stats is not None:
             msg["stats"] = stats
+        if extra:
+            msg.update(extra)
         self.bus.publish(self.control_topic, msg)
 
-    def hello(self, stats: Optional[dict] = None) -> None:
+    def hello(
+        self, stats: Optional[dict] = None,
+        extra: Optional[dict] = None,
+    ) -> None:
+        """Announce membership.  ``extra`` fields ride the hello only —
+        the worker's open-session report (id → seq + norm) goes here, so
+        a router restarted mid-serve rebuilds its registry from the
+        re-hello without a second RPC surface (router failover,
+        docs/chaos.md)."""
         self._last_beat = self.clock()
-        self._publish(HELLO, stats)
+        self._publish(HELLO, stats, extra)
 
     def beat(
         self, stats: Optional[dict] = None, *, force: bool = False

@@ -70,6 +70,7 @@ from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from fmda_tpu.chaos.inject import default_chaos
 from fmda_tpu.config import (
     FleetTopologyConfig,
     TOPIC_FLEET_CONTROL,
@@ -83,6 +84,9 @@ from fmda_tpu.obs.trace import default_tracer, now_ns
 from fmda_tpu.runtime.metrics import RuntimeMetrics
 
 log = logging.getLogger("fmda_tpu.fleet")
+
+#: chaos injection (fmda_tpu.chaos): disabled = one branch per pump/link
+_CHAOS = default_chaos()
 
 
 class NoLiveWorkers(RuntimeError):
@@ -146,6 +150,7 @@ class FleetRouter:
         control_topic: str = TOPIC_FLEET_CONTROL,
         prediction_topic: str = TOPIC_FLEET_PREDICTION,
         connect_fn: Optional[Callable[[str], object]] = None,
+        from_end: bool = False,
     ) -> None:
         self.cfg = config or FleetTopologyConfig()
         self.bus = bus
@@ -158,6 +163,15 @@ class FleetRouter:
             self.cfg.heartbeat_timeout_s, clock=clock)
         self.table = OwnershipTable(0, (), self.cfg.hash_space)
         self._sessions: Dict[str, _Session] = {}
+        #: lazy per-worker owned-session counts (None = recompute);
+        #: invalidated at every registry/owner mutation
+        self._owned_cache: Optional[Dict[str, int]] = None
+        #: ids of every session whose carried state this router ever
+        #: lost (owner died undrained → fresh reopen).  The chaos
+        #: soak's bit-identity gate excludes exactly these — loss is
+        #: judged by observation, not by which faults were planned (a
+        #: falsely-reaped worker's sessions lose state just as really)
+        self.lost_state_sessions: set = set()
         #: session ids whose status != "active" (migrating/orphaned) —
         #: maintained at every status transition so saturation checks
         #: and drain's are-we-done test never scan the whole registry
@@ -172,6 +186,14 @@ class FleetRouter:
         #: data-plane links to worker-hosted buses (absent for workers
         #: sharing this router's bus)
         self._links: Dict[str, _WorkerLink] = {}
+        #: worker ids that ever announced a data-plane address: their
+        #: outgoing traffic must never fall through to the shared bus
+        #: while a link is down (their inbox lives on THEIR bus)
+        self._linked_ever: set = set()
+        #: worker ids whose outgoing batch sat out a link outage — their
+        #: next delivery re-checks ticks against the in-flight table
+        #: (aged ones are already counted lost and must not be served)
+        self._held_outgoing: set = set()
         #: (worker_id, address) -> results_offset saved when a link
         #: drops on a TRANSIENT error: the worker's bus (and its
         #: retained results) are still there, so the re-link must
@@ -183,8 +205,16 @@ class FleetRouter:
         #: (session, seq) -> (t_submit, trace_ref) for latency + loss
         #: accounting; insertion-ordered, aged out at result_timeout_s
         self._inflight: "OrderedDict[Tuple[str, int], tuple]" = OrderedDict()
-        self._control = bus.consumer(control_topic)
-        self._results = bus.consumer(prediction_topic)
+        #: workers we asked for a session report (takeover) whose answer
+        #: is still outstanding — one request in flight per worker
+        self._report_pending: set = set()
+        #: ``from_end=True`` is the RESTART posture (router failover,
+        #: docs/chaos.md): skip the control topic's history — replaying
+        #: hours-old hellos would resurrect dead workers at receipt-time
+        #: liveness — and re-learn membership from the next beats; the
+        #: session registry is rebuilt from worker session reports
+        self._control = bus.consumer(control_topic, from_end=from_end)
+        self._results = bus.consumer(prediction_topic, from_end=from_end)
         self._mig_ids = itertools.count(1)
         self._tracer = default_tracer()
         #: set while the whole topology is being stopped: membership
@@ -276,6 +306,21 @@ class FleetRouter:
 
     def _sessions_changed(self) -> None:
         self.metrics.gauge("active_sessions", len(self._sessions))
+        self._owned_cache = None
+
+    def _owned_counts(self) -> Dict[str, int]:
+        """Per-worker owned-session counts, cached between registry
+        mutations: takeover detection reads this on essentially every
+        heartbeat, and a scan of the whole registry per beat would put
+        O(sessions × workers / heartbeat_interval) on the pump loop."""
+        counts = self._owned_cache
+        if counts is None:
+            counts = {}
+            for s in self._sessions.values():
+                if s.owner is not None:
+                    counts[s.owner] = counts.get(s.owner, 0) + 1
+            self._owned_cache = counts
+        return counts
 
     # -- the request path ----------------------------------------------------
 
@@ -336,6 +381,9 @@ class FleetRouter:
         )
 
     def _set_status(self, sess: _Session, status: str) -> None:
+        # every owner handoff passes through here right after the
+        # assignment (migration complete, reopen) — drop the cache with it
+        self._owned_cache = None
         sess.status = status
         if status == "active":
             self._migrating.discard(sess.session_id)
@@ -356,7 +404,18 @@ class FleetRouter:
         gateway-API compatibility (the router has no deferred flushes —
         every pump flushes)."""
         del force
-        self._drain_control()
+        if _CHAOS.enabled:
+            # injection point "router.pump": delay/hang windows stall
+            # the control loop (the slow-router shape)
+            _CHAOS.check("router.pump")
+        try:
+            self._drain_control()
+        except (ConnectionError, OSError) as e:
+            # the control bus is down (broker blip): the router keeps
+            # pumping its data links — membership just ages until the
+            # bus returns.  Counted degradation, never abort.
+            self.metrics.count("bus_errors")
+            log.warning("control-plane poll failed: %s", e)
         dead = self.membership.reap()
         if dead:
             self.metrics.count("workers_dead", len(dead))
@@ -367,6 +426,8 @@ class FleetRouter:
                 # replacement hellos, which purges the saved position
                 self._close_link(wid, resume=True)
                 self._stops_sent.discard(wid)
+                self._drop_outgoing(wid)
+                self._report_pending.discard(wid)
             self._rebalance(f"worker death: {sorted(dead)}")
         # a migration completed this pump may have emptied a leaving
         # worker — release it now, not on the next membership change
@@ -423,9 +484,20 @@ class FleetRouter:
         rows: List[tuple] = []
         for wid, link in list(self._links.items()):
             msgs = outgoing.pop(wid, [])
+            if wid in self._held_outgoing:
+                # this batch sat out a link outage: ticks that aged into
+                # results_missing while held must not be delivered now —
+                # serving a written-off tick would count it twice
+                self._held_outgoing.discard(wid)
+                msgs = self._drop_aged_ticks(wid, msgs)
             t0_ns = now_ns() if tracing else 0
             t0 = self.clock()
             try:
+                if _CHAOS.enabled:
+                    # injection point "link:<wid>": a partition window
+                    # raises here and exercises the REAL link-failure
+                    # machinery below (drop, count, heartbeat re-link)
+                    _CHAOS.check("link:" + wid)
                 with self.metrics.timer.stage("route"):
                     batch = getattr(link.bus, "batch", None)
                     read_op = {
@@ -465,10 +537,24 @@ class FleetRouter:
                 # the worker's bus went away mid-exchange: drop the
                 # link (a live worker's next heartbeat re-links it —
                 # every beat carries the address; a dead worker's
-                # silence confirms the death by timeout) and count the
-                # batch lost, never silent
+                # silence confirms the death by timeout).  Ticks in the
+                # failed frame are at-most-once — re-sending could
+                # double-advance a recurrence — so they are counted
+                # lost (any that actually landed still answer and are
+                # matched; the rest age into results_missing).  Control
+                # messages ARE idempotent (a duplicate open replaces
+                # with identical state, a duplicate close/drain is
+                # counted unknown), so they re-queue ahead of newer
+                # traffic and ride the re-link: a transient blip can no
+                # longer strand a migration on a lost drain marker.
                 self.metrics.count("link_errors")
-                self.metrics.count("routed_ticks_lost", len(msgs))
+                keep = [m for m in msgs if m.get("kind") != "tick"]
+                n_ticks = len(msgs) - len(keep)
+                if n_ticks:
+                    self.metrics.count("routed_ticks_lost", n_ticks)
+                if keep:
+                    self.metrics.count("control_requeued", len(keep))
+                    self._outgoing[wid] = keep + self._outgoing.get(wid, [])
                 log.warning("data link to %s failed: %s", wid, e)
                 self._close_link(wid, resume=True)
                 continue
@@ -489,6 +575,24 @@ class FleetRouter:
         if outgoing:
             publish_many = getattr(self.bus, "publish_many", None)
             for wid, msgs in outgoing.items():
+                if wid in self._linked_ever and wid not in self._links:
+                    # a worker-hosted worker whose link is down: its
+                    # inbox lives on ITS bus, not the shared one —
+                    # hold the batch for the heartbeat-driven re-link
+                    # (dropped + counted if the worker is declared
+                    # dead instead).  Ticks that aged out of the
+                    # in-flight table while held are dropped NOW: they
+                    # are already counted results_missing, so late
+                    # delivery would serve a tick the accounting wrote
+                    # off (counted twice) — and keeping them would let
+                    # a long partition grow the hold without bound,
+                    # where dropping caps it at max_inflight_ticks.
+                    held = self._drop_aged_ticks(wid, msgs)
+                    if held:
+                        self._held_outgoing.add(wid)
+                        self._outgoing[wid] = \
+                            held + self._outgoing.get(wid, [])
+                    continue
                 t0_ns = now_ns() if tracing else 0
                 t0 = self.clock()
                 try:
@@ -505,6 +609,27 @@ class FleetRouter:
                         "router: no inbox topic for %s on the shared "
                         "bus", wid)
                     continue
+                except (ConnectionError, OSError) as e:
+                    # shared broker down: counted, the pump survives —
+                    # the same contract as a link failure, including the
+                    # requeue: ticks are at-most-once (counted lost, the
+                    # unanswered ones age into results_missing), but
+                    # idempotent control messages ride the broker's
+                    # recovery — a blip must not strand a migration on a
+                    # dropped drain marker or leave a reopen dark
+                    self.metrics.count("bus_errors")
+                    keep = [m for m in msgs if m.get("kind") != "tick"]
+                    n_ticks = len(msgs) - len(keep)
+                    if n_ticks:
+                        self.metrics.count("routed_ticks_lost", n_ticks)
+                    if keep:
+                        self.metrics.count("control_requeued", len(keep))
+                        self._outgoing[wid] = \
+                            keep + self._outgoing.get(wid, [])
+                    log.warning(
+                        "router: shared-bus publish for %s failed: %s",
+                        wid, e)
+                    continue
                 self.metrics.observe("route", self.clock() - t0)
                 if tracing:
                     t1_ns = now_ns()
@@ -518,8 +643,12 @@ class FleetRouter:
         if (not self._links
                 or any(wid not in self._links
                        for wid in self.membership.workers)):
-            rows.extend(
-                (r.offset, r.value) for r in self._results.poll())
+            try:
+                rows.extend(
+                    (r.offset, r.value) for r in self._results.poll())
+            except (ConnectionError, OSError) as e:
+                self.metrics.count("bus_errors")
+                log.warning("shared-bus results poll failed: %s", e)
         return self._fold_results(rows)
 
     def _ensure_link(self, worker_id: str, address: Optional[str]) -> None:
@@ -538,10 +667,26 @@ class FleetRouter:
             log.error("cannot connect %s data bus at %s: %s",
                       worker_id, address, e)
             return
+        resume = self._link_resume.pop((worker_id, address), None)
+        if resume is None:
+            # start at the bus's END, not 0: a fresh worker's bus is
+            # empty (end == 0, identical), but a TAKEOVER (this router
+            # restarted while the worker kept serving) must not re-read
+            # every result the dead router already consumed — those
+            # ticks were never routed by this incarnation and would
+            # only flood results_unmatched
+            resume = 0
+            end = getattr(bus, "end_offset", None)
+            if end is not None:
+                try:
+                    resume = int(end(self.prediction_topic))
+                except (ConnectionError, OSError, RuntimeError, KeyError):
+                    resume = 0
         self._links[worker_id] = _WorkerLink(
-            address=address, bus=bus,
-            results_offset=self._link_resume.pop((worker_id, address), 0))
-        log.info("data link to %s at %s", worker_id, address)
+            address=address, bus=bus, results_offset=resume)
+        self._linked_ever.add(worker_id)
+        log.info("data link to %s at %s (results from %d)",
+                 worker_id, address, resume)
 
     def _close_link(self, worker_id: str, *, resume: bool = False) -> None:
         """Drop a worker's data link.  ``resume`` (transient link error:
@@ -564,10 +709,60 @@ class FleetRouter:
                 except OSError:
                     pass
 
+    def _drop_aged_ticks(self, worker_id: str, msgs: List[dict]) -> List[dict]:
+        """Filter ticks that aged out of the in-flight table from a
+        batch held across a link outage: they are already counted
+        ``results_missing``, so delivering them late would serve a tick
+        the accounting wrote off (counted twice) — and dropping them
+        caps a long partition's hold at ``max_inflight_ticks`` instead
+        of letting it grow without bound.  Control messages always
+        survive the hold (a migration must not strand on a dropped
+        drain marker)."""
+        now = self.clock()
+        timeout = self.cfg.result_timeout_s
+        kept = []
+        for m in msgs:
+            if m.get("kind") == "tick":
+                entry = self._inflight.get((m["session"], m["seq"]))
+                # expired-but-unswept ticks are dropped too: the sweep
+                # at the end of this pump will count them, and a re-link
+                # landing in the same pump must not deliver them first
+                if entry is None or now - entry[0] > timeout:
+                    continue
+            kept.append(m)
+        aged = len(msgs) - len(kept)
+        if aged:
+            self.metrics.count("routed_ticks_lost", aged)
+            log.warning(
+                "dropped %d held ticks for %s that aged out awaiting a "
+                "re-link", aged, worker_id)
+        return kept
+
+    def _drop_outgoing(self, worker_id: str) -> None:
+        """Discard a departed worker's pending batch (held for a
+        re-link that will never happen) — counted, never silent; its
+        sessions are reopened elsewhere by the same rebalance."""
+        self._held_outgoing.discard(worker_id)
+        msgs = self._outgoing.pop(worker_id, None)
+        if not msgs:
+            return
+        n_ticks = sum(1 for m in msgs if m.get("kind") == "tick")
+        if n_ticks:
+            self.metrics.count("routed_ticks_lost", n_ticks)
+        self.metrics.count("outgoing_dropped", len(msgs))
+        log.warning(
+            "dropped %d pending messages for departed worker %s "
+            "(%d ticks)", len(msgs), worker_id, n_ticks)
+
     def _fold_results(self, rows) -> List[FleetResult]:
         results: List[FleetResult] = []
         for _offset, v in rows:
             sid, seq = v.get("session"), v.get("seq")
+            if sid is None or seq is None:
+                # not a result at all (a corrupted/foreign record on
+                # the results topic) — count it, never crash on it
+                self.metrics.count("results_undecodable")
+                continue
             entry = self._inflight.pop((sid, seq), None)
             if entry is not None:
                 t_submit, ref = entry
@@ -609,33 +804,92 @@ class FleetRouter:
     def _handle_control(self, msg: dict) -> None:
         kind = msg.get("kind")
         if kind in (HELLO, HEARTBEAT, GOODBYE):
+            wid = msg.get("worker")
             if kind == HELLO:
-                # a hello is a fresh process whose data bus restarts at
-                # offset 0 — any resume position saved from a previous
-                # incarnation's transient link error is now wrong
-                self._close_link(msg.get("worker"))
+                # a session-LESS hello is a fresh process whose data bus
+                # restarts at offset 0 — purge any saved resume position.
+                # A hello WITH sessions is the SAME incarnation re-dialing
+                # the control plane (its data bus kept serving the whole
+                # time): save the results read position so the re-link
+                # resumes where this one stopped instead of jumping to
+                # end and skipping unread results
+                self._close_link(wid, resume=bool(msg.get("sessions")))
+                if not msg.get("address"):
+                    # a shared-bus incarnation of a previously linked id
+                    self._linked_ever.discard(wid)
+                if wid in self.membership.workers \
+                        and not msg.get("sessions"):
+                    # a session-less hello of a LIVE id: the process was
+                    # killed and revived inside the heartbeat timeout —
+                    # membership never noticed, but the carried state
+                    # died with the old incarnation.  Same consequence
+                    # as a detected death: reopen its sessions fresh,
+                    # counted.  (A hello WITH sessions is the other
+                    # direction — a control-plane reconnect of the same
+                    # incarnation — and adopts below instead.)
+                    self.metrics.count("worker_restarts")
+                    self._drop_outgoing(wid)
+                    self._reopen_for_restart(wid)
             if kind != GOODBYE:
                 # link before rebalance: a join's first drain markers
                 # and opens must have somewhere to land
-                self._ensure_link(msg.get("worker"), msg.get("address"))
+                if msg.get("address"):
+                    self._ensure_link(wid, msg["address"])
+                else:
+                    # shared-bus worker: its inbox rides THIS bus, and
+                    # the launch-time topic set only covers the initial
+                    # fleet — admit the topic so a late joiner is
+                    # routable (ROADMAP (c); idempotent on all backends)
+                    add = getattr(self.bus, "add_topic", None)
+                    if add is not None:
+                        add(fleet_worker_topic(wid))
+            adopted = 0
+            if kind == HELLO and msg.get("sessions"):
+                # router failover: the hello of a worker that was
+                # already serving (this router restarted, or the worker
+                # re-dialed a new router) carries its open-session map;
+                # the registry is rebuilt from it — the workers own the
+                # truth about what is being served (docs/chaos.md)
+                adopted = self._adopt_sessions(wid, msg["sessions"])
             event = self.membership.observe(msg)
             if event == "join":
                 self.metrics.count("workers_joined")
-                self._stops_sent.discard(msg.get("worker"))
-                self._rebalance(f"worker join: {msg.get('worker')}")
-            elif event == "leave":
+                self._stops_sent.discard(wid)
+                self._rebalance(f"worker join: {wid}")
+            elif adopted:
+                # adopted sessions on a non-join hello still need their
+                # hash-table placement checked (migrations if the table
+                # maps them elsewhere)
+                self._rebalance(f"adopted {adopted} sessions from {wid}")
+            if event == "leave":
                 self.metrics.count("workers_left")
                 # drop the link before the next pump would error on it
-                self._close_link(msg.get("worker"))
-                self._stops_sent.discard(msg.get("worker"))
-                self._rebalance(f"worker leave: {msg.get('worker')}")
+                self._close_link(wid)
+                self._stops_sent.discard(wid)
+                self._report_pending.discard(wid)
+                self._drop_outgoing(wid)
+                self._rebalance(f"worker leave: {wid}")
             elif kind == GOODBYE:
                 # a released leaving worker's goodbye: already out of
                 # live(), nothing to rebalance — just drop its link
-                self._close_link(msg.get("worker"))
-                self._stops_sent.discard(msg.get("worker"))
+                self._close_link(wid)
+                self._stops_sent.discard(wid)
+                self._report_pending.discard(wid)
+                self._drop_outgoing(wid)
+            else:
+                # takeover detection: a beating worker serving more
+                # sessions than this router's registry credits it with
+                # means the registry predates us (we restarted) — ask
+                # for the authoritative session map via its inbox
+                self._maybe_request_report(wid, msg.get("stats"))
         elif kind == "session_state":
             self._on_session_state(msg)
+        elif kind == "session_report":
+            wid = msg.get("worker")
+            self._report_pending.discard(wid)
+            adopted = self._adopt_sessions(wid, msg.get("sessions"))
+            if adopted:
+                self._rebalance(f"adopted {adopted} sessions from {wid}")
         elif kind == "leaving":
             self.request_leave(msg.get("worker"))
         elif kind == "open_failed":
@@ -644,6 +898,68 @@ class FleetRouter:
                 "worker %s could not open session %s: %s",
                 msg.get("worker"), msg.get("session"), msg.get("error"))
         # "ownership" announcements are our own — ignored on re-read
+
+    def _adopt_sessions(
+        self, worker_id: Optional[str], sessions: Optional[dict]
+    ) -> int:
+        """Fold a worker's authoritative session report into the
+        registry (router failover, docs/chaos.md): sessions this router
+        never heard of are registered with the reporter as owner, the
+        reported ``seq`` continuing the result stream with no gap or
+        collision, and the reported norm stats kept so a LATER owner
+        death can still reopen the session fresh.  Sessions the
+        registry already tracks are left alone — this router's view is
+        authoritative for everything it actually routed."""
+        if not worker_id or not sessions:
+            return 0
+        adopted = 0
+        for sid, info in sessions.items():
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                if sess.owner != worker_id and sess.status == "active":
+                    # two live workers claim one session (a protocol
+                    # breach upstream): the registry wins — visible,
+                    # and the reporter is told to drop its copy
+                    self.metrics.count("adoption_conflicts")
+                    self._enqueue(worker_id,
+                                  {"kind": "close", "session": sid})
+                    log.warning(
+                        "session %s reported by %s but owned by %s — "
+                        "close sent to the reporter",
+                        sid, worker_id, sess.owner)
+                continue
+            self._sessions[sid] = _Session(
+                sid, worker_id, info.get("norm"),
+                next_seq=int(info.get("seq", 0)))
+            adopted += 1
+        if adopted:
+            self.metrics.count("sessions_adopted", adopted)
+            self._sessions_changed()
+            log.info(
+                "adopted %d sessions from %s (registry rebuilt from "
+                "worker state)", adopted, worker_id)
+        return adopted
+
+    def _maybe_request_report(
+        self, worker_id: Optional[str], stats: Optional[dict]
+    ) -> None:
+        """Ask a worker for its session map when its heartbeat shows it
+        serving more sessions than the registry credits it with — the
+        restarted-router takeover path.  One request in flight per
+        worker; the reply (``session_report``) clears it."""
+        if not worker_id or worker_id in self._report_pending:
+            return
+        if not isinstance(stats, dict):
+            return
+        active = int(stats.get("active_sessions") or 0)
+        if not active:
+            return
+        owned = self._owned_counts().get(worker_id, 0)
+        if active <= owned:
+            return
+        self._report_pending.add(worker_id)
+        self._enqueue(worker_id, {"kind": "report_sessions"})
+        self.metrics.count("session_reports_requested")
 
     def request_leave(self, worker_id: Optional[str]) -> None:
         """Gracefully drain a worker out of the fleet: it keeps serving
@@ -661,7 +977,7 @@ class FleetRouter:
         window can never route sessions (or migrated state) into the
         stopping worker's inbox."""
         for wid in sorted(self.membership.leaving - self._stops_sent):
-            if any(s.owner == wid for s in self._sessions.values()):
+            if self._owned_counts().get(wid):
                 continue
             self._enqueue(wid, {"kind": "stop"})
             self._stops_sent.add(wid)
@@ -679,10 +995,17 @@ class FleetRouter:
             # the whole topology is exiting: goodbyes must not cascade
             # into pointless migrations between dying workers
             return
-        self.bus.publish(self.control_topic, {
-            "kind": "ownership", "table": self.table.to_wire(),
-            "reason": reason,
-        })
+        try:
+            self.bus.publish(self.control_topic, {
+                "kind": "ownership", "table": self.table.to_wire(),
+                "reason": reason,
+            })
+        except (ConnectionError, OSError) as e:
+            # the announcement is observability, not protocol (workers
+            # never consume it) — a down control bus must not abort a
+            # rebalance that only touches local state + worker inboxes
+            self.metrics.count("bus_errors")
+            log.warning("ownership announcement failed: %s", e)
         log.info(
             "ownership v%d over %s (%s)", self.table.version, live, reason)
         # "present" = still alive and serving its inbox, even if leaving
@@ -736,6 +1059,7 @@ class FleetRouter:
             # until one joins (the next rebalance re-enters here)
             sess.pending_state = msg["state"]
             sess.owner = None
+            self._owned_cache = None
             return
         self._complete_migration(sess, new_owner, msg["state"])
 
@@ -756,6 +1080,19 @@ class FleetRouter:
             "session %s migrated to %s (%d buffered ticks replayed)",
             sess.session_id, new_owner, replayed)
 
+    def _reopen_for_restart(self, worker_id: str) -> None:
+        """A live worker id came back as a fresh process (revive inside
+        the heartbeat window): every session it hosted lost its carried
+        state.  Reopen them fresh on their table owner — usually the
+        same id, now the new incarnation — through the same counted
+        path a detected death takes."""
+        for sess in list(self._sessions.values()):
+            if sess.owner != worker_id:
+                continue
+            if sess.mig is not None:
+                self.metrics.count("migrations_aborted")
+            self._reopen_lost(sess, self.table.owner_of(sess.session_id))
+
     def _reopen_lost(self, sess: _Session, new_owner: Optional[str]) -> None:
         """The owner died with the session's carried state: reopen fresh
         on the new owner (state restarts from zero — counted, documented
@@ -766,6 +1103,7 @@ class FleetRouter:
             # owner died; re-entering here on a later rebalance (a
             # worker finally joined) is placement, not a second loss
             self.metrics.count("sessions_lost_state")
+            self.lost_state_sessions.add(sess.session_id)
         sess.mig = None
         sess.pending_state = None
         if new_owner is None:
@@ -808,6 +1146,22 @@ class FleetRouter:
         """Release every data-plane link (shutdown)."""
         for wid in list(self._links):
             self._close_link(wid)
+
+    @property
+    def outstanding_ticks(self) -> int:
+        """Routed ticks not yet answered (or aged into a counter)."""
+        return len(self._inflight)
+
+    @property
+    def migrating_sessions(self) -> int:
+        """Sessions whose ticks are buffering (a migration or orphaned
+        reopen in flight) — the chaos soak's recovery barrier keys on
+        this reaching zero before it probes post-chaos serving."""
+        return len(self._migrating)
+
+    def open_session_ids(self) -> List[str]:
+        """Ids of every registered session (chaos-soak introspection)."""
+        return list(self._sessions)
 
     def worker_stats(self) -> Dict[str, dict]:
         """Latest heartbeat-carried stats per worker (live + departed)."""

@@ -31,12 +31,15 @@ import struct
 import threading
 from typing import List, Optional, Sequence, Tuple
 
+from fmda_tpu.chaos.inject import default_chaos
 from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
 from fmda_tpu.stream.bus import Consumer, Record
 
 log = logging.getLogger("fmda_tpu.fleet")
 
 _TRACER = default_tracer()
+#: chaos injection (fmda_tpu.chaos): disabled = one branch per request
+_CHAOS = default_chaos()
 
 #: Frame-size ceiling (4-byte length prefix allows 4 GiB; a frame this
 #: large is a bug, not a batch).
@@ -239,6 +242,14 @@ class BusServer:
             return [[r.offset, r.value] for r in records]
         if op == "end_offset":
             return bus.end_offset(req["topic"])
+        if op == "add_topic":
+            add = getattr(bus, "add_topic", None)
+            if add is None:
+                raise RuntimeError(
+                    f"backing bus {type(bus).__name__} cannot create "
+                    f"topic {req['topic']!r} dynamically")
+            add(req["topic"])
+            return True
         if op == "base_offset":
             base = getattr(bus, "base_offset", None)
             return base(req["topic"]) if base is not None else 0
@@ -310,6 +321,11 @@ class SocketBus:
     # -- request plumbing ---------------------------------------------------
 
     def _request(self, req: dict) -> object:
+        if _CHAOS.enabled:
+            # injection point "wire.request": a kill/partition window
+            # raises ChaosFault (a ConnectionError — exactly the failure
+            # every caller already hardens against); delay windows sleep
+            _CHAOS.check("wire.request")
         with self._lock:
             try:
                 self._io.send_frame(req)
@@ -385,6 +401,12 @@ class SocketBus:
 
     def base_offset(self, topic: str) -> int:
         return int(self._request({"op": "base_offset", "topic": topic}))
+
+    def add_topic(self, topic: str) -> None:
+        """Create a topic on the served bus (idempotent; raises if the
+        backing bus cannot create topics dynamically)."""
+        self._request({"op": "add_topic", "topic": topic})
+        self._topics = None  # the cached layout just changed
 
     def topics(self) -> Sequence[str]:
         if self._topics is None:

@@ -22,6 +22,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from fmda_tpu.chaos.inject import default_chaos
 from fmda_tpu.config import (
     FleetTopologyConfig,
     RuntimeConfig,
@@ -33,6 +34,7 @@ from fmda_tpu.fleet.state import (
     decode_norm,
     decode_row,
     decode_session_state,
+    encode_array,
     encode_session_state,
 )
 from fmda_tpu.runtime.batcher import BatcherConfig
@@ -40,6 +42,9 @@ from fmda_tpu.runtime.gateway import FleetGateway
 from fmda_tpu.runtime.session_pool import PoolExhausted, SessionPool
 
 log = logging.getLogger("fmda_tpu.fleet")
+
+#: chaos injection (fmda_tpu.chaos): disabled = one branch per step
+_CHAOS = default_chaos()
 
 
 class FleetWorker:
@@ -61,6 +66,7 @@ class FleetWorker:
         gateway_kwargs: Optional[dict] = None,
         data_bus=None,
         data_address: Optional[str] = None,
+        reconnect_fn: Optional[Callable[[], object]] = None,
     ) -> None:
         self.worker_id = worker_id
         self.bus = bus
@@ -101,6 +107,19 @@ class FleetWorker:
             self._pub = BufferedPublisher(bus)
         else:
             self._pub = bus  # control messages go straight out
+        # dynamic topic creation (ROADMAP (c)): a worker joining beyond
+        # the bus's launch-time topic set brings its own inbox (and the
+        # shared results topic) with it — NativeBus/InProcessBus/KafkaBus
+        # and the wire transport all speak add_topic; buses without it
+        # keep the old contract (topics pre-created at construction)
+        from fmda_tpu.config import TOPIC_FLEET_PREDICTION
+
+        add_topic = getattr(self.data_bus, "add_topic", None)
+        if add_topic is not None:
+            for topic in (fleet_worker_topic(worker_id),
+                          TOPIC_FLEET_PREDICTION):
+                if topic not in self.data_bus.topics():
+                    add_topic(topic)
         self.gateway = FleetGateway(
             self.pool,
             self.data_bus if self._split else self._pub,
@@ -117,6 +136,22 @@ class FleetWorker:
         self.stopped = False
         #: next inbox offset we expect (gap ⇒ records evicted unread)
         self._next_offset: Optional[int] = None
+        #: rebuilds the control-bus connection after a transport failure
+        #: (the CLI passes a SocketBus re-dial); None = no reconnect
+        self._reconnect_fn = reconnect_fn
+        #: control plane currently unreachable (beats failing) — the
+        #: worker keeps serving its local data plane and re-dials on a
+        #: cadence; a reconnect re-hellos WITH the session report, which
+        #: is how a restarted router adopts this worker's sessions
+        self._control_down = False
+        #: migrations whose exported state never left this process
+        #: (control publish failed): session -> mig id, re-drained and
+        #: re-exported once the control plane answers again — without
+        #: this the router would wait on a ``session_state`` that is
+        #: never coming and the session would buffer forever
+        self._failed_drains: Dict[str, Optional[str]] = {}
+        self._last_reconnect: float = float("-inf")
+        self._first_bus_error: Optional[float] = None
         if precompile:
             # one padding-only flush per bucket: every program the tick
             # path can need exists before the first real tick, so
@@ -131,10 +166,40 @@ class FleetWorker:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
-        """Announce membership (the router rebalances on the hello)."""
-        self.heartbeater.hello(self.stats())
+        """Announce membership (the router rebalances on the hello).
+        The hello carries this worker's open-session report, so a
+        router that restarted while we kept serving rebuilds its
+        registry from the re-hello alone (failover, docs/chaos.md)."""
+        self._hello_with_report()
         if self._batch_bus is not None:
             self._pub.flush()  # the hello must not wait for a step
+
+    def _hello_with_report(self) -> None:
+        """Hello carrying the open-session report — the router-failover
+        handshake (start, shared-bus retry, and control re-dial all
+        announce this worker the same way; a new or restarted router
+        rebuilds its registry from exactly this message)."""
+        report = self.session_report()
+        self.heartbeater.hello(
+            self.stats(), extra={"sessions": report} if report else None)
+
+    def session_report(self) -> Dict[str, dict]:
+        """Authoritative open-session map: id → next result ``seq`` +
+        normalization stats (wire form).  This is what router failover
+        rebuilds the session registry from — the workers, not the dead
+        router, own the truth about what is being served."""
+        out: Dict[str, dict] = {}
+        for sid in self.pool.session_ids():
+            handle = self.pool.handle_for(sid)
+            x_min, x_range = self.pool.slot_norm(handle)
+            out[sid] = {
+                "seq": self.gateway.session_seq(sid),
+                "norm": {
+                    "x_min": encode_array(x_min),
+                    "x_max": encode_array(x_min + x_range),
+                },
+            }
+        return out
 
     def stats(self) -> Dict[str, object]:
         """The serving stats every heartbeat carries."""
@@ -157,9 +222,16 @@ class FleetWorker:
         the gateway, heartbeat if due.  Returns an activity count
         (inbox records applied + results published) — zero means idle,
         which the run loop's poll backoff keys on."""
+        if _CHAOS.enabled:
+            # injection point "worker.step": delay/hang stalls the loop
+            # (the false-reap / late-heartbeat shape); kill raises a
+            # ConnectionError the run loop's hardening absorbs
+            _CHAOS.check("worker.step")
         # beat first: a long pump last cycle must not push two beats
         # more than one step duration apart
-        self.heartbeater.beat(self.stats())
+        self._beat_counted()
+        if self._failed_drains and not self._control_down:
+            self._retry_failed_drains()
         processed = 0
         for rec in self._poll_inbox():
             processed += 1
@@ -180,6 +252,72 @@ class FleetWorker:
                 break
         served = len(self.gateway.pump())
         return processed + served
+
+    def _beat_counted(self) -> None:
+        """Heartbeat with the control plane's failure absorbed: a worker
+        whose router (or broker) vanished keeps serving its local data
+        plane — counted degradation, never abort.  While down, the
+        control bus is re-dialed on a cadence; success re-hellos with
+        the session report (a restarted router adopts us from it)."""
+        try:
+            if self._control_down:
+                self._maybe_reconnect_control()
+                return
+            self.heartbeater.beat(self.stats())
+        except (ConnectionError, OSError) as e:
+            self.metrics.count("control_errors")
+            if not self._control_down:
+                log.warning(
+                    "worker %s: control plane unreachable (%s) — serving "
+                    "continues, re-dialing%s", self.worker_id, e,
+                    "" if self._reconnect_fn else " on the same bus")
+            self._control_down = True
+
+    def _maybe_reconnect_control(self) -> None:
+        now = self.clock()
+        if now - self._last_reconnect < self.cfg.control_retry_s:
+            return
+        self._last_reconnect = now
+        if self._reconnect_fn is None:
+            # no transport to rebuild (shared-broker worker): retry the
+            # SAME bus on the cadence — one transient publish error must
+            # not mute a healthy worker's heartbeats forever (the router
+            # would falsely reap it and lose real carried state).  The
+            # re-hello carries the session report, same as a re-dial.
+            try:
+                self._hello_with_report()
+            except (ConnectionError, OSError):
+                self.metrics.count("control_reconnect_failures")
+                return
+            self._control_down = False
+            self.metrics.count("control_reconnects")
+            log.info(
+                "worker %s: control plane recovered", self.worker_id)
+            return
+        try:
+            new_bus = self._reconnect_fn()
+        except (ConnectionError, OSError):
+            self.metrics.count("control_reconnect_failures")
+            return
+        old = self.bus
+        self.bus = new_bus
+        # reconnect is a split-topology feature (the data plane is local,
+        # only control traffic rides this bus); a shared-bus worker that
+        # lost its one broker exits after the grace instead (run loop)
+        self._pub = new_bus
+        self.heartbeater.bus = new_bus
+        self._control_down = False
+        self.metrics.count("control_reconnects")
+        log.info("worker %s: control plane reconnected", self.worker_id)
+        close = getattr(old, "close", None)
+        if close is not None:
+            try:
+                close()
+            except OSError:
+                pass
+        # re-hello with the session report: a NEW router on the other
+        # end rebuilds its registry from exactly this message
+        self._hello_with_report()
 
     def _poll_inbox(self):
         """Inbox records for this step.  Over a batched SocketBus, one
@@ -238,7 +376,35 @@ class FleetWorker:
                     self.worker_id)
                 self._shutdown()
                 break
-            activity = self.step()
+            try:
+                activity = self.step()
+            except (ConnectionError, OSError) as e:
+                # the shared bus (inbox + results in one broker) went
+                # away mid-step: counted, retried under a grace window,
+                # and — if the broker never returns — a CLEAN exit, not
+                # a crash (the never-abort contract; a split-topology
+                # worker instead keeps serving through _beat_counted)
+                self.metrics.count("bus_errors")
+                now = self.clock()
+                if self._first_bus_error is None:
+                    self._first_bus_error = now
+                    log.warning(
+                        "worker %s: bus transport failed (%s); retrying "
+                        "for %.0fs", self.worker_id, e,
+                        self.cfg.bus_error_grace_s)
+                if now - self._first_bus_error > self.cfg.bus_error_grace_s:
+                    lost = len(self.gateway.batcher)
+                    if lost:
+                        self.metrics.count("ticks_lost_on_exit", lost)
+                    log.error(
+                        "worker %s: bus unreachable for %.0fs — exiting "
+                        "cleanly (%d queued ticks lost, counted)",
+                        self.worker_id, now - self._first_bus_error, lost)
+                    self.stopped = True
+                    break
+                sleep_fn(min(0.5, poll_interval_s * 50 + 0.05))
+                continue
+            self._first_bus_error = None
             if activity:
                 idle_sleep = poll_interval_s
             else:
@@ -278,10 +444,19 @@ class FleetWorker:
             self._on_close(msg)
         elif kind == "drain_session":
             self._on_drain_session(msg)
+        elif kind == "report_sessions":
+            # a router that restarted mid-serve asks for the session map
+            # it lost; the reply is the same shape the hello carries
+            self._publish_control_counted({
+                "kind": "session_report",
+                "worker": self.worker_id,
+                "sessions": self.session_report(),
+            })
+            self.metrics.count("session_reports")
         elif kind == "leave":
             # operator-initiated graceful leave: tell the router, which
             # migrates our sessions off and stops us when none remain
-            self._pub.publish(self.control_topic, {
+            self._publish_control_counted({
                 "kind": "leaving", "worker": self.worker_id})
             self.metrics.count("leave_requested")
         elif kind in ("drain_all", "stop"):
@@ -292,9 +467,39 @@ class FleetWorker:
                 "worker %s: unknown inbox message kind %r",
                 self.worker_id, kind)
 
+    def _publish_control_counted(self, msg: dict) -> bool:
+        """Control-topic publish with the transport failure absorbed
+        (counted ``control_errors``); returns whether it landed.  The
+        chaos contract: losing a control message degrades the fleet
+        visibly — it must never crash the serving loop."""
+        try:
+            self._pub.publish(self.control_topic, msg)
+            return True
+        except (ConnectionError, OSError) as e:
+            self.metrics.count("control_errors")
+            self._control_down = True
+            log.warning(
+                "worker %s: control publish (%s) failed: %s",
+                self.worker_id, msg.get("kind"), e)
+            return False
+
     def _on_open(self, msg: dict) -> None:
         sid = msg["session"]
         if self.pool.handle_for(sid) is not None:
+            state = msg.get("state")
+            if (state is not None
+                    and self.gateway.session_seq(sid) > int(state["seq"])):
+                # a requeued duplicate of an open this session already
+                # served past (the original frame landed but its response
+                # read failed): re-importing the snapshot would silently
+                # roll the carried state back — keep the newer state
+                self.metrics.count("duplicate_opens_stale")
+                log.warning(
+                    "worker %s: stale duplicate open(+state) for %s "
+                    "(snapshot seq %d < live seq %d) — ignored",
+                    self.worker_id, sid, int(state["seq"]),
+                    self.gateway.session_seq(sid))
+                return
             # a duplicate open is a protocol violation upstream; recover
             # by replacing (the router's registry is authoritative)
             self.metrics.count("duplicate_opens")
@@ -314,7 +519,7 @@ class FleetWorker:
         except PoolExhausted:
             # counted at the gateway too (rejected_sessions); tell the
             # router so the failure is visible fleet-wide
-            self._pub.publish(self.control_topic, {
+            self._publish_control_counted({
                 "kind": "open_failed",
                 "worker": self.worker_id,
                 "session": sid,
@@ -334,12 +539,19 @@ class FleetWorker:
             # the floor by the worker itself)
             self.gateway.pump(force=True)
             self.metrics.count("forced_pumps")
-        seq = self.gateway.submit(sid, row, wire=msg.get("trace"))
-        if seq != msg.get("seq", seq):
-            # the router's and gateway's per-session counters are in
-            # lockstep by construction — divergence means a protocol
-            # bug, worth a loud counter while results still flow
-            self.metrics.count("seq_mismatch")
+        expected = msg.get("seq")
+        if (expected is not None
+                and self.gateway.session_seq(sid) != expected):
+            # the streams diverged — ticks were lost in transit (a
+            # partitioned link's frame, counted router-side).  Resync
+            # to the router's counter: without this, every later
+            # result would match the WRONG in-flight tick forever;
+            # with it, exactly the lost ticks age out as
+            # results_missing and the stream re-aligns.  Counted —
+            # divergence is a failure event, never silent.
+            self.metrics.count("seq_resyncs")
+            self.gateway.resync_seq(sid, int(expected))
+        self.gateway.submit(sid, row, wire=msg.get("trace"))
 
     def _on_close(self, msg: dict) -> None:
         sid = msg["session"]
@@ -353,6 +565,7 @@ class FleetWorker:
         session bit-exact, hand the state to the router via the control
         topic, release the slot."""
         sid = msg["session"]
+        self._failed_drains.pop(sid, None)
         if self.pool.handle_for(sid) is None:
             self.metrics.count("drain_for_unknown_session")
             log.warning(
@@ -368,12 +581,74 @@ class FleetWorker:
         # buffered AFTER the drained results, so the broker lands every
         # pre-drain result before the state (the router's ordering
         # argument leans on exactly this)
-        self._pub.publish(self.control_topic, {
+        landed = self._publish_control_counted({
             "kind": "session_state",
             "worker": self.worker_id,
             "session": sid,
             "mig": msg.get("mig"),
             "state": state,
         })
+        if landed and self._batch_bus is not None:
+            # over a batched SocketBus the publish above only QUEUED the
+            # state in the BufferedPublisher — push the frame out now
+            # and find out whether it actually landed.  Closing the
+            # session on a buffered-but-unsent export would destroy the
+            # only copy the moment the next batch frame failed.
+            landed = self._flush_control_batched()
+        if not landed:
+            # the exported state never left this process: closing the
+            # session now would destroy the only copy.  Keep serving it
+            # and retry from the step loop once the control plane is
+            # back (a retry re-drains and re-exports, so the state is
+            # current; the stale mig id on any late duplicate is
+            # ignored router-side)
+            self.metrics.count("drain_export_failed")
+            self._failed_drains[sid] = msg.get("mig")
+            return
         self.gateway.close_session(sid)
         self.metrics.count("sessions_migrated_out")
+
+    def _flush_control_batched(self) -> bool:
+        """Flush the BufferedPublisher in one batched frame and report
+        whether every control-topic op landed.  Values in failed ops are
+        lost — counted exactly like ``_poll_inbox``'s batched-publish
+        failures (the dropped results age into ``results_missing``
+        router-side)."""
+        ops = self._pub.take_ops()
+        if not ops:
+            return True
+        try:
+            resps = self._batch_bus.batch(ops)
+        except (ConnectionError, OSError) as e:
+            self.metrics.count("control_errors")
+            self.metrics.count(
+                "publish_errors",
+                sum(len(op.get("values", ())) for op in ops))
+            log.warning(
+                "worker %s: control flush failed: %s", self.worker_id, e)
+            return False
+        ok = True
+        for op, resp in zip(ops, resps):
+            if "err" in resp:
+                self.metrics.count(
+                    "publish_errors", len(op.get("values", ())))
+                log.error(
+                    "worker %s: batched publish to %r failed: %s",
+                    self.worker_id, op.get("topic"), resp["err"])
+                if op.get("topic") == self.control_topic:
+                    ok = False
+        return ok
+
+    def _retry_failed_drains(self) -> None:
+        """Re-run the drain for every migration whose state export
+        failed, now that the control plane answers again.  Each retry
+        re-exports fresh state (the session kept serving meanwhile), so
+        the router never imports a stale snapshot."""
+        for sid, mig in list(self._failed_drains.items()):
+            if self.pool.handle_for(sid) is None:
+                self._failed_drains.pop(sid, None)  # closed meanwhile
+                continue
+            self.metrics.count("drain_export_retries")
+            self._on_drain_session({"session": sid, "mig": mig})
+            if sid in self._failed_drains:
+                return  # control plane still down — keep the rest queued

@@ -166,8 +166,40 @@ class Observability:
             tracer = default_tracer()
             self.registry.register_collector(
                 "tracing", lambda: tracer_families(tracer))
+            # injected-fault accounting (fmda_tpu.chaos): empty while
+            # chaos is off; under a fault plan every triggered effect is
+            # a counted series and the first fire of each window lands
+            # in the event log — injected chaos is itself counted
+            # degradation, never silence (docs/chaos.md)
+            from fmda_tpu.chaos.inject import chaos_families, default_chaos
+
+            chaos = default_chaos()
+            self.registry.register_collector(
+                "chaos", lambda: chaos_families(chaos))
+            # latest instance wins (same discipline as the collector
+            # registration above): a first-one-wins guard would pin a
+            # discarded instance's event log — and the whole instance
+            # with it — for the process lifetime
+            # ("fault", not "kind": the latter is emit()'s positional —
+            # the collision would TypeError inside the observer guard
+            # and silently drop every fault event)
+            chaos.on_fault = (
+                lambda point, kind, step: self.events.emit(
+                    "chaos_fault", point=point, fault=kind, step=step))
         self.clock = clock
         self.checks: Dict[str, HealthCheck] = {}
+        if self.registry.enabled:
+            # surfaced on /healthz so an operator can always tell a
+            # chaos drill from a real incident; injected faults never
+            # flip health to degraded — the drill is the healthy state
+            def check_chaos():
+                c = default_chaos()
+                if not c.enabled:
+                    return True, "disabled"
+                return True, (
+                    f"ACTIVE step={c.step} injected={c.injected_total()}")
+
+            self.checks["chaos"] = check_chaos
         self.server = None
         self._last_tick: Optional[float] = None
 

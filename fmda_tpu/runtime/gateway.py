@@ -228,6 +228,24 @@ class FleetGateway:
         state["seq"] = self._seq.get(session_id, 0)
         return state
 
+    def session_seq(self, session_id: str) -> int:
+        """The next result sequence number of an open session — what a
+        worker's session report carries so a restarted router resumes
+        the stream with no gap or collision (fmda_tpu.fleet failover)."""
+        if self.pool.handle_for(session_id) is None:
+            raise KeyError(f"no open session {session_id!r}")
+        return self._seq.get(session_id, 0)
+
+    def resync_seq(self, session_id: str, seq: int) -> None:
+        """Jump a session's sequence counter to the router's (fleet
+        worker use): after ticks were lost in transit — a partitioned
+        link's frame — the streams diverge by the loss count, and
+        without a resync every later result would match the WRONG
+        in-flight tick forever.  The caller counts the divergence."""
+        if self.pool.handle_for(session_id) is None:
+            raise KeyError(f"no open session {session_id!r}")
+        self._seq[session_id] = int(seq)
+
     def import_session(self, session_id: str, state: dict) -> SessionHandle:
         """Open a session from an :meth:`export_session` snapshot (the
         receiving end of a migration): allocates a slot, loads the
@@ -508,11 +526,19 @@ class FleetGateway:
                 # one batched publish per flush: one lock acquisition /
                 # native call sequence instead of per-tick bus overhead
                 t_pub0_ns = now_ns() if tracing else 0
-                if self._publish_many is not None:
-                    self._publish_many(self.prediction_topic, messages)
-                else:
-                    for msg in messages:
-                        self.bus.publish(self.prediction_topic, msg)
+                try:
+                    if self._publish_many is not None:
+                        self._publish_many(self.prediction_topic, messages)
+                    else:
+                        for msg in messages:
+                            self.bus.publish(self.prediction_topic, msg)
+                except Exception:
+                    # the transport failed AFTER the state advance —
+                    # _complete_counted marks the ticks lost; this
+                    # counter splits "bus down" from "transfer failed"
+                    # on dashboards (the chaos soak keys on it)
+                    self.metrics.count("publish_errors")
+                    raise
         t_publish = self.clock()
 
         m = self.metrics

@@ -39,6 +39,19 @@ class FleetLoadConfig:
     storm_every: int = 0
     #: Fraction of sessions hit per storm burst.
     storm_fraction: float = 0.25
+    #: Synchronized burst (the market-open spike): every ``burst_every``
+    #: rounds, EVERY session ticks — duty and the slow-drip set are
+    #: overridden — for ``burst_rounds`` consecutive rounds, so the
+    #: largest bucket, the queue bound, and the shedder all get hit at
+    #: once.  0 disables.
+    burst_every: int = 0
+    burst_rounds: int = 1
+    #: Slow-drip stragglers: this fraction of sessions tick at
+    #: ``slow_duty`` instead of ``duty`` — long-lived sessions that
+    #: barely tick keep slots pinned, drag the linger deadline, and
+    #: ragged-fill the small buckets (the anti-batching shape).
+    slow_fraction: float = 0.0
+    slow_duty: float = 0.05
 
 
 def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
@@ -68,9 +81,17 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
 
     # independent random walks (B, F), advanced only for sessions that tick
     walk = rng.normal(size=(load.n_sessions, feats)).astype(np.float32)
+    # the slow-drip straggler set is fixed for the whole load (the same
+    # long-lived barely-ticking clients every round, not a rotating one)
+    per_session_duty = np.full(load.n_sessions, load.duty)
+    n_slow = int(load.n_sessions * load.slow_fraction)
+    if n_slow:
+        slow_idx = rng.choice(load.n_sessions, size=n_slow, replace=False)
+        per_session_duty[slow_idx] = load.slow_duty
     submitted = 0
     served = 0
     reopened = 0
+    burst_ticks = 0
     t0 = time.perf_counter()
     for r in range(load.n_ticks):
         if load.storm_every and r and r % load.storm_every == 0:
@@ -85,7 +106,14 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
                 gateway.close_session(sid)
                 gateway.open_session(sid, NormParams(mins[i], maxs[i]))
                 reopened += 1
-        ticking = rng.random(load.n_sessions) < load.duty
+        in_burst = (load.burst_every and r >= load.burst_every
+                    and r % load.burst_every < load.burst_rounds)
+        if in_burst:
+            # market-open spike: everyone ticks, stragglers included
+            ticking = np.ones(load.n_sessions, bool)
+            burst_ticks += load.n_sessions
+        else:
+            ticking = rng.random(load.n_sessions) < per_session_duty
         steps = rng.normal(
             scale=0.1, size=(load.n_sessions, feats)).astype(np.float32)
         walk[ticking] += steps[ticking]
@@ -119,6 +147,10 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
     }
     if load.storm_every:
         out["sessions_reopened"] = reopened
+    if load.burst_every:
+        out["burst_ticks"] = burst_ticks
+    if n_slow:
+        out["slow_sessions"] = n_slow
     return out
 
 

@@ -372,6 +372,19 @@ class SessionPool:
     def handle_for(self, session_id: str) -> Optional[SessionHandle]:
         return self._by_id.get(session_id)
 
+    def session_ids(self) -> List[str]:
+        """Ids of every live session (the worker's session report —
+        router failover rebuilds its registry from these)."""
+        return list(self._by_id)
+
+    def slot_norm(self, handle: SessionHandle) -> tuple:
+        """One session's normalization stats as host ``(x_min, x_range)``
+        arrays — the cheap slice a session report carries (the full
+        :meth:`export_slot` hauls the ring too)."""
+        self.check(handle)
+        s = handle.slot
+        return np.asarray(self._x_min[s]), np.asarray(self._x_range[s])
+
     def ticks_seen(self, handle: SessionHandle) -> int:
         self.check(handle)
         return int(self._pos[handle.slot])

@@ -120,12 +120,15 @@ class InProcessBus:
         #: :meth:`bind_metrics` (fmda_tpu.obs); None = uninstrumented
         self._publish_counters = None
         self._consumed_cb = None
+        self._metrics_registry = None
 
     def bind_metrics(self, registry) -> None:
         """Report publish/consume totals per topic through a
         :class:`~fmda_tpu.obs.registry.MetricsRegistry`.  Counters are
         created once here, so the publish hot path pays one dict lookup
-        and one lock-guarded increment."""
+        and one lock-guarded increment; topics added later
+        (:meth:`add_topic`) get their counters on first touch."""
+        self._metrics_registry = registry
         self._publish_counters = {
             t: registry.counter("bus_published_total", topic=t)
             for t in self._logs
@@ -134,15 +137,38 @@ class InProcessBus:
             t: registry.counter("bus_consumed_total", topic=t)
             for t in self._logs
         }
-        self._consumed_cb = (
-            lambda topic, n: consume_counters[topic].inc(n)
-        )
+
+        def consumed(topic: str, n: int) -> None:
+            counter = consume_counters.get(topic)
+            if counter is None:
+                counter = consume_counters[topic] = registry.counter(
+                    "bus_consumed_total", topic=topic)
+            counter.inc(n)
+
+        self._consumed_cb = consumed
 
     def _check_topic(self, topic: str) -> None:
         if topic not in self._logs:
             raise KeyError(
                 f"unknown topic {topic!r}; configured: {sorted(self._logs)}"
             )
+
+    def add_topic(self, topic: str) -> None:
+        """Create a topic after construction (idempotent) — dynamic
+        membership needs this: a fleet worker joining beyond the
+        launch-time set brings its own inbox topic (ROADMAP (c)).  The
+        shared contract (all backends + the wire transport): an existing
+        topic keeps its log and offsets untouched."""
+        with self._lock:
+            if topic in self._logs:
+                return
+            self._logs[topic] = []
+            self._base[topic] = 0
+            self._next[topic] = 0
+        if self._publish_counters is not None:
+            registry = self._metrics_registry
+            self._publish_counters[topic] = registry.counter(
+                "bus_published_total", topic=topic)
 
     def publish(self, topic: str, value: dict) -> int:
         if _TRACER.enabled:  # in-band trace context + a bus-stage span

@@ -61,6 +61,15 @@ class KafkaBus:
                 f"unknown topic {topic!r}; configured: {sorted(self._topics)}"
             )
 
+    def add_topic(self, topic: str) -> None:
+        """Admit a topic after construction (idempotent).  Kafka brokers
+        auto-create topics on first produce (the reference deployment
+        relies on it), so this only widens the adapter's configured set
+        — the same dynamic-membership contract NativeBus/InProcessBus
+        implement by actually allocating a log."""
+        if topic not in self._topics:
+            self._topics = self._topics + (topic,)
+
     def publish(self, topic: str, value: dict) -> int:
         self._check(topic)
         if _TRACER.enabled:  # in-band trace context (fmda_tpu.obs.trace)

@@ -98,11 +98,28 @@ class NativeBus:
         #: by :meth:`bind_metrics`; the C++ log itself is uninstrumented
         self._publish_counters = None
         self._consumed_cb = None
+        self._metrics_registry = None
+
+    def add_topic(self, topic: str) -> None:
+        """Create a topic after construction (idempotent; the C++ side's
+        ``rb_topic`` registers-or-looks-up under its own mutex) — the
+        dynamic-membership entry point the fleet needs so a worker can
+        join beyond the launch-time inbox set (ROADMAP (c))."""
+        if topic in self._topic_ids:
+            return
+        tid = self._lib.rb_topic(self._handle, topic.encode())
+        if tid < 0:
+            raise NativeBusUnavailable(f"rb_topic({topic!r}) failed")
+        self._topic_ids[topic] = tid
+        if self._publish_counters is not None:
+            self._publish_counters[topic] = self._metrics_registry.counter(
+                "bus_published_total", topic=topic)
 
     def bind_metrics(self, registry) -> None:
         """Same per-topic publish/consume counters as
         :meth:`InProcessBus.bind_metrics` — counted in the Python wrapper,
         so cross-process writers bypassing this handle are not seen."""
+        self._metrics_registry = registry
         self._publish_counters = {
             t: registry.counter("bus_published_total", topic=t)
             for t in self._topic_ids
@@ -111,9 +128,15 @@ class NativeBus:
             t: registry.counter("bus_consumed_total", topic=t)
             for t in self._topic_ids
         }
-        self._consumed_cb = (
-            lambda topic, n: consume_counters[topic].inc(n)
-        )
+
+        def consumed(topic: str, n: int) -> None:
+            counter = consume_counters.get(topic)
+            if counter is None:
+                counter = consume_counters[topic] = registry.counter(
+                    "bus_consumed_total", topic=topic)
+            counter.inc(n)
+
+        self._consumed_cb = consumed
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

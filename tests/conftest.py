@@ -36,6 +36,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; the spawned-process chaos soak is the
+    # first slow-marked test — register the marker so it stays declared
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (long multi-process soaks; "
+        "run explicitly or via bench phases)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,552 @@
+"""Router failover + the documented failure matrix (ISSUE 7).
+
+The tentpole contract: a router restart never orphans a session — the
+new router rebuilds its registry from authoritative worker state
+(session reports: id → seq + norm), resumes routing, and surviving
+sessions produce the bit-identical output stream an unfaulted run
+produces.  Plus one test per docs/multihost.md failure-matrix row the
+chaos work added or sharpened, each asserting the documented counter
+fires exactly once.
+"""
+
+import numpy as np
+import pytest
+
+from test_fleet import FakeClock, _cycle, _setup, _topology
+
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    FleetTopologyConfig,
+    fleet_topics,
+)
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.fleet.router import FleetRouter
+from fmda_tpu.fleet.worker import FleetWorker
+from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+from fmda_tpu.stream.bus import InProcessBus, Record
+
+
+def _reference_run(cfg, params, norms, rows, sids, window):
+    """The unfaulted single-gateway stream: bucket 1, strictly serial."""
+    pool = SessionPool(cfg, params, capacity=8, window=window)
+    gw = FleetGateway(
+        pool, None,
+        batcher_config=BatcherConfig(bucket_sizes=(1,), max_linger_s=0.0),
+        pipeline_depth=0)
+    ref = {sid: [] for sid in sids}
+    for sid in sids:
+        gw.open_session(sid, norms[sid])
+    for r in range(rows[sids[0]].shape[0]):
+        for sid in sids:
+            gw.submit(sid, rows[sid][r])
+            for res in gw.drain():
+                ref[res.session_id].append(res.probabilities)
+    return ref
+
+
+def test_router_takeover_rebuilds_registry_bit_identical():
+    """Rounds 0..5 flow through router #1; it dies (no shutdown, no
+    drain handoff — just gone).  Router #2 starts from the end of the
+    control topic, learns the worker from its next beat, pulls the
+    session report through the worker's inbox, adopts every session at
+    the right seq, and rounds 6..11 flow through it — the combined
+    output stream must be bit-identical to an unfaulted run."""
+    feats, window, n_rounds = 6, 4, 12
+    cfg, params = _setup(feats=feats, window=window)
+    rng = np.random.default_rng(2)
+    sids = [f"T{i}" for i in range(4)]
+    norms, rows = {}, {}
+    for sid in sids:
+        mn = rng.normal(size=feats).astype(np.float32)
+        norms[sid] = NormParams(mn, mn + 2.0)
+        rows[sid] = rng.normal(size=(n_rounds, feats)).astype(np.float32)
+    ref = _reference_run(cfg, params, norms, rows, sids, window)
+
+    router, workers, bus, clock, _ = _topology(["w0"])
+    got = {sid: [] for sid in sids}
+
+    def absorb(r, results):
+        for res in results:
+            got[res.session_id].append((res.seq, res.probabilities))
+
+    for sid in sids:
+        router.open_session(sid, norms[sid])
+    for r in range(6):
+        for sid in sids:
+            router.submit(sid, rows[sid][r])
+        router.pump()
+        for w in workers.values():
+            w.step()
+        absorb(r, router.pump())
+    # everything answered before the crash (the takeover-with-inflight
+    # variant is test_router_death_with_inflight_* below)
+    for _ in range(4):
+        router.pump()
+        for w in workers.values():
+            w.step()
+        absorb(5, router.pump())
+    assert all(len(got[sid]) == 6 for sid in sids)
+
+    # router #1 vanishes; #2 starts with NOTHING but the live bus
+    router2 = FleetRouter(
+        bus, FleetTopologyConfig(
+            heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0),
+        n_features=feats, clock=clock, from_end=True)
+    # beats flow -> join -> report request -> session_report -> adopt
+    for _ in range(6):
+        for w in workers.values():
+            w.step()
+        router2.pump()
+        if len(router2.open_session_ids()) == len(sids):
+            break
+    assert sorted(router2.open_session_ids()) == sorted(sids)
+    c2 = router2.metrics.counters
+    assert c2["sessions_adopted"] == len(sids)
+    assert c2["session_reports_requested"] >= 1
+    # no session lost state, none reopened fresh
+    assert c2.get("sessions_lost_state", 0) == 0
+
+    for r in range(6, n_rounds):
+        for sid in sids:
+            router2.submit(sid, rows[sid][r])
+        router2.pump()
+        for w in workers.values():
+            w.step()
+        absorb(r, router2.pump())
+    for _ in range(4):
+        router2.pump()
+        for w in workers.values():
+            w.step()
+        absorb(n_rounds, router2.pump())
+
+    for sid in sids:
+        seqs = [s for s, _ in got[sid]]
+        assert seqs == list(range(n_rounds)), (sid, seqs)
+        for r in range(n_rounds):
+            np.testing.assert_array_equal(
+                got[sid][r][1], ref[sid][r],
+                err_msg=f"{sid} tick {r} diverged across the takeover")
+
+
+def test_worker_re_hello_with_sessions_adopts_without_report():
+    """The other failover direction: the worker re-dials a new router
+    and its hello carries the session report directly — adoption with
+    no report round trip."""
+    router, workers, bus, clock, _ = _topology(["w0"])
+    rng = np.random.default_rng(0)
+    router.open_session("S")
+    for _ in range(3):
+        router.submit("S", rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), {})
+    router2 = FleetRouter(
+        bus, FleetTopologyConfig(
+            heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0),
+        n_features=6, clock=clock, from_end=True)
+    workers["w0"].start()  # the reconnect path re-hellos with sessions
+    router2.pump()
+    assert router2.open_session_ids() == ["S"]
+    assert router2.metrics.counters["sessions_adopted"] == 1
+    assert router2.metrics.counters.get(
+        "session_reports_requested", 0) == 0
+    # the adopted seq continues the stream with no collision
+    assert router2.submit("S", np.zeros(6, np.float32)) == 3
+
+
+def test_fresh_incarnation_hello_reopens_sessions_counted_once():
+    """Failure row: a worker killed and revived INSIDE the heartbeat
+    window re-hellos session-less while membership still shows it live
+    — its carried state died with the old process, so its sessions
+    reopen fresh, `worker_restarts` and `sessions_lost_state` each
+    firing exactly once (per event / per session)."""
+    router, workers, bus, clock, (mcfg, mparams, rc) = _topology(["w0"])
+    rng = np.random.default_rng(1)
+    sids = ["A", "B"]
+    got = {}
+    for sid in sids:
+        router.open_session(sid)
+    for _ in range(3):
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    # the old incarnation dies silently; a fresh one hellos the same id
+    workers["w0"].stopped = True
+    w0b = FleetWorker("w0", bus, mcfg, mparams, config=router.cfg,
+                      runtime=rc, clock=clock, precompile=False)
+    w0b.start()
+    router.pump()
+    c = router.metrics.counters
+    assert c["worker_restarts"] == 1
+    assert c["sessions_lost_state"] == len(sids)
+    # streams continue on the new incarnation, fresh state, no collision
+    for sid in sids:
+        router.submit(sid, rng.normal(size=6).astype(np.float32))
+    for _ in range(4):
+        _cycle(router, [w0b], got)
+    for sid in sids:
+        seqs = [r.seq for r in got[sid]]
+        assert seqs == sorted(set(seqs))
+        assert seqs[-1] == 3
+
+
+def test_worker_death_with_inflight_ticks_counts_results_missing_exactly():
+    """Failure row: worker dies undrained with routed ticks unanswered —
+    after `result_timeout_s` each unanswered tick is counted
+    `results_missing` exactly once, and the loss total closes the
+    accounting identity (submitted == served + missing)."""
+    router, workers, _bus, clock, _ = _topology(["w0", "w1"])
+    rng = np.random.default_rng(3)
+    sids = [f"T{i}" for i in range(4)]
+    got = {}
+    for sid in sids:
+        router.open_session(sid)
+    for _ in range(2):  # served cleanly
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    served_before = sum(len(v) for v in got.values())
+    assert served_before == 8
+    victim = router.table.owner_of(sids[0])
+    survivor = "w1" if victim == "w0" else "w0"
+    victim_sids = [s for s in sids if router.table.owner_of(s) == victim]
+    workers[victim].stopped = True
+    # one more round routed while the victim is dead-but-undetected
+    for sid in sids:
+        router.submit(sid, rng.normal(size=6).astype(np.float32))
+    router.pump()
+    workers[survivor].step()
+    clock.advance(61.0)  # past heartbeat timeout AND result timeout
+    workers[survivor].step()  # survivor re-beats at the new now
+    for _ in range(6):
+        _cycle(router, [workers[survivor]], got)
+    c = router.metrics.counters
+    # exactly the victim's unanswered ticks aged out — no more, no less
+    assert c["results_missing"] == len(victim_sids)
+    served = sum(len(v) for v in got.values())
+    submitted = 3 * len(sids)
+    assert submitted == served + c["results_missing"]
+
+
+def test_router_death_with_inflight_ticks_counts_unmatched():
+    """Failure row: the router dies with ticks in flight; the worker
+    serves them anyway and the TAKEOVER router sees their results as
+    `results_unmatched` (it never routed them) — counted exactly once
+    each, never fatal."""
+    router, workers, bus, clock, _ = _topology(["w0"])
+    rng = np.random.default_rng(4)
+    router.open_session("S")
+    n = 3
+    for _ in range(n):
+        router.submit("S", rng.normal(size=6).astype(np.float32))
+    router.pump()  # ticks reach the inbox; results not yet consumed
+    # router #1 is gone; #2 starts before the worker serves them
+    router2 = FleetRouter(
+        bus, FleetTopologyConfig(
+            heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0),
+        n_features=6, clock=clock, from_end=True)
+    workers["w0"].step()  # serves + publishes the orphaned results
+    for _ in range(4):
+        router2.pump()
+        workers["w0"].step()
+    c2 = router2.metrics.counters
+    assert c2["results_unmatched"] == n
+    # and the takeover still adopted the session for future routing
+    assert router2.open_session_ids() == ["S"]
+
+
+def test_link_drop_during_migration_requeues_the_drain_marker():
+    """Failure row: the data link fails on the frame carrying a
+    `drain_session` marker — `link_errors` fires once, the marker is
+    requeued (idempotent control), and the migration completes after
+    the re-link instead of stranding the session in `migrating`."""
+
+    class FlakyLinkBus:
+        def __init__(self):
+            self.published = []
+            self.fail = False
+
+        def publish_many(self, topic, values):
+            if self.fail:
+                raise ConnectionError("link down")
+            self.published.extend(values)
+
+        def read(self, topic, offset):
+            if self.fail:
+                raise ConnectionError("link down")
+            return []
+
+        def end_offset(self, topic):
+            return 0
+
+        def close(self):
+            pass
+
+    clock = FakeClock()
+    bus = InProcessBus(
+        tuple(DEFAULT_TOPICS) + fleet_topics(["w0", "w1"]))
+    links = {"addr:0": FlakyLinkBus(), "addr:1": FlakyLinkBus()}
+    router = FleetRouter(
+        bus, FleetTopologyConfig(heartbeat_timeout_s=500.0),
+        n_features=4, clock=clock, connect_fn=lambda a: links[a])
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w0",
+                                  "address": "addr:0"})
+    router.pump()
+    router.open_session("S")
+    router.pump()
+    # w1 joins -> rebalance -> some sessions drain off w0
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w1",
+                                  "address": "addr:1"})
+    links["addr:0"].fail = True  # the drain frame will be lost
+    router.pump()
+    c = router.metrics.counters
+    if router.table.owner_of("S") == "w0":
+        pytest.skip("hash placed S on the joining worker — no drain")
+    assert c["migrations_started"] == 1
+    assert c["link_errors"] == 1
+    assert c["control_requeued"] >= 1
+    assert not any(m.get("kind") == "drain_session"
+                   for m in links["addr:0"].published)
+    # the link heals; the worker's beat re-links and the marker lands
+    links["addr:0"].fail = False
+    bus.publish("fleet_control", {"kind": "heartbeat", "worker": "w0",
+                                  "address": "addr:0"})
+    router.pump()
+    drains = [m for m in links["addr:0"].published
+              if m.get("kind") == "drain_session"]
+    assert len(drains) == 1  # requeued exactly once, not duplicated
+    # the export flows back on the control topic and completes as usual
+    bus.publish("fleet_control", {
+        "kind": "session_state", "worker": "w0", "session": "S",
+        "mig": drains[0]["mig"],
+        "state": {"seq": 0, "carry": [], "ring": None, "pos": 0,
+                  "x_min": None, "x_range": None},
+    })
+    router.pump()
+    assert c["migrations_completed"] == 1
+
+
+def test_held_ticks_that_age_out_are_dropped_not_served_late():
+    """Failure row sharpened: during a long data-link outage (heartbeats
+    still flowing), ticks held for the re-link age into
+    `results_missing` — the re-link must NOT deliver them afterwards
+    (serving a written-off tick would count it twice), and the hold must
+    never grow past the in-flight bound."""
+
+    class LinkBus:
+        def __init__(self):
+            self.published = []
+            self.fail = False
+
+        def publish_many(self, topic, values):
+            if self.fail:
+                raise ConnectionError("link down")
+            self.published.extend(values)
+
+        def read(self, topic, offset):
+            if self.fail:
+                raise ConnectionError("link down")
+            return []
+
+        def end_offset(self, topic):
+            return 0
+
+        def close(self):
+            pass
+
+    clock = FakeClock()
+    bus = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    link = LinkBus()
+    router = FleetRouter(
+        bus, FleetTopologyConfig(heartbeat_timeout_s=500.0,
+                                 result_timeout_s=5.0),
+        n_features=4, clock=clock, connect_fn=lambda a: link)
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w0",
+                                  "address": "addr:0"})
+    router.pump()
+    router.open_session("S")
+    router.pump()  # the open lands cleanly
+    link.fail = True
+    router.submit("S", np.zeros(4, np.float32))  # lost with the frame
+    router.pump()  # link drops; seq 0 counted routed_ticks_lost
+    c = router.metrics.counters
+    assert c["link_errors"] == 1
+    assert c["routed_ticks_lost"] == 1
+    link.fail = False  # bus back up, but no beat yet — no re-link
+    router.submit("S", np.zeros(4, np.float32))  # seq 1: held
+    router.pump()
+    assert any(m.get("kind") == "tick"
+               for m in router._outgoing.get("w0", ()))
+    clock.advance(6.0)  # past result_timeout_s while still held
+    router.pump()  # both ticks age into results_missing
+    assert c["results_missing"] == 2
+    router.pump()  # the held-batch re-check drops the aged tick
+    assert c["routed_ticks_lost"] == 2
+    assert not any(m.get("kind") == "tick"
+                   for m in router._outgoing.get("w0", ()))
+    # the worker's next beat re-links: nothing stale is delivered
+    bus.publish("fleet_control", {"kind": "heartbeat", "worker": "w0",
+                                  "address": "addr:0"})
+    router.pump()
+    assert "w0" in router._links
+    assert not any(m.get("kind") == "tick" for m in link.published)
+    # accounting identity closes: submitted == served + missing
+    assert c["results_missing"] == 2
+    # and fresh traffic flows normally after the outage
+    router.submit("S", np.zeros(4, np.float32))
+    router.pump()
+    assert sum(1 for m in link.published if m.get("kind") == "tick") == 1
+
+
+def test_shared_bus_blip_requeues_control_messages():
+    """Failure row sharpened: a shared-broker blip on the router's
+    outgoing publish must not strand control messages — ticks in the
+    failed batch are at-most-once (counted lost), but idempotent
+    control (open/drain/close) is requeued and rides the broker's
+    recovery, exactly like the per-worker link path."""
+
+    class BlippyBus:
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail = False
+
+        def publish(self, topic, value):
+            return self.inner.publish(topic, value)
+
+        def publish_many(self, topic, values):
+            if self.fail:
+                raise ConnectionError("broker blip")
+            return self.inner.publish_many(topic, values)
+
+        def read(self, topic, offset, max_records=None):
+            return self.inner.read(topic, offset, max_records)
+
+        def end_offset(self, topic):
+            return self.inner.end_offset(topic)
+
+        def topics(self):
+            return self.inner.topics()
+
+        def consumer(self, topic, *, from_end=False):
+            return self.inner.consumer(topic, from_end=from_end)
+
+    from fmda_tpu.config import fleet_worker_topic
+
+    clock = FakeClock()
+    inner = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    bus = BlippyBus(inner)
+    router = FleetRouter(
+        bus, FleetTopologyConfig(heartbeat_timeout_s=500.0),
+        n_features=4, clock=clock)
+    inner.publish("fleet_control", {"kind": "hello", "worker": "w0"})
+    router.pump()
+    bus.fail = True
+    router.open_session("S")  # control: must survive the blip
+    router.submit("S", np.zeros(4, np.float32))  # tick: counted lost
+    router.pump()
+    c = router.metrics.counters
+    assert c["bus_errors"] == 1
+    assert c["routed_ticks_lost"] == 1
+    assert c["control_requeued"] == 1
+    bus.fail = False
+    router.pump()
+    delivered = [r.value["kind"]
+                 for r in inner.read(fleet_worker_topic("w0"), 0)]
+    assert delivered == ["open"]  # control landed once, the tick never
+
+
+def test_batched_shared_bus_drain_export_failure_keeps_the_session():
+    """Failure row sharpened: over a batched shared bus the migration
+    state export rides a BufferedPublisher — a broker failure on the
+    batch frame must be detected (`drain_export_failed`), the session
+    kept serving instead of destroyed, and the retry must land the
+    state exactly once when the broker answers again."""
+    from fmda_tpu.config import RuntimeConfig, fleet_worker_topic
+    from fmda_tpu.fleet.state import encode_row
+
+    class BatchBus:
+        """InProcessBus + the SocketBus batch surface, with a switch
+        that fails control-topic publishes like a broker blip."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.fail_control = False
+
+        def topics(self):
+            return self.inner.topics()
+
+        def publish(self, topic, value):
+            return self.inner.publish(topic, value)
+
+        def publish_many(self, topic, values):
+            return self.inner.publish_many(topic, values)
+
+        def read(self, topic, offset, max_records=None):
+            return self.inner.read(topic, offset, max_records)
+
+        def end_offset(self, topic):
+            return self.inner.end_offset(topic)
+
+        def consumer(self, topic, *, from_end=False):
+            return self.inner.consumer(topic, from_end=from_end)
+
+        def batch(self, ops):
+            resps = []
+            for op in ops:
+                if (self.fail_control
+                        and op["op"].startswith("publish")
+                        and op.get("topic") == "fleet_control"):
+                    resps.append({"err": "broker blip",
+                                  "kind": "ConnectionError"})
+                elif op["op"] == "publish_many":
+                    self.inner.publish_many(op["topic"], op["values"])
+                    resps.append({"ok": True})
+                elif op["op"] == "read":
+                    recs = self.inner.read(
+                        op["topic"], op["offset"], op.get("max_records"))
+                    resps.append(
+                        {"ok": [[r.offset, r.value] for r in recs]})
+                else:
+                    resps.append({"err": f"unknown op {op['op']}"})
+            return resps
+
+        def unwrap_op(self, op, resp):
+            if "err" in resp:
+                raise ConnectionError(resp["err"])
+            return resp.get("ok")
+
+    cfg, params = _setup(feats=6, window=4)
+    clock = FakeClock()
+    inner = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    fake = BatchBus(inner)
+    rc = RuntimeConfig(capacity=4, window=4, bucket_sizes=(1,),
+                       max_linger_ms=0.0, pipeline_depth=0)
+    w = FleetWorker(
+        "w0", fake, cfg, params,
+        config=FleetTopologyConfig(heartbeat_interval_s=1e9,
+                                   heartbeat_timeout_s=1e9),
+        runtime=rc, clock=clock, precompile=False)
+    assert w._batch_bus is not None  # the batched posture under test
+    w.start()
+    inbox = fleet_worker_topic("w0")
+    inner.publish(inbox, {"kind": "open", "session": "S", "norm": None})
+    inner.publish(inbox, {"kind": "tick", "session": "S",
+                          "row": encode_row(np.zeros(6, np.float32)),
+                          "seq": 0})
+    w.step()
+    assert w.pool.handle_for("S") is not None
+    fake.fail_control = True
+    inner.publish(inbox, {"kind": "drain_session", "session": "S",
+                          "mig": "m1"})
+    w.step()
+    c = w.metrics.counters
+    assert c["drain_export_failed"] == 1
+    assert c.get("sessions_migrated_out", 0) == 0
+    # the only copy of the state was NOT destroyed: still serving
+    assert w.pool.handle_for("S") is not None
+    # broker answers again: the retry re-drains, re-exports, closes
+    fake.fail_control = False
+    w.step()
+    assert c["sessions_migrated_out"] == 1
+    assert w.pool.handle_for("S") is None
+    states = [r.value for r in inner.read("fleet_control", 0)
+              if r.value.get("kind") == "session_state"]
+    assert len(states) == 1 and states[0]["mig"] == "m1"

@@ -196,6 +196,80 @@ def test_fleet_router_modules_never_import_jax_at_module_scope():
     )
 
 
+#: modules carrying compiled-in chaos injection points (ISSUE 7
+#: satellite): every `_CHAOS` touch outside the module-scope singleton
+#: capture must sit under an `if _CHAOS.enabled:` guard, so disabled
+#: chaos costs exactly one attribute read + one branch per point —
+#: zero allocation, zero calls (the same discipline obs.trace pins)
+CHAOS_INSTRUMENTED = (
+    "fleet/router.py",
+    "fleet/wire.py",
+    "fleet/worker.py",
+)
+
+
+def _is_enabled_guard(node: ast.If) -> bool:
+    t = node.test
+    return (isinstance(t, ast.Attribute) and t.attr == "enabled"
+            and isinstance(t.value, ast.Name) and t.value.id == "_CHAOS")
+
+
+def _unguarded_chaos_uses(path: pathlib.Path):
+    """`_CHAOS` references outside (a) the module-scope
+    ``_CHAOS = default_chaos()`` capture, (b) an ``if _CHAOS.enabled:``
+    test, (c) the body of such a guard."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    rel = path.relative_to(PACKAGE_DIR)
+    found = []
+    points = [0]
+
+    def walk(node, guarded):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "_CHAOS"
+                for t in node.targets):
+            return  # the singleton capture
+        if isinstance(node, ast.If) and _is_enabled_guard(node):
+            points[0] += 1
+            for child in node.body:
+                walk(child, True)
+            for child in node.orelse:
+                walk(child, guarded)
+            return
+        if isinstance(node, ast.Name) and node.id == "_CHAOS" \
+                and not guarded:
+            found.append(
+                f"{rel}:{node.lineno}: _CHAOS use outside an "
+                "`if _CHAOS.enabled:` guard")
+        for child in ast.iter_child_nodes(node):
+            walk(child, guarded)
+
+    walk(tree, False)
+    return found, points[0]
+
+
+def test_chaos_injection_points_are_noops_when_disabled():
+    """AST contract for the never-abort chaos layer (docs/chaos.md):
+    with chaos off, every compiled-in injection point is a single
+    predictable branch on the hot path — any `_CHAOS` call reachable
+    without passing the `enabled` test fails tier-1 the commit it
+    appears."""
+    violations = []
+    total_points = 0
+    for rel in CHAOS_INSTRUMENTED:
+        path = PACKAGE_DIR / rel
+        assert path.is_file(), f"stale CHAOS_INSTRUMENTED entry {rel}"
+        found, n_points = _unguarded_chaos_uses(path)
+        violations.extend(found)
+        assert n_points >= 1, f"{rel} lost its injection point"
+        total_points += n_points
+    assert not violations, (
+        "chaos injection must be free when disabled (guard every "
+        "_CHAOS touch with `if _CHAOS.enabled:`):\n"
+        + "\n".join(violations)
+    )
+    assert total_points >= 4  # the walk actually sees the points
+
+
 def test_fleet_router_import_path_is_transitively_jax_free():
     """Runtime half: actually import every router-role module in a
     clean interpreter and assert jax never loaded — an AST check can't

@@ -93,3 +93,102 @@ def test_worker_role_cli_requires_connect_args(capsys):
     rc = main(["serve-fleet", "--role", "worker", "--platform", "ambient"])
     assert rc == 2
     assert "--worker-id" in capsys.readouterr().err
+
+
+def test_shared_broker_kafka_topology_end_to_end(monkeypatch):
+    """ROADMAP (d): the `--shared-bus` topology over KafkaBus, end to
+    end through open/tick/migrate/close — router and both workers each
+    hold their OWN KafkaBus client against one (fake, protocol-faithful)
+    broker, exactly the external-broker deployment shape.  The late
+    worker's inbox topic is created dynamically (`add_topic` — ROADMAP
+    (c) on the Kafka side), migration state crosses the broker, and the
+    per-session streams stay complete and ordered."""
+    import numpy as np
+
+    import fake_kafka
+
+    fake_kafka.reset()
+    monkeypatch.setitem(sys.modules, "kafka", fake_kafka)
+    try:
+        from fmda_tpu.config import DEFAULT_TOPICS, FleetTopologyConfig, \
+            RuntimeConfig, fleet_topics
+        from fmda_tpu.fleet.router import FleetRouter
+        from fmda_tpu.fleet.worker import FleetWorker
+        from fmda_tpu.stream.kafka_bus import KafkaBus
+        from test_fleet import FakeClock, _setup
+
+        clock = FakeClock()
+        feats, window = 6, 4
+        cfg, params = _setup(feats=feats, window=window)
+        fleet_cfg = FleetTopologyConfig(
+            heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0)
+        rc = RuntimeConfig(capacity=8, window=window, bucket_sizes=(1,),
+                           max_linger_ms=0.0, pipeline_depth=0)
+        # launch-time topics cover only w0 — w1 joins beyond the set
+        topics = tuple(DEFAULT_TOPICS) + fleet_topics(["w0"])
+        servers = ("broker:9092",)
+
+        def bus():
+            return KafkaBus(topics, servers=servers)
+
+        router = FleetRouter(bus(), fleet_cfg, n_features=feats,
+                             clock=clock)
+        w0 = FleetWorker("w0", bus(), cfg, params, config=fleet_cfg,
+                         runtime=rc, clock=clock, precompile=False)
+        w0.start()
+        router.pump()
+        assert router.membership.live() == ["w0"]
+
+        rng = np.random.default_rng(0)
+        sids = [f"T{i}" for i in range(4)]
+        got = {}
+
+        def cycle(workers):
+            router.pump()
+            for w in workers:
+                if not w.stopped:
+                    w.step()
+            for res in router.pump():
+                got.setdefault(res.session_id, []).append(res)
+
+        for sid in sids:
+            router.open_session(sid)
+        n_rounds = 10
+        live = [w0]
+        for r in range(n_rounds):
+            if r == 4:
+                # w1 joins mid-run: its inbox topic is NOT in the
+                # launch-time set — FleetWorker/router create it via
+                # add_topic (Kafka brokers auto-create; the adapter
+                # widens its configured set)
+                w1 = FleetWorker("w1", bus(), cfg, params,
+                                 config=fleet_cfg, runtime=rc,
+                                 clock=clock, precompile=False)
+                live.append(w1)
+                w1.start()
+                router.pump()  # join -> rebalance -> drains enqueued
+            for sid in sids:
+                router.submit(sid, rng.normal(size=feats).astype(
+                    np.float32))
+            cycle(live)
+        for _ in range(8):
+            cycle(live)
+
+        counters = router.metrics.counters
+        assert counters["migrations_completed"] >= 1
+        assert counters.get("sessions_lost_state", 0) == 0
+        assert counters.get("results_missing", 0) == 0
+        moved = [s for s in sids if router.table.owner_of(s) == "w1"]
+        assert moved  # the rebalance actually used the new worker
+        for sid in sids:
+            seqs = [r_.seq for r_ in got[sid]]
+            assert seqs == list(range(n_rounds)), (sid, seqs)
+
+        # close everything; the workers release their slots
+        for sid in sids:
+            router.close_session(sid)
+        for _ in range(3):
+            cycle(live)
+        assert all(w.pool.n_active == 0 for w in live)
+    finally:
+        fake_kafka.reset()

@@ -426,7 +426,8 @@ def test_healthz_ok_then_flips_on_induced_failures():
         assert status == 200
         health = json.loads(body)
         assert health["status"] == "ok"
-        assert set(health["checks"]) == {"bus", "warehouse", "last_tick"}
+        assert set(health["checks"]) == {
+            "bus", "warehouse", "last_tick", "chaos"}
         assert all(c["ok"] for c in health["checks"].values())
 
         # induced bus failure: the transport stops answering
@@ -472,6 +473,30 @@ def test_healthz_last_tick_age_gate():
     assert "age 20.0s" in health["checks"]["last_tick"]["detail"]
     obs.tick()
     assert obs.health()["status"] == "ok"
+
+
+def test_chaos_fault_events_land_in_the_latest_observability():
+    """The process-default chaos runtime's ``on_fault`` hook must follow
+    the LATEST Observability instance (same discipline as its scrape
+    collectors): a first-one-wins guard would pin a discarded instance's
+    event log — and the whole instance with it — for the process
+    lifetime, silently dropping fault events from the live surface."""
+    from fmda_tpu.chaos import ChaosFault, FaultEvent, FaultPlan
+    from fmda_tpu.chaos.inject import configure_chaos
+
+    first = Observability(ObservabilityConfig(enabled=True))
+    second = Observability(ObservabilityConfig(enabled=True))
+    rt = configure_chaos(
+        enabled=True, plan=FaultPlan(3, (FaultEvent(1, "kill", "bus"),)))
+    try:
+        rt.advance(1)
+        with pytest.raises(ChaosFault):
+            rt.check("bus")
+        assert "chaos_fault" in [e["kind"] for e in second.events.tail()]
+        assert "chaos_fault" not in [e["kind"] for e in first.events.tail()]
+    finally:
+        configure_chaos(enabled=False)
+        rt.on_fault = None
 
 
 def test_fleet_queue_health_check_reports_saturation():

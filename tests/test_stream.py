@@ -94,6 +94,70 @@ def test_bus_publish_many_retention():
     assert recs[0].offset == 2
 
 
+def _add_topic_contract(bus):
+    """The dynamic-topic contract every backend shares (ROADMAP (c)):
+    a topic created after construction behaves exactly like a
+    launch-time one, re-adding is a no-op that keeps the log, and
+    unknown topics still reject loudly."""
+    with pytest.raises(KeyError):
+        bus.publish("late", {"x": 0})
+    bus.add_topic("late")
+    assert "late" in bus.topics()
+    assert bus.publish("late", {"x": 1}) == 0
+    bus.add_topic("late")  # idempotent: offsets/log untouched
+    assert bus.publish("late", {"x": 2}) == 1
+    assert [r.value["x"] for r in bus.consumer("late").poll()] == [1, 2]
+    assert bus.end_offset("late") == 2
+    with pytest.raises(KeyError):
+        bus.publish("still_unknown", {})
+
+
+def test_add_topic_in_process_bus():
+    _add_topic_contract(InProcessBus(["a"]))
+
+
+def test_add_topic_native_bus():
+    from fmda_tpu.stream.native_bus import NativeBus, native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    _add_topic_contract(NativeBus(["a"]))
+
+
+def test_add_topic_kafka_bus(monkeypatch):
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    import fake_kafka
+
+    fake_kafka.reset()
+    monkeypatch.setitem(sys.modules, "kafka", fake_kafka)
+    from fmda_tpu.stream.kafka_bus import KafkaBus
+
+    try:
+        # KafkaBus only widens its configured set (the broker
+        # auto-creates on first produce) — same observable contract
+        _add_topic_contract(KafkaBus(["a"]))
+    finally:
+        fake_kafka.reset()
+
+
+def test_add_topic_over_the_wire():
+    from fmda_tpu.fleet.wire import BusServer, SocketBus
+
+    server = BusServer(InProcessBus(["a"])).start()
+    try:
+        client = SocketBus.connect(server.address)
+        try:
+            _add_topic_contract(client)
+            # the server-side bus actually grew the topic
+            assert "late" in server.bus.topics()
+        finally:
+            client.close()
+    finally:
+        server.stop()
+
+
 # ---------------------------------------------------------------- warehouse
 
 
