@@ -1671,6 +1671,42 @@ def phase_trace_overhead() -> dict:
     }
 
 
+def phase_analysis_lint() -> dict:
+    """Cost guard for the static-analysis gate (ISSUE 8): the whole rule
+    suite — drift resolver included — over the parsed-module cache must
+    stay a single-digit-seconds affair, or nobody runs it pre-commit and
+    tier-1 eats the slowdown.  Also re-asserts the gate itself: zero
+    non-baselined findings (`ok` covers both).  Budget is generous (10 s)
+    because the drift rule imports jax submodules on first resolution;
+    the second run prices the warm path the pytest wrapper pays."""
+    import time as _time
+
+    from fmda_tpu.analysis import collect_modules, default_rules, run_lint
+
+    t0 = _time.monotonic()
+    result = run_lint(default_rules())
+    cold_s = _time.monotonic() - t0
+    # warm: jax imports + resolution cache primed; re-parse dominates
+    t0 = _time.monotonic()
+    ctx = collect_modules()
+    result2 = run_lint(default_rules(), ctx=ctx)
+    warm_s = _time.monotonic() - t0
+    budget_s = 10.0
+    return {
+        "n_modules": result.n_modules,
+        "n_rules": len(default_rules()),
+        "new_findings": len(result.new),
+        "baselined": len(result.baselined),
+        "drift_symbols": result.reports.get(
+            "jax_api_drift", {}).get("n_symbols"),
+        "cold_wall_s": round(cold_s, 3),
+        "warm_wall_s": round(warm_s, 3),
+        "budget_s": budget_s,
+        "ok": (result.ok and result2.ok
+               and cold_s < budget_s and warm_s < budget_s),
+    }
+
+
 _PHASES = {
     "flagship_pallas": lambda: phase_flagship(use_pallas=True),
     "flagship_scan": lambda: phase_flagship(use_pallas=False),
@@ -1696,6 +1732,7 @@ _PHASES = {
     "runtime_chaos_soak": phase_runtime_chaos_soak,
     "obs_overhead": phase_obs_overhead,
     "trace_overhead": phase_trace_overhead,
+    "analysis_lint": phase_analysis_lint,
 }
 
 

@@ -1,0 +1,104 @@
+"""Framework-aware static analysis for the ``fmda_tpu`` tree.
+
+One pluggable AST-analyzer engine (:mod:`fmda_tpu.analysis.engine`) and
+the rule catalog that runs on it:
+
+========================  ==========  =========================================
+rule id                   severity    contract
+========================  ==========  =========================================
+``lock-discipline``       warning     lock-guarded attributes accessed inside
+                                      ``with self._lock:`` only
+``jit-purity``            warning     jit/pjit/shard_map-reachable functions
+                                      stay pure; donated buffers die at the
+                                      call site
+``jax-api-drift``         error       every jax.* reference on the kernel
+                                      surface resolves against installed JAX
+``bus-topics``            error       published topic literals are declared
+                                      or consumed somewhere
+``logging-hygiene``       error       no print()/foreign loggers in library
+                                      code
+``span-wall-clock``       error       span code never reads the wall clock
+``router-jax-import``     error       router-role fleet modules import no jax
+                                      at module scope
+``chaos-guard``           error       every ``_CHAOS`` touch sits under
+                                      ``if _CHAOS.enabled:``
+========================  ==========  =========================================
+
+Entry points: ``python -m fmda_tpu lint`` (exit 0 = clean vs baseline,
+1 = new findings, 2 = usage error), :func:`run_lint` for tests, and
+``docs/analysis.md`` for the baseline workflow and how to write a rule.
+"""
+
+from fmda_tpu.analysis.drift import DRIFT_SCOPE, JaxApiDriftRule
+from fmda_tpu.analysis.engine import (
+    DEFAULT_BASELINE,
+    Finding,
+    LintContext,
+    LintResult,
+    ParsedModule,
+    Rule,
+    apply_baseline,
+    collect_modules,
+    load_baseline,
+    run_lint,
+    run_rules,
+    save_baseline,
+)
+from fmda_tpu.analysis.hygiene import (
+    ChaosGuardRule,
+    LoggingHygieneRule,
+    RouterJaxImportRule,
+    SpanClockRule,
+)
+from fmda_tpu.analysis.locks import LockDisciplineRule
+from fmda_tpu.analysis.purity import JitPurityRule
+from fmda_tpu.analysis.topics import BusTopicRule
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "DRIFT_SCOPE",
+    "Finding",
+    "LintContext",
+    "LintResult",
+    "ParsedModule",
+    "Rule",
+    "apply_baseline",
+    "collect_modules",
+    "load_baseline",
+    "run_lint",
+    "run_rules",
+    "save_baseline",
+    "default_rules",
+    "rule_catalog",
+    "BusTopicRule",
+    "ChaosGuardRule",
+    "JaxApiDriftRule",
+    "JitPurityRule",
+    "LockDisciplineRule",
+    "LoggingHygieneRule",
+    "RouterJaxImportRule",
+    "SpanClockRule",
+]
+
+
+def default_rules(*, drift: bool = True):
+    """Fresh instances of the full catalog (rules carry per-run state).
+    ``drift=False`` skips the JAX resolver — the only rule that imports
+    jax — for jax-free contexts and fast editor loops."""
+    rules = [
+        LoggingHygieneRule(),
+        SpanClockRule(),
+        RouterJaxImportRule(),
+        ChaosGuardRule(),
+        LockDisciplineRule(),
+        JitPurityRule(),
+        BusTopicRule(),
+    ]
+    if drift:
+        rules.append(JaxApiDriftRule())
+    return rules
+
+
+def rule_catalog(*, drift: bool = True):
+    """``{rule_id: description}`` for ``lint --rule`` validation/help."""
+    return {r.id: r.description for r in default_rules(drift=drift)}

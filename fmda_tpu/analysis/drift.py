@@ -1,0 +1,202 @@
+"""JAX API-drift scanner.
+
+The ROADMAP's top blocker is an 84-test failure set walling off the
+Pallas kernels, pjit sequence-parallel training, and ring attention from
+tier-1 coverage — and until now nobody had *inventoried* which symbols
+actually moved.  This rule resolves every dotted reference into
+``jax.*`` (including ``jax.experimental.*`` and the pallas aliases)
+across the kernel surface (``ops/``, ``parallel/``, ``models/``)
+against the **installed** JAX and reports the ones that no longer
+exist, as findings plus a machine-readable inventory
+(``ctx.reports["jax_api_drift"]``, exported to
+``artifacts/jax_api_drift.json`` by ``lint --drift-report``):
+
+    {"jax_version": "...", "n_symbols": N, "n_sites": M,
+     "symbols": {"jax.experimental.pallas.X": [{"path","line"}, ...]}}
+
+That turns the opaque failure set into an actionable porting list for
+the version-shim/porting PR (ROADMAP: "unblock the TPU kernel surface").
+
+How references are gathered: import aliases are tracked per module
+(``import jax.numpy as jnp`` → ``jnp.X`` is ``jax.numpy.X``;
+``from jax.experimental import pallas as pl`` → ``pl.Y``; direct symbol
+imports are checked at the import line), then every maximal attribute
+chain rooted at an alias is resolved by importing the longest module
+prefix and ``getattr``-ing the rest.  Only static module-path
+references are judged — values passed around as objects are invisible,
+so this is a lower bound on drift, never a false alarm on style.
+
+Grandfathered drift lives in the baseline like any other finding (the
+pre-existing inventory is baselined with a pointer at the ROADMAP
+item); a *new* unresolved symbol fails lint the commit it appears, so
+the kernel surface can't silently drift further.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from fmda_tpu.analysis.engine import Finding, LintContext, ParsedModule, Rule
+
+#: package subtrees whose jax surface is inventoried
+DRIFT_SCOPE = ("ops/", "parallel/", "models/")
+
+#: reference roots that are resolved (module path prefixes)
+_JAX_ROOT = "jax"
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.startswith(DRIFT_SCOPE)
+
+
+class _AliasCollector(ast.NodeVisitor):
+    """Module-path aliases + directly imported symbols, whole module
+    (function-scope imports included — deferred imports are the repo's
+    sanctioned pattern for jax in lazily-loaded modules)."""
+
+    def __init__(self) -> None:
+        #: local name -> dotted module path it stands for
+        self.aliases: Dict[str, str] = {}
+        #: (line, dotted symbol) for `from jax.x import y` imports
+        self.symbols: List[Tuple[int, str]] = []
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name.split(".")[0] != _JAX_ROOT:
+                continue
+            if alias.asname:
+                self.aliases[alias.asname] = alias.name
+            else:
+                # `import jax.numpy` binds `jax`; chains through the
+                # bare root are resolved from `jax` itself
+                self.aliases.setdefault(_JAX_ROOT, _JAX_ROOT)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        if node.level or mod.split(".")[0] != _JAX_ROOT:
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            dotted = f"{mod}.{alias.name}"
+            self.symbols.append((node.lineno, dotted))
+            # the imported name may itself be a module used as a root
+            # (`from jax.experimental import pallas as pl`)
+            self.aliases[alias.asname or alias.name] = dotted
+
+
+class _RefCollector(ast.NodeVisitor):
+    """Maximal attribute chains rooted at a jax alias."""
+
+    def __init__(self, aliases: Dict[str, str]) -> None:
+        self.aliases = aliases
+        self.refs: List[Tuple[int, str]] = []
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        chain: List[str] = []
+        cur: ast.AST = node
+        while isinstance(cur, ast.Attribute):
+            chain.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name) and cur.id in self.aliases:
+            dotted = ".".join([self.aliases[cur.id], *reversed(chain)])
+            self.refs.append((node.lineno, dotted))
+            return  # the whole chain is consumed
+        self.generic_visit(node)
+
+
+class JaxApiDriftRule(Rule):
+    id = "jax-api-drift"
+    severity = "error"
+    description = ("every jax.* reference on the kernel surface must "
+                   "resolve against the installed JAX")
+
+    def __init__(self) -> None:
+        #: dotted -> resolvable? (shared across modules, one import each)
+        self._cache: Dict[str, bool] = {}
+        #: dotted -> [{"path", "line"}] for the inventory report
+        self._sites: Dict[str, List[Dict[str, object]]] = {}
+        self._n_sites = 0
+        self._n_modules = 0
+
+    def check(self, module: ParsedModule, ctx: LintContext) -> List[Finding]:
+        if not _in_scope(module.rel):
+            return []
+        self._n_modules += 1
+        aliases = _AliasCollector()
+        aliases.visit(module.tree)
+        refs = _RefCollector(aliases.aliases)
+        refs.visit(module.tree)
+        found: List[Finding] = []
+        reported = set()
+        for line, dotted in sorted(set(aliases.symbols) | set(refs.refs)):
+            self._n_sites += 1
+            if self._resolves(dotted):
+                continue
+            self._sites.setdefault(dotted, []).append(
+                {"path": module.rel, "line": line})
+            if dotted in reported:
+                continue  # one finding per symbol per module
+            reported.add(dotted)
+            found.append(self.finding(
+                module.rel, line,
+                f"unresolved jax reference: {dotted}"))
+        return found
+
+    def finish(self, ctx: LintContext) -> List[Finding]:
+        try:
+            import jax
+
+            version = jax.__version__
+        except Exception:  # noqa: BLE001 — a jax-free host still gets
+            # the inventory (every ref unresolved); the CLI steers such
+            # hosts to --no-drift before it ever gets here
+            version = None
+        ctx.reports["jax_api_drift"] = {
+            "jax_version": version,
+            "scope": list(DRIFT_SCOPE),
+            "n_modules": self._n_modules,
+            "n_sites": self._n_sites,
+            "n_symbols": len(self._sites),
+            "symbols": {k: self._sites[k] for k in sorted(self._sites)},
+        }
+        self._sites = {}
+        self._n_sites = self._n_modules = 0
+        return []
+
+    # -- resolution ---------------------------------------------------------
+
+    def _resolves(self, dotted: str) -> bool:
+        hit = self._cache.get(dotted)
+        if hit is not None:
+            return hit
+        ok = _resolve_against_installed(dotted)
+        self._cache[dotted] = ok
+        return ok
+
+
+def _resolve_against_installed(dotted: str) -> bool:
+    """Import the longest module prefix, then getattr the rest.  Any
+    import-time explosion (renamed module, version-gated init) counts
+    as unresolved — the symbol is unusable either way."""
+    import importlib
+
+    parts = dotted.split(".")
+    obj = None
+    depth = 0
+    for i in range(len(parts), 0, -1):
+        try:
+            obj = importlib.import_module(".".join(parts[:i]))
+            depth = i
+            break
+        except Exception:  # noqa: BLE001 — see docstring
+            continue
+    if obj is None:
+        return False
+    for attr in parts[depth:]:
+        try:
+            obj = getattr(obj, attr)
+        except AttributeError:
+            return False
+    return True

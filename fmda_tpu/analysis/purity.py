@@ -1,0 +1,444 @@
+"""Jit-purity analyzer.
+
+Everything that reaches ``jax.jit`` / ``pjit`` / ``shard_map`` is traced
+once and replayed forever: a wall-clock read, an ``random`` draw, a
+``print``, or a mutation of ``self`` inside the traced function silently
+freezes at trace time (or retraces per call), and a host sync
+(``.item()``, ``np.asarray`` on a tracer) stalls the dispatch pipeline
+the overlap path exists to hide.  This rule finds jit-reachable
+functions statically and flags effectful operations inside them:
+
+- **jit roots**: functions decorated with ``jit``/``pjit``/``shard_map``
+  (bare, dotted, or via ``functools.partial(jax.jit, ...)``), plus
+  functions passed to a jit call site (``jax.jit(step, ...)``);
+- **transitive, one level**: plain-name calls from a jit root to
+  functions defined in the same module are checked too — the helper a
+  kernel delegates to is as traced as the kernel;
+- **effects flagged**: ``time.*``/``datetime.*`` reads, host ``random``
+  (``random.*``, ``np.random.*`` — ``jax.random`` is the sanctioned
+  PRNG), ``print``/``logging``/logger calls, ``input``/``open``,
+  ``.item()``/``.tolist()`` host syncs, ``np.asarray``/``np.array`` on
+  traced values, ``global`` writes, and ``self.*`` mutation;
+- **donation discipline**: when a jitted callable was built with a
+  literal ``donate_argnums``, a plain-name argument at a donated
+  position must not be read again after the call in the same function
+  (the buffer is gone — XLA may have aliased it into the output) unless
+  it was rebound first.
+
+Escape hatch: ``# lint: ignore[jit-purity] reason`` on the line; static
+host work on genuinely-static values (shape math via ``np``) is the
+expected false-positive class to hatch or baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from fmda_tpu.analysis.engine import Finding, LintContext, ParsedModule, Rule
+
+JIT_NAMES = ("jit", "pjit", "shard_map")
+
+#: call roots that are effectful on the host (module alias -> reason)
+_EFFECT_ROOTS = {
+    "time": "wall-clock read",
+    "_time": "wall-clock read",
+    "datetime": "wall-clock read",
+    "random": "host RNG (use jax.random)",
+    "logging": "logging call",
+    "logger": "logging call",
+    "_logger": "logging call",
+}
+
+#: bare-name calls that are effectful
+_EFFECT_CALLS = {
+    "print": "print() call",
+    "input": "host input",
+    "open": "file I/O",
+}
+
+#: attribute methods that force a device->host sync
+_SYNC_METHODS = ("item", "tolist")
+
+#: numpy-aliased conversion calls that force a sync on traced values
+_NP_SYNC = {"asarray", "array"}
+_NP_ALIASES = ("np", "numpy", "onp")
+
+
+def _callable_name(fn: ast.AST) -> Optional[str]:
+    """Trailing name of a call target: ``jit`` for ``jax.jit``, ``x.jit``."""
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _is_jit_callable(fn: ast.AST) -> bool:
+    return _callable_name(fn) in JIT_NAMES
+
+
+def _is_partial(fn: ast.AST) -> bool:
+    return _callable_name(fn) == "partial"
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[ast.Call]:
+    """The jit ``Call`` behind a decorator, when the decorator is one:
+    ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+    ``@functools.partial(jax.jit, donate_argnums=...)``, ``@jax.jit(...)``.
+    Returns the Call carrying jit kwargs (or None for bare names)."""
+    if _is_jit_callable(dec):
+        return None  # bare @jax.jit — jit'd, no kwargs to mine
+    if isinstance(dec, ast.Call):
+        if _is_jit_callable(dec.func):
+            return dec
+        if _is_partial(dec.func) and dec.args \
+                and _is_jit_callable(dec.args[0]):
+            return dec
+    return None
+
+
+def _literal_donate(call: Optional[ast.Call]) -> Tuple[int, ...]:
+    """Literal ``donate_argnums`` positions from a jit call, () when
+    absent or not statically literal."""
+    if call is None:
+        return ()
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, int):
+                    out.append(elt.value)
+                else:
+                    return ()
+            return tuple(out)
+    return ()
+
+
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    severity = "warning"
+    description = ("functions reaching jax.jit/pjit/shard_map must stay "
+                   "pure: no wall clock, host RNG, logging, self/module "
+                   "mutation, or host sync; donated buffers die at the "
+                   "call site")
+
+    def check(self, module: ParsedModule, ctx: LintContext) -> List[Finding]:
+        found: List[Finding] = []
+        scopes = _ScopeIndex(module.tree)
+        roots = self._jit_roots(scopes)
+        reachable: Dict[str, object] = {}
+        for fn in roots:
+            reachable.setdefault(self._key(fn), fn)
+        # transitive, one level: plain-name callees resolved by scope
+        for fn in list(reachable.values()):
+            for callee in self._local_callees(fn, scopes):
+                reachable.setdefault(self._key(callee), callee)
+        for fn in reachable.values():
+            found.extend(self._scan_impure(module, fn))
+        found.extend(self._check_donation(module, scopes))
+        return found
+
+    @staticmethod
+    def _key(fn) -> str:
+        return f"{fn.name}@{fn.lineno}"
+
+    def _jit_roots(self, scopes: "_ScopeIndex") -> List[object]:
+        roots: List[object] = []
+        for fn, _stack in scopes.defs:
+            for dec in fn.decorator_list:
+                if _is_jit_callable(dec) or _jit_decorator(dec) is not None:
+                    roots.append(fn)
+                    break
+        for node, stack in scopes.calls:
+            if not _is_jit_callable(node.func) or not node.args:
+                continue
+            # jax.jit(step, ...) call site: resolve the Name argument
+            # with Python scoping (a bare name can never be a method)
+            if isinstance(node.args[0], ast.Name):
+                roots.extend(scopes.resolve(node.args[0].id, stack))
+            elif isinstance(node.args[0], ast.Lambda):
+                roots.append(_LambdaShim(node.args[0]))
+        return roots
+
+    def _local_callees(self, fn, scopes: "_ScopeIndex") -> Iterable[object]:
+        node = fn.node if isinstance(fn, _LambdaShim) else fn
+        stack = scopes.stack_of(node)
+        body = node.body
+        seen: Set[str] = set()
+        nodes = body if isinstance(body, list) else [body]
+        for stmt in nodes:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call) and isinstance(
+                        sub.func, ast.Name):
+                    name = sub.func.id
+                    if name in seen or _is_jit_callable(sub.func):
+                        continue
+                    seen.add(name)
+                    yield from scopes.resolve(name, (node, *stack))
+
+    # -- impurity scan ------------------------------------------------------
+
+    def _scan_impure(self, module: ParsedModule, fn) -> List[Finding]:
+        found: List[Finding] = []
+        name = fn.name
+        seen: Set[Tuple[str, str]] = set()
+
+        def emit(line: int, what: str) -> None:
+            if (name, what) in seen:
+                return
+            seen.add((name, what))
+            found.append(self.finding(
+                module.rel, line,
+                f"jit-reachable {name}: {what}"))
+
+        body = fn.node.body if isinstance(fn, _LambdaShim) else fn.body
+        nodes = body if isinstance(body, list) else [body]
+        for stmt in nodes:
+            for node in ast.walk(stmt):
+                self._scan_node(node, emit)
+        return found
+
+    def _scan_node(self, node: ast.AST, emit) -> None:
+        if isinstance(node, ast.Global):
+            emit(node.lineno,
+                 f"`global {', '.join(node.names)}` (module-state "
+                 "mutation inside jit)")
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                if fn.id in _EFFECT_CALLS:
+                    emit(node.lineno, f"{_EFFECT_CALLS[fn.id]} ({fn.id})")
+            elif isinstance(fn, ast.Attribute):
+                root = fn.value
+                if isinstance(root, ast.Name):
+                    if root.id in _EFFECT_ROOTS:
+                        emit(node.lineno,
+                             f"{_EFFECT_ROOTS[root.id]} "
+                             f"({root.id}.{fn.attr})")
+                    elif root.id in _NP_ALIASES and fn.attr in _NP_SYNC:
+                        emit(node.lineno,
+                             f"host sync ({root.id}.{fn.attr} forces a "
+                             "device transfer on traced values)")
+                    elif root.id == "self":
+                        pass  # method call — state mutation caught below
+                elif (isinstance(root, ast.Attribute)
+                      and isinstance(root.value, ast.Name)
+                      and root.value.id in _NP_ALIASES
+                      and root.attr == "random"):
+                    emit(node.lineno,
+                         f"host RNG ({root.value.id}.random.{fn.attr})")
+                elif (isinstance(root, ast.Call)
+                      and isinstance(root.func, ast.Name)
+                      and root.func.id in ("_log", "_logger")):
+                    emit(node.lineno, f"logging call (_log().{fn.attr})")
+                if isinstance(fn, ast.Attribute) \
+                        and fn.attr in _SYNC_METHODS and not node.args:
+                    emit(node.lineno,
+                         f"host sync (.{fn.attr}() blocks on the device)")
+        elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                emit(node.lineno,
+                     f"mutates self.{node.attr} inside a traced function")
+
+    # -- donation discipline ------------------------------------------------
+
+    def _check_donation(self, module: ParsedModule,
+                        scopes: "_ScopeIndex") -> List[Finding]:
+        """A donated argument's buffer is dead after the call; reading
+        the name again without rebinding it first reads freed storage."""
+        #: id(def node) -> donated positions, for decorated functions
+        donated_defs: Dict[int, Tuple[int, ...]] = {}
+        for fn, _stack in scopes.defs:
+            for dec in fn.decorator_list:
+                pos = _literal_donate(_jit_decorator(dec))
+                if pos:
+                    donated_defs[id(fn)] = pos
+        #: (enclosing-function id or None, name) -> positions, for
+        #: `fwd = jax.jit(f, donate_argnums=...)` local handles
+        donated_names: Dict[Tuple[object, str], Tuple[int, ...]] = {}
+        #: (enclosing-class id, attr) -> positions, for
+        #: `self._step = jax.jit(step, donate_argnums=...)`
+        donated_self: Dict[Tuple[int, str], Tuple[int, ...]] = {}
+        for node, stack in scopes.assigns:
+            if not (isinstance(node.value, ast.Call)
+                    and _is_jit_callable(node.value.func)):
+                continue
+            pos = _literal_donate(node.value)
+            if not pos:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    frame = _innermost_function(stack)
+                    donated_names[
+                        (id(frame) if frame else None, t.id)] = pos
+                elif (isinstance(t, ast.Attribute)
+                      and isinstance(t.value, ast.Name)
+                      and t.value.id == "self"):
+                    cls = _innermost_class(stack)
+                    if cls is not None:
+                        donated_self[(id(cls), t.attr)] = pos
+        if not (donated_defs or donated_names or donated_self):
+            return []
+        #: enclosing function -> [(call line, callee label, donated names)]
+        per_frame: Dict[int, List[Tuple[int, str, List[str]]]] = {}
+        frames: Dict[int, object] = {}
+        for node, stack in scopes.calls:
+            pos, label = self._donated_callee(
+                node, stack, scopes, donated_defs, donated_names,
+                donated_self)
+            if pos is None:
+                continue
+            names = [node.args[i].id for i in pos
+                     if i < len(node.args)
+                     and isinstance(node.args[i], ast.Name)]
+            frame = _innermost_function(stack)
+            if not names or frame is None:
+                continue
+            frames[id(frame)] = frame
+            per_frame.setdefault(id(frame), []).append(
+                (node.lineno, label, names))
+        found: List[Finding] = []
+        for fid, calls in per_frame.items():
+            found.extend(self._donation_in_function(
+                module, frames[fid], calls))
+        return found
+
+    @staticmethod
+    def _donated_callee(node, stack, scopes, donated_defs, donated_names,
+                        donated_self):
+        f = node.func
+        if isinstance(f, ast.Name):
+            for d in scopes.resolve(f.id, stack):
+                if id(d) in donated_defs:
+                    return donated_defs[id(d)], f.id
+            for frame in [*(fr for fr in stack if isinstance(
+                    fr, (ast.FunctionDef, ast.AsyncFunctionDef))), None]:
+                pos = donated_names.get(
+                    (id(frame) if frame else None, f.id))
+                if pos:
+                    return pos, f.id
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name) and f.value.id == "self"):
+            cls = _innermost_class(stack)
+            if cls is not None:
+                pos = donated_self.get((id(cls), f.attr))
+                if pos:
+                    return pos, f"self.{f.attr}"
+        return None, None
+
+    def _donation_in_function(self, module: ParsedModule, fn,
+                              calls) -> List[Finding]:
+        found: List[Finding] = []
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                book = loads if isinstance(node.ctx, ast.Load) else stores
+                book.setdefault(node.id, []).append(node.lineno)
+        for call_line, callee, names in calls:
+            for name in names:
+                for load_line in loads.get(name, ()):
+                    if load_line <= call_line:
+                        continue
+                    rebound = any(call_line <= s <= load_line
+                                  for s in stores.get(name, ()))
+                    if not rebound:
+                        found.append(self.finding(
+                            module.rel, load_line,
+                            f"{fn.name}: {name!r} read after being "
+                            f"donated to {callee} (donate_argnums — the "
+                            "buffer may be aliased into the output)"))
+                        break
+        return found
+
+
+class _LambdaShim:
+    """Adapter so a jitted lambda flows through the same scan paths as a
+    named function."""
+
+    __slots__ = ("node", "name", "lineno")
+
+    def __init__(self, node: ast.Lambda) -> None:
+        self.node = node
+        self.name = f"<lambda:{node.lineno}>"
+        self.lineno = node.lineno
+
+
+def _innermost_function(stack):
+    for frame in stack:
+        if isinstance(frame, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return frame
+    return None
+
+
+def _innermost_class(stack):
+    for frame in stack:
+        if isinstance(frame, ast.ClassDef):
+            return frame
+    return None
+
+
+class _ScopeIndex:
+    """One pass over a module tree recording where every function def,
+    call, and assignment sits — so ``jax.jit(step)`` resolves ``step``
+    with *Python* scoping.  A bare name can never reach a class method
+    (``self.step`` the host method vs ``step`` the jitted closure in
+    ``__init__`` — the exact shape of every streaming core in this
+    repo), and an inner definition shadows an outer one.
+
+    ``stack`` tuples are innermost-first chains of enclosing scope
+    nodes (functions, classes, lambdas); the module scope is the empty
+    tail.
+    """
+
+    def __init__(self, tree: ast.AST) -> None:
+        self.defs: List[Tuple[object, tuple]] = []
+        self.calls: List[Tuple[ast.Call, tuple]] = []
+        self.assigns: List[Tuple[ast.Assign, tuple]] = []
+        #: (id(parent frame) or None, name) -> [def nodes]
+        self._by_scope: Dict[Tuple[object, str], List[object]] = {}
+        self._stacks: Dict[int, tuple] = {}
+        self._visit(tree, ())
+
+    def _visit(self, node: ast.AST, stack: tuple) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.append((child, stack))
+                self._stacks[id(child)] = stack
+                parent = stack[0] if stack else None
+                self._by_scope.setdefault(
+                    (id(parent) if parent is not None else None,
+                     child.name), []).append(child)
+                self._visit(child, (child, *stack))
+            elif isinstance(child, (ast.ClassDef, ast.Lambda)):
+                self._stacks[id(child)] = stack
+                self._visit(child, (child, *stack))
+            else:
+                if isinstance(child, ast.Call):
+                    self.calls.append((child, stack))
+                elif isinstance(child, ast.Assign):
+                    self.assigns.append((child, stack))
+                self._visit(child, stack)
+
+    def stack_of(self, node: ast.AST) -> tuple:
+        return self._stacks.get(id(node), ())
+
+    def resolve(self, name: str, stack: tuple) -> List[object]:
+        """Defs a bare ``name`` can reach from ``stack``: enclosing
+        function scopes innermost-out (class frames are invisible to
+        nested scopes — Python semantics), then the module scope."""
+        for frame in stack:
+            if isinstance(frame, ast.ClassDef):
+                continue
+            hit = self._by_scope.get((id(frame), name))
+            if hit:
+                return hit
+        return self._by_scope.get((None, name), [])

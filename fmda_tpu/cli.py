@@ -16,7 +16,11 @@ operations are subcommands over one file-backed warehouse:
                 scraped from a running ``/snapshot`` endpoint;
 - ``trace``     inspect recorded tick traces (per-stage latency
                 attribution) from a ``--trace-out`` file or a running
-                ``/trace`` endpoint.
+                ``/trace`` endpoint;
+- ``lint``      framework-aware static analysis over the package
+                (lock discipline, jit purity, JAX API drift, topic
+                cross-checks, hygiene rules); exit 0 = clean against
+                the baseline, 1 = new findings, 2 = usage error.
 
 Every command is a thin composition of the public library API — anything
 the CLI does is one import away in a notebook.
@@ -1122,6 +1126,80 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """The static-analysis gate (docs/analysis.md).  Exit-code contract
+    mirrors the serve-fleet gates: 0 = clean against the baseline,
+    1 = new findings, 2 = usage error — CI scripts can gate on it
+    directly and parse ``--json`` for the details."""
+    import pathlib
+
+    from fmda_tpu.analysis import default_rules, run_lint
+
+    if not args.no_drift:
+        import importlib.util
+
+        if importlib.util.find_spec("jax") is None:
+            print(
+                "jax is not installed on this host — the jax-api-drift "
+                "rule has nothing to resolve against; re-run with "
+                "--no-drift",
+                file=sys.stderr)
+            return 2
+    rules = default_rules(drift=not args.no_drift)
+    if args.rule:
+        by_id = {r.id: r for r in rules}
+        unknown = [rid for rid in args.rule if rid not in by_id]
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(available: {', '.join(sorted(by_id))})",
+                file=sys.stderr)
+            return 2
+        rules = [by_id[rid] for rid in args.rule]
+    baseline = pathlib.Path(args.baseline) if args.baseline else None
+    if baseline is not None and not baseline.is_file():
+        # only the *default* baseline may be absent (fresh tree); an
+        # explicit path that resolves to nothing is a typo, and gating
+        # against an empty register silently would defeat the gate
+        print(f"baseline file not found: {baseline}", file=sys.stderr)
+        return 2
+    try:
+        result = run_lint(rules, baseline_path=baseline)
+    except ValueError as exc:  # malformed baseline (no justification, …)
+        print(str(exc), file=sys.stderr)
+        return 2
+    if args.drift_report:
+        if "jax_api_drift" not in result.reports:
+            # --no-drift or a --rule filter excluded the drift rule:
+            # silently leaving a stale inventory on disk would be worse
+            # than refusing
+            print(
+                "--drift-report needs the jax-api-drift rule in the "
+                "run (drop --no-drift / include --rule jax-api-drift)",
+                file=sys.stderr)
+            return 2
+        out = pathlib.Path(args.drift_report)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(
+            json.dumps(result.reports["jax_api_drift"], indent=2) + "\n")
+    if args.json:
+        print(json.dumps(result.as_dict(), indent=2))
+        return 0 if result.ok else 1
+    for f in result.new:
+        print(f.format())
+    for e in result.stale_baseline:
+        print(
+            f"stale baseline entry (debt paid — prune it): "
+            f"[{e['rule']}] {e['path']}: {e['message']}",
+            file=sys.stderr)
+    print(f"{result.n_modules} modules: {len(result.new)} new finding(s), "
+          f"{len(result.baselined)} baselined, "
+          f"{result.suppressed} suppressed, "
+          f"{len(result.stale_baseline)} stale baseline entr"
+          f"{'y' if len(result.stale_baseline) == 1 else 'ies'}")
+    return 0 if result.ok else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="fmda_tpu", description=__doc__,
@@ -1391,6 +1469,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (grouped trace dicts)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "lint",
+        help="framework-aware static analysis gate (docs/analysis.md)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable result document (schema "
+                        "covered by tests/test_analysis.py)")
+    p.add_argument("--rule", action="append", default=None, metavar="ID",
+                   help="run only this rule (repeatable); baseline "
+                        "entries for other rules are ignored, not stale")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline JSON of grandfathered findings "
+                        "(default: fmda_tpu/analysis/baseline.json)")
+    p.add_argument("--no-drift", action="store_true",
+                   help="skip the JAX API-drift resolver — the one rule "
+                        "that imports jax (fast editor loops, jax-free "
+                        "hosts)")
+    p.add_argument("--drift-report", default=None, metavar="FILE",
+                   help="write the machine-readable jax drift inventory "
+                        "(the porting work-list artifact: "
+                        "artifacts/jax_api_drift.json in this repo)")
+    p.set_defaults(fn=cmd_lint)
     return parser
 
 

@@ -98,6 +98,7 @@ class EventLog:
                 self._fh = None
 
     def __len__(self) -> int:
+        # lock-free: deque len is GIL-atomic; scrape-time skew tolerated
         return len(self._ring)
 
     def __enter__(self) -> "EventLog":

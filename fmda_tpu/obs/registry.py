@@ -68,10 +68,14 @@ class Counter:
 
     @property
     def value(self) -> float:
+        # hot-loop callers poll this between updates and tolerate skew
+        # lock-free: GIL-atomic float read
         return self._value
 
     def sample(self) -> Sample:
-        return {"name": self.name, "labels": self.labels, "value": self._value}
+        with self._lock:  # scrape reads must not tear against inc()
+            return {"name": self.name, "labels": self.labels,
+                    "value": self._value}
 
 
 class Gauge:
@@ -91,10 +95,13 @@ class Gauge:
 
     @property
     def value(self) -> float:
+        # lock-free: GIL-atomic float read (see Counter.value)
         return self._value
 
     def sample(self) -> Sample:
-        return {"name": self.name, "labels": self.labels, "value": self._value}
+        with self._lock:  # scrape reads must not tear against set()
+            return {"name": self.name, "labels": self.labels,
+                    "value": self._value}
 
 
 class LatencyHistogram:

@@ -237,7 +237,8 @@ class Tracer:
 
     @property
     def capacity(self) -> int:
-        return self._ring.maxlen or 0
+        with self._lock:  # configure() can swap the ring under us
+            return self._ring.maxlen or 0
 
     def configure(
         self,
@@ -271,6 +272,8 @@ class Tracer:
     # -- recording ------------------------------------------------------------
 
     def _sampled(self) -> bool:
+        # a configure() race skews at most one sampling draw
+        # lock-free: GIL-atomic read of a config float on the hot path
         return (self.sample_rate >= 1.0
                 or self._rng.random() < self.sample_rate)
 
@@ -297,6 +300,9 @@ class Tracer:
             # their duration IS the end-to-end latency.  Context-manager
             # roots (e.g. session_tick) close before downstream stages
             # attach, so their duration would understate the journey.
+            # the histogram carries its own lock — never nest it under
+            # ours; a clear() race loses at most one observation
+            # lock-free: e2e observe deliberately outside the ring lock
             self.e2e.observe(seconds)
 
     def maybe_trace(self) -> Optional[TraceRef]:
@@ -306,9 +312,12 @@ class Tracer:
         no allocation past the sampling draw — when disabled or
         unsampled: **the** one-branch hot-path check.
         """
-        if not self.enabled or not self._sampled():
+        if not self.enabled or not self._sampled():  # lock-free: THE
+            # one-branch disabled-path check (GIL-atomic bool read)
             return None
-        self.traces_started += 1
+        with self._lock:  # two gateways starting ticks must not lose
+            # a count to a torn read-modify-write
+            self.traces_started += 1
         return TraceRef(_new_id(), _new_id(), now_ns())
 
     def finish_root(self, ref: TraceRef, name: str, stage: str,
@@ -347,15 +356,17 @@ class Tracer:
         """New sampled trace scoping the enclosed code (sets the
         ContextVar, so nested :meth:`span` calls and bus publishes
         inherit it).  No-op singleton when disabled/unsampled."""
-        if not self.enabled or not self._sampled():
+        if not self.enabled or not self._sampled():  # lock-free: the
+            # one-branch disabled-path check (GIL-atomic bool read)
             return _NULL_CM
-        self.traces_started += 1
+        with self._lock:  # see maybe_trace — counted, not torn
+            self.traces_started += 1
         return _SpanCM(self, name, stage, _new_id(), None)
 
     def span(self, name: str, stage: str):
         """Child span of the *active* context; no-op singleton when
         disabled or when no trace is active (never creates orphans)."""
-        if not self.enabled:
+        if not self.enabled:  # lock-free: one-branch disabled path
             return _NULL_CM
         ctx = _CURRENT.get()
         if ctx is None:
@@ -386,7 +397,7 @@ class Tracer:
         ``trace_stage_count`` keyed by span name) and ring gauges — what
         ``/snapshot`` and ``python -m fmda_tpu status`` show."""
         out: Snapshot = {"counters": [], "gauges": [], "histograms": []}
-        if not self.enabled:
+        if not self.enabled:  # lock-free: one-branch disabled path
             return out
         with self._lock:
             totals = {k: tuple(v) for k, v in self._stage_totals.items()}
@@ -395,6 +406,7 @@ class Tracer:
             started = self.traces_started
             finished = self.traces_finished
             exemplars = dict(self._exemplars)
+            e2e = self.e2e  # clear() swaps the histogram; pin one
         for name in sorted(totals):
             total_s, count = totals[name]
             out["counters"].append({
@@ -415,15 +427,15 @@ class Tracer:
         out["gauges"].append(
             {"name": "trace_spans_buffered", "labels": {},
              "value": buffered})
-        if self.e2e.n:
-            s = self.e2e.sample()
+        if e2e.n:
+            s = e2e.sample()
             # sample-linked exemplars: sparse cumulative buckets (only
             # the occupied bins + the implicit +Inf — cumulative counts
             # stay exact over a sparse `le` series) with the last trace
             # id per bucket.  /snapshot serves this verbatim; the
             # Prometheus renderer switches this one series to histogram
             # exposition with OpenMetrics exemplar syntax.
-            snap = self.e2e.snapshot()
+            snap = e2e.snapshot()
             buckets = []
             cum = 0
             for b, c in enumerate(snap["counts"]):

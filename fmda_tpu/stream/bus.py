@@ -129,13 +129,15 @@ class InProcessBus:
         and one lock-guarded increment; topics added later
         (:meth:`add_topic`) get their counters on first touch."""
         self._metrics_registry = registry
+        with self._lock:  # add_topic can race a live-fleet bind
+            topics = tuple(self._logs)
         self._publish_counters = {
             t: registry.counter("bus_published_total", topic=t)
-            for t in self._logs
+            for t in topics
         }
         consume_counters = {
             t: registry.counter("bus_consumed_total", topic=t)
-            for t in self._logs
+            for t in topics
         }
 
         def consumed(topic: str, n: int) -> None:
@@ -147,7 +149,8 @@ class InProcessBus:
 
         self._consumed_cb = consumed
 
-    def _check_topic(self, topic: str) -> None:
+    def _check_topic_locked(self, topic: str) -> None:
+        """Caller must hold ``self._lock`` (reads the topic map)."""
         if topic not in self._logs:
             raise KeyError(
                 f"unknown topic {topic!r}; configured: {sorted(self._logs)}"
@@ -182,7 +185,7 @@ class InProcessBus:
         # the stored value from caller-side mutation), like a real broker
         value = json.loads(json.dumps(value))
         with self._lock:
-            self._check_topic(topic)
+            self._check_topic_locked(topic)
             offset = self._next[topic]
             self._next[topic] = offset + 1
             log = self._logs[topic]
@@ -191,9 +194,22 @@ class InProcessBus:
                 drop = len(log) - self._capacity
                 del log[:drop]
                 self._base[topic] += drop
-        if self._publish_counters is not None:
-            self._publish_counters[topic].inc()
+        self._count_published(topic, 1)
         return offset
+
+    def _count_published(self, topic: str, n: int) -> None:
+        """Publish-counter bump with create-on-first-touch: a topic
+        added concurrently with ``bind_metrics`` can miss the snapshot
+        on either side, and the hot path must count it, never KeyError
+        (the never-abort contract reaches down to here)."""
+        counters = self._publish_counters
+        if counters is None:
+            return
+        counter = counters.get(topic)
+        if counter is None:
+            counter = counters[topic] = self._metrics_registry.counter(
+                "bus_published_total", topic=topic)
+        counter.inc(n)
 
     def publish_many(self, topic: str, values) -> List[int]:
         """Batched :meth:`publish`: one JSON round-trip and one lock
@@ -208,7 +224,7 @@ class InProcessBus:
             return []
         offsets: List[int] = []
         with self._lock:
-            self._check_topic(topic)
+            self._check_topic_locked(topic)
             log = self._logs[topic]
             offset = self._next[topic]
             for value in values:
@@ -220,15 +236,14 @@ class InProcessBus:
                 drop = len(log) - self._capacity
                 del log[:drop]
                 self._base[topic] += drop
-        if self._publish_counters is not None:
-            self._publish_counters[topic].inc(len(offsets))
+        self._count_published(topic, len(offsets))
         return offsets
 
     def read(
         self, topic: str, offset: int, max_records: Optional[int] = None
     ) -> List[Record]:
         with self._lock:
-            self._check_topic(topic)
+            self._check_topic_locked(topic)
             base = self._base[topic]
             start = max(offset - base, 0)
             log = self._logs[topic]
@@ -237,11 +252,12 @@ class InProcessBus:
 
     def end_offset(self, topic: str) -> int:
         with self._lock:
-            self._check_topic(topic)
+            self._check_topic_locked(topic)
             return self._next[topic]
 
     def topics(self) -> Sequence[str]:
-        return tuple(self._logs)
+        with self._lock:  # concurrent add_topic must not tear the walk
+            return tuple(self._logs)
 
     def consumer(self, topic: str, *, from_end: bool = False) -> Consumer:
         c = Consumer(self, topic)

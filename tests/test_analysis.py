@@ -1,0 +1,567 @@
+"""fmda_tpu.analysis: engine, rule fixtures, baseline, CLI (ISSUE 8).
+
+Layout mirrors the acceptance criteria: every analyzer gets a
+true-positive/true-negative fixture pair, the baseline suppression
+round-trips, the ``--json`` schema is pinned, and ONE test runs the
+whole suite against the shipped baseline — the tier-1 gate every future
+PR lands under.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+import fmda_tpu
+from fmda_tpu.analysis import (
+    BusTopicRule,
+    ChaosGuardRule,
+    Finding,
+    JaxApiDriftRule,
+    JitPurityRule,
+    LintContext,
+    LockDisciplineRule,
+    LoggingHygieneRule,
+    ParsedModule,
+    SpanClockRule,
+    apply_baseline,
+    collect_modules,
+    default_rules,
+    load_baseline,
+    run_lint,
+    run_rules,
+    save_baseline,
+)
+
+PACKAGE_DIR = pathlib.Path(fmda_tpu.__file__).parent
+
+
+def run_on(rule, sources, package_dir=PACKAGE_DIR):
+    """Run one rule over ``{rel: source}`` fixture modules."""
+    modules = [ParsedModule.from_source(src, rel)
+               for rel, src in sources.items()]
+    ctx = LintContext(package_dir, modules)
+    findings, suppressed = run_rules([rule], ctx)
+    return findings, suppressed, ctx
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+def test_parsed_module_comment_map_ignores_strings():
+    m = ParsedModule.from_source(
+        's = "# not a comment"\nx = 1  # real comment\n')
+    assert m.comments == {2: "real comment"}
+
+
+def test_finding_key_is_line_free():
+    a = Finding("r", "p.py", 10, "msg")
+    b = Finding("r", "p.py", 99, "msg")
+    assert a.key == b.key
+    assert set(a.as_dict()) == {"rule", "path", "line", "severity",
+                                "message"}
+
+
+def test_generic_ignore_hatch_requires_a_reason():
+    src_with = ("import threading\n"
+                "class C:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self.n = 0\n"
+                "    def bump(self):\n"
+                "        with self._lock:\n"
+                "            self.n += 1\n"
+                "    def peek(self):\n"
+                "        return self.n  "
+                "# lint: ignore[lock-discipline] scrape-time skew is fine\n")
+    findings, suppressed, _ = run_on(
+        LockDisciplineRule(), {"mod.py": src_with})
+    assert not findings and suppressed == 1
+    src_bare = src_with.replace(" scrape-time skew is fine", "")
+    findings, suppressed, _ = run_on(
+        LockDisciplineRule(), {"mod.py": src_bare})
+    assert len(findings) == 1 and suppressed == 0  # reasonless = inert
+
+
+# ---------------------------------------------------------------------------
+# Lock discipline
+# ---------------------------------------------------------------------------
+
+LOCK_TP = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    def peek(self):
+        return self.n
+"""
+
+
+def test_lock_rule_flags_unguarded_read():
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": LOCK_TP})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "lock-discipline"
+    assert "C.peek" in f.message and "self.n" in f.message
+
+
+def test_lock_rule_clean_when_guarded():
+    src = LOCK_TP.replace(
+        "    def peek(self):\n        return self.n\n",
+        "    def peek(self):\n        with self._lock:\n"
+        "            return self.n\n")
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": src})
+    assert not findings
+
+
+def test_lock_rule_guarded_by_annotation_alone():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.state = {}  # guarded-by: _lock\n"
+           "    def read(self):\n"
+           "        return self.state\n")
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": src})
+    assert len(findings) == 1 and "self.state" in findings[0].message
+
+
+def test_lock_rule_lock_free_hatch():
+    src = LOCK_TP.replace(
+        "        return self.n",
+        "        # lock-free: GIL-atomic int read, skew tolerated\n"
+        "        return self.n")
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": src})
+    assert not findings
+
+
+def test_lock_rule_locked_suffix_contract():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.n = 0\n"
+           "    def _peek_locked(self):\n"
+           "        return self.n\n"
+           "    def good(self):\n"
+           "        with self._lock:\n"
+           "            self.n += 1\n"
+           "            return self._peek_locked()\n"
+           "    def bad(self):\n"
+           "        return self._peek_locked()\n")
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": src})
+    assert len(findings) == 1
+    assert "C.bad" in findings[0].message
+    assert "_peek_locked" in findings[0].message
+
+
+def test_lock_rule_infers_guarded_from_container_mutation():
+    # the repo's dominant shape: shared dicts/deques mutated in place
+    # under the lock, never rebound — the inference must see
+    # subscript stores and mutator-method calls, not just `self.x = ...`
+    src = ("import threading\n"
+           "class Bus:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._logs = {}\n"
+           "    def publish(self, topic, rec):\n"
+           "        with self._lock:\n"
+           "            self._logs[topic].append(rec)\n"
+           "    def read(self, topic):\n"
+           "        return list(self._logs[topic])\n")
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": src})
+    assert len(findings) == 1
+    assert "Bus.read" in findings[0].message
+    assert "self._logs" in findings[0].message
+
+
+def test_lock_rule_init_exempt_and_lockless_class_skipped():
+    src = ("class NoLock:\n"
+           "    def __init__(self):\n"
+           "        self.n = 0\n"
+           "    def bump(self):\n"
+           "        self.n += 1\n")
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": src})
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# Jit purity
+# ---------------------------------------------------------------------------
+
+
+def test_purity_flags_wall_clock_in_decorated_jit():
+    src = ("import time\n"
+           "import jax\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    t = time.time()\n"
+           "    return x + t\n")
+    findings, _, _ = run_on(JitPurityRule(), {"mod.py": src})
+    assert any("wall-clock" in f.message for f in findings)
+
+
+def test_purity_transitive_one_level():
+    src = ("import jax\n"
+           "def helper(x):\n"
+           "    print(x)\n"
+           "    return x\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    return helper(x)\n")
+    findings, _, _ = run_on(JitPurityRule(), {"mod.py": src})
+    assert any("print" in f.message and "helper" in f.message
+               for f in findings)
+
+
+def test_purity_host_method_sharing_a_jitted_closure_name_is_clean():
+    # the repo's streaming-core shape: `step` the host method calls
+    # `self._step`, the jitted closure ALSO named `step` — Python
+    # scoping must keep the host method out of the jit-reachable set
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "class Core:\n"
+           "    def __init__(self):\n"
+           "        def step(carry, row):\n"
+           "            return carry + row\n"
+           "        self._step = jax.jit(step)\n"
+           "    def step(self, row):\n"
+           "        self.count = 1\n"
+           "        out = self._step(self.carry, row)\n"
+           "        return np.asarray(out)\n")
+    findings, _, _ = run_on(JitPurityRule(), {"mod.py": src})
+    assert not findings
+
+
+def test_purity_flags_self_mutation_and_host_rng():
+    src = ("import jax\n"
+           "import random\n"
+           "class M:\n"
+           "    def build(self):\n"
+           "        def step(x):\n"
+           "            self.cache = x\n"
+           "            return x * random.random()\n"
+           "        return jax.jit(step)\n")
+    findings, _, _ = run_on(JitPurityRule(), {"mod.py": src})
+    msgs = "\n".join(f.message for f in findings)
+    assert "mutates self.cache" in msgs
+    assert "host RNG" in msgs
+
+
+def test_purity_donation_use_after_donate():
+    src = ("import jax\n"
+           "def train(fn, state, batch):\n"
+           "    step = jax.jit(fn, donate_argnums=(0,))\n"
+           "    out = step(state, batch)\n"
+           "    return out, state\n")
+    findings, _, _ = run_on(JitPurityRule(), {"mod.py": src})
+    assert any("donated" in f.message and "'state'" in f.message
+               for f in findings)
+
+
+def test_purity_donation_rebind_is_clean():
+    src = ("import jax\n"
+           "def train(fn, state, batch):\n"
+           "    step = jax.jit(fn, donate_argnums=(0,))\n"
+           "    state = step(state, batch)\n"
+           "    return state\n")
+    findings, _, _ = run_on(JitPurityRule(), {"mod.py": src})
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# JAX API drift
+# ---------------------------------------------------------------------------
+
+
+def test_drift_flags_missing_symbol_in_scope():
+    src = ("import jax\n"
+           "x = jax.numpy.definitely_not_an_api_zz\n")
+    findings, _, _ = run_on(JaxApiDriftRule(), {"ops/fake.py": src})
+    assert len(findings) == 1
+    assert "jax.numpy.definitely_not_an_api_zz" in findings[0].message
+    assert findings[0].severity == "error"
+
+
+def test_drift_resolves_aliases_and_skips_out_of_scope():
+    good = ("import jax\n"
+            "import jax.numpy as jnp\n"
+            "from jax import lax\n"
+            "y = jnp.ones\n"
+            "z = lax.scan\n"
+            "w = jax.tree_util.tree_map\n")
+    findings, _, _ = run_on(JaxApiDriftRule(), {"ops/fake.py": good})
+    assert not findings
+    bad_but_out_of_scope = ("import jax\n"
+                            "x = jax.numpy.definitely_not_an_api_zz\n")
+    findings, _, _ = run_on(
+        JaxApiDriftRule(), {"stream/fake.py": bad_but_out_of_scope})
+    assert not findings
+
+
+def test_drift_report_inventory_shape():
+    src = ("import jax\n"
+           "a = jax.numpy.definitely_not_an_api_zz\n"
+           "b = jax.numpy.definitely_not_an_api_zz\n")
+    _, _, ctx = run_on(JaxApiDriftRule(), {"parallel/fake.py": src})
+    rep = ctx.reports["jax_api_drift"]
+    assert rep["n_symbols"] == 1
+    sites = rep["symbols"]["jax.numpy.definitely_not_an_api_zz"]
+    assert [s["line"] for s in sites] == [2, 3]
+    assert rep["jax_version"]
+
+
+# ---------------------------------------------------------------------------
+# Bus topics
+# ---------------------------------------------------------------------------
+
+TOPIC_CONFIG = ('TOPIC_A = "alpha"\n'
+                'TOPIC_FLEET_TICKS_PREFIX = "fleet_ticks_"\n')
+
+
+def test_topics_flags_published_but_never_declared():
+    src = ('def go(bus):\n'
+           '    bus.publish("typo_topic", {})\n')
+    findings, _, _ = run_on(
+        BusTopicRule(), {"config.py": TOPIC_CONFIG, "mod.py": src})
+    assert len(findings) == 1
+    assert "'typo_topic'" in findings[0].message
+
+
+def test_topics_clean_paths():
+    src = ('from fmda_tpu.config import TOPIC_A, TOPIC_FLEET_TICKS_PREFIX\n'
+           'def go(bus, wid):\n'
+           '    bus.publish("alpha", {})\n'          # config literal
+           '    bus.publish(TOPIC_A, {})\n'          # config constant
+           '    bus.publish(TOPIC_FLEET_TICKS_PREFIX + wid, {})\n'  # prefix
+           '    bus.publish_many("beta", [])\n'      # consumed elsewhere
+           '    bus.publish(wid, {})\n')             # dynamic: skipped
+    other = ('def listen(bus):\n'
+             '    bus.consumer("beta")\n')
+    findings, _, ctx = run_on(
+        BusTopicRule(),
+        {"config.py": TOPIC_CONFIG, "mod.py": src, "other.py": other})
+    assert not findings
+    assert ctx.reports["bus_topics"]["declared"] == ["alpha"]
+
+
+# ---------------------------------------------------------------------------
+# Hygiene rules (fixture-level; repo-level runs live in
+# tests/test_logging_hygiene.py)
+# ---------------------------------------------------------------------------
+
+
+def test_logging_rule_fixture_pair():
+    bad = 'print("hi")\n'
+    findings, _, _ = run_on(LoggingHygieneRule(), {"stream/x.py": bad})
+    assert len(findings) == 1 and "print()" in findings[0].message
+    good = ('import logging\n'
+            'log = logging.getLogger("fmda_tpu.x")\n')
+    findings, _, _ = run_on(LoggingHygieneRule(), {"stream/x.py": good})
+    assert not findings
+    # allowlisted module: prints are its contract
+    findings, _, _ = run_on(LoggingHygieneRule(), {"cli.py": bad})
+    assert not findings
+
+
+def test_span_clock_rule_fixture_pair():
+    bad = ("import time\n"
+           "t = time.time()\n")
+    findings, _, _ = run_on(SpanClockRule(), {"obs/trace.py": bad})
+    assert any("time.time()" in f.message for f in findings)
+    good = ("import time\n"
+            "t = time.perf_counter_ns()\n")
+    findings, _, _ = run_on(SpanClockRule(), {"obs/trace.py": good})
+    assert not findings
+
+
+def test_chaos_rule_fixture_pair():
+    bad = ("from fmda_tpu.chaos import default_chaos\n"
+           "_CHAOS = default_chaos()\n"
+           "def pump():\n"
+           "    _CHAOS.check('router.pump')\n")
+    findings, _, _ = run_on(ChaosGuardRule(), {"fleet/router.py": bad})
+    assert any("outside an `if _CHAOS.enabled:`" in f.message
+               for f in findings)
+    good = bad.replace(
+        "    _CHAOS.check('router.pump')",
+        "    if _CHAOS.enabled:\n"
+        "        _CHAOS.check('router.pump')")
+    findings, _, _ = run_on(ChaosGuardRule(), {"fleet/router.py": good})
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_round_trip_and_staleness(tmp_path):
+    f1 = Finding("lock-discipline", "a.py", 3, "A.m: read of self.x")
+    f2 = Finding("lock-discipline", "b.py", 9, "B.m: read of self.y")
+    path = tmp_path / "baseline.json"
+    save_baseline(
+        [{**f1.as_dict(), "justification": "deliberate snapshot read"}],
+        path)
+    entries = load_baseline(path)
+    new, old, stale = apply_baseline([f1, f2], entries)
+    assert [f.key for f in old] == [f1.key]
+    assert [f.key for f in new] == [f2.key]
+    assert not stale
+    # the grandfathered finding moved lines: still matched (key is
+    # line-free); once fixed, the entry reports stale
+    moved = Finding(f1.rule, f1.path, 77, f1.message)
+    new, old, stale = apply_baseline([moved], entries)
+    assert old and not new and not stale
+    new, old, stale = apply_baseline([], entries)
+    assert stale and stale[0]["path"] == "a.py"
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({
+        "version": 1,
+        "findings": [{"rule": "r", "path": "p.py", "message": "m",
+                      "justification": "  "}],
+    }))
+    with pytest.raises(ValueError, match="justification"):
+        load_baseline(path)
+
+
+def test_baseline_rejects_unknown_version(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({"version": 99, "findings": []}))
+    with pytest.raises(ValueError, match="version"):
+        load_baseline(path)
+
+
+# ---------------------------------------------------------------------------
+# CLI contract + --json schema stability
+# ---------------------------------------------------------------------------
+
+
+def test_lint_json_schema(capsys):
+    from fmda_tpu import cli
+
+    rc = cli.main(["lint", "--json", "--no-drift"])
+    doc = json.loads(capsys.readouterr().out)
+    # schema is load-bearing for CI scripts: extend, don't rename
+    assert set(doc) == {"ok", "n_modules", "new", "baselined",
+                        "suppressed", "stale_baseline", "reports"}
+    assert doc["ok"] is True and rc == 0
+    assert doc["n_modules"] > 50
+    assert "bus_topics" in doc["reports"]
+
+
+def test_lint_unknown_rule_is_usage_error(capsys):
+    from fmda_tpu import cli
+
+    rc = cli.main(["lint", "--rule", "no-such-rule", "--no-drift"])
+    assert rc == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_lock_rule_sees_through_match_statements():
+    # a lock acquired inside a `match` case must not read as unlocked
+    # (and writes there must still mark the attribute guarded)
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.n = 0\n"
+           "    def bump(self, kind):\n"
+           "        match kind:\n"
+           "            case 'inc':\n"
+           "                with self._lock:\n"
+           "                    self.n += 1\n"
+           "    def peek(self):\n"
+           "        return self.n\n")
+    findings, _, _ = run_on(LockDisciplineRule(), {"mod.py": src})
+    assert len(findings) == 1
+    assert "C.peek" in findings[0].message
+
+
+def test_lint_stale_baseline_entry_fails_the_gate(capsys, tmp_path):
+    # a paid-off debt left in the baseline exits 1 — the CLI, the bench
+    # phase, and the tier-1 test agree on `LintResult.ok`
+    from fmda_tpu import cli
+
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({
+        "version": 1,
+        "findings": [{"rule": "lock-discipline", "path": "gone.py",
+                      "message": "paid off long ago",
+                      "justification": "was deliberate once"}],
+    }))
+    rc = cli.main(["lint", "--no-drift", "--baseline", str(path)])
+    captured = capsys.readouterr()
+    assert rc == 1
+    assert "stale baseline entry" in captured.err
+    assert "1 stale baseline entry" in captured.out
+
+
+def test_lint_drift_report_without_drift_rule_is_usage_error(
+        capsys, tmp_path):
+    from fmda_tpu import cli
+
+    out = tmp_path / "drift.json"
+    rc = cli.main(["lint", "--no-drift", "--drift-report", str(out)])
+    assert rc == 2
+    assert "--no-drift" in capsys.readouterr().err
+    assert not out.exists()
+
+
+def test_lint_missing_explicit_baseline_is_usage_error(capsys, tmp_path):
+    # only the DEFAULT baseline may be absent; a typo'd --baseline must
+    # not silently gate against an empty register
+    from fmda_tpu import cli
+
+    rc = cli.main(["lint", "--no-drift",
+                   "--baseline", str(tmp_path / "nope.json")])
+    assert rc == 2
+    assert "baseline file not found" in capsys.readouterr().err
+
+
+def test_lint_single_rule_filter(capsys):
+    from fmda_tpu import cli
+
+    rc = cli.main(["lint", "--rule", "lock-discipline", "--no-drift"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "0 new finding(s)" in out
+    # rule filtering must not report other rules' baseline as stale
+    # (the 9 drift entries are ignored, not stale — else rc would be 1)
+    assert "0 stale baseline entries" in out
+
+
+# ---------------------------------------------------------------------------
+# THE gate: the whole suite runs clean against the shipped baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_is_lint_clean_against_baseline():
+    """Tier-1 equivalent of ``python -m fmda_tpu lint`` exiting 0: zero
+    non-baselined findings across every rule (drift included), and no
+    stale debt entries hiding in the baseline."""
+    result = run_lint(default_rules())
+    assert result.n_modules > 50
+    assert not result.new, "new static-analysis findings:\n" + "\n".join(
+        f.format() for f in result.new)
+    assert not result.stale_baseline, (
+        "baseline entries whose debt was paid — prune them:\n"
+        + json.dumps(result.stale_baseline, indent=2))
+    # the drift inventory stays in sync with the grandfathered findings
+    rep = result.reports["jax_api_drift"]
+    baselined_syms = {f.message.split(": ", 1)[1]
+                      for f in result.baselined
+                      if f.rule == "jax-api-drift"}
+    assert set(rep["symbols"]) == baselined_syms

@@ -72,6 +72,7 @@ class TestPhaseRegistry:
             "runtime_chaos_soak",
             "obs_overhead",
             "trace_overhead",
+            "analysis_lint",
         }
         assert expected == set(bench._PHASES)
 

@@ -1,273 +1,103 @@
-"""Logging hygiene, enforced statically (ISSUE 2 satellite).
+"""Hygiene contracts, enforced through the static-analysis engine.
 
-Library code must report through the observability plane or the
-``fmda_tpu`` logger hierarchy — never ``print()`` (invisible to any
-operator collecting logs, corrupts CLI JSON output) and never a logger
-outside the ``fmda_tpu`` namespace (escapes the hierarchy operators
-configure).  This is an AST walk over every module in the package, so a
-violation fails tier-1 the commit it appears.
-
-Allowlist: ``cli.py`` (stdout IS its interface) and ``utils/env.py``
-(prints inside a generated subprocess probe script).
+These four checks (ISSUE 2/4/6/7 satellites) used to be ad-hoc AST
+walks in this file; the logic now lives in
+``fmda_tpu.analysis.hygiene`` where ``python -m fmda_tpu lint`` runs it
+alongside the race/purity/drift analyzers.  Each test here is a thin
+wrapper running ONE rule through the engine and asserting zero
+findings, so the tier-1 effect (a violation fails the commit it
+appears) is unchanged — plus the one check static analysis can't do:
+the transitive jax-free import probe in a clean subprocess.
 """
 
-import ast
 import pathlib
 
 import fmda_tpu
+from fmda_tpu.analysis import (
+    ChaosGuardRule,
+    LoggingHygieneRule,
+    RouterJaxImportRule,
+    SpanClockRule,
+    collect_modules,
+    run_rules,
+)
+from fmda_tpu.analysis.hygiene import ROUTER_ROLE_MODULES
 
 PACKAGE_DIR = pathlib.Path(fmda_tpu.__file__).parent
 
-#: modules whose prints are their contract, relative to the package root
-ALLOWLIST = {"cli.py", "utils/env.py"}
-
-LOGGER_NAMESPACE = "fmda_tpu"
+_CTX = None
 
 
-def _module_files():
-    return sorted(
-        p for p in PACKAGE_DIR.rglob("*.py")
-        if str(p.relative_to(PACKAGE_DIR)) not in ALLOWLIST
-    )
+def _ctx():
+    global _CTX
+    if _CTX is None:
+        _CTX = collect_modules(PACKAGE_DIR)
+    return _CTX
 
 
-def _violations(path: pathlib.Path):
-    tree = ast.parse(path.read_text(), filename=str(path))
-    rel = path.relative_to(PACKAGE_DIR)
-    found = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if isinstance(fn, ast.Name) and fn.id == "print":
-            found.append(f"{rel}:{node.lineno}: print() call")
-        is_get_logger = (
-            isinstance(fn, ast.Attribute) and fn.attr == "getLogger"
-        ) or (isinstance(fn, ast.Name) and fn.id == "getLogger")
-        if is_get_logger:
-            if not node.args:
-                found.append(
-                    f"{rel}:{node.lineno}: getLogger() with no name "
-                    "(the root logger is not ours to configure)")
-                continue
-            arg = node.args[0]
-            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
-                name = arg.value
-                if name != LOGGER_NAMESPACE and not name.startswith(
-                        LOGGER_NAMESPACE + "."):
-                    found.append(
-                        f"{rel}:{node.lineno}: logger {name!r} outside "
-                        f"the {LOGGER_NAMESPACE!r} namespace")
-            elif isinstance(arg, ast.Name) and arg.id == "__name__":
-                pass  # module __name__ always resolves under fmda_tpu.*
-            else:
-                found.append(
-                    f"{rel}:{node.lineno}: getLogger() with a dynamic "
-                    "name — use a literal 'fmda_tpu.*' name")
-    return found
+def _run(rule):
+    findings, _suppressed = run_rules([rule], _ctx())
+    return findings
 
 
 def test_no_prints_or_foreign_loggers_in_library_code():
-    files = _module_files()
-    assert len(files) > 50  # the walk actually covers the package
-    violations = []
-    for path in files:
-        violations.extend(_violations(path))
-    assert not violations, (
+    ctx = _ctx()
+    assert ctx.modules and len(ctx.modules) > 50  # the walk covers the package
+    findings = _run(LoggingHygieneRule())
+    assert not findings, (
         "logging hygiene violations (report via the fmda_tpu logger "
-        "hierarchy or the obs plane):\n" + "\n".join(violations)
+        "hierarchy or the obs plane):\n"
+        + "\n".join(f.format() for f in findings)
     )
 
 
 def test_allowlisted_modules_exist():
     # a refactor that moves/renames an allowlisted module must shrink the
     # allowlist, not silently stop checking a path that no longer exists
-    for rel in ALLOWLIST:
+    # (the rule reports stale entries as findings — covered above — so
+    # this wrapper just pins the behavior explicitly)
+    from fmda_tpu.analysis.hygiene import PRINT_ALLOWLIST
+
+    for rel in PRINT_ALLOWLIST:
         assert (PACKAGE_DIR / rel).is_file(), f"stale allowlist entry {rel}"
-
-
-#: span-recording code, relative to the package root — everywhere span
-#: timestamps are minted (ISSUE 4 satellite)
-SPAN_CODE = {"obs/trace.py"}
-
-
-def _time_time_calls(path: pathlib.Path):
-    """Every ``time.time(...)`` / ``from time import time`` call site."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    rel = path.relative_to(PACKAGE_DIR)
-    found = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if (isinstance(fn, ast.Attribute) and fn.attr == "time"
-                and isinstance(fn.value, ast.Name)
-                and fn.value.id in ("time", "_time")):
-            found.append(f"{rel}:{node.lineno}: time.time() call")
-        elif isinstance(fn, ast.Name) and fn.id == "time":
-            found.append(f"{rel}:{node.lineno}: bare time() call")
-    return found
 
 
 def test_span_code_never_uses_wall_clock():
     """Span timestamps must come from ``time.perf_counter_ns`` —
     monotonic and ns-resolution, so a mid-run NTP step can never fold a
-    trace back on itself or make stage durations negative.  Enforced
-    statically over the span-recording modules: a ``time.time()`` call
-    there fails tier-1 the commit it appears."""
-    violations = []
-    for rel in sorted(SPAN_CODE):
-        path = PACKAGE_DIR / rel
-        assert path.is_file(), f"stale SPAN_CODE entry {rel}"
-        violations.extend(_time_time_calls(path))
-    assert not violations, (
+    trace back on itself or make stage durations negative."""
+    findings = _run(SpanClockRule())
+    assert not findings, (
         "span code must use time.perf_counter_ns, never time.time():\n"
-        + "\n".join(violations)
+        + "\n".join(f.format() for f in findings)
     )
-    # and the sanctioned clock is actually present
-    text = (PACKAGE_DIR / "obs/trace.py").read_text()
-    assert "perf_counter_ns" in text
-
-
-#: router-role fleet modules (ISSUE 6 satellite): a fleet router runs on
-#: a bus-only host, so NOTHING on its import path may pull jax in at
-#: module scope — only worker.py (which embeds the serving runtime) may
-ROUTER_ROLE_MODULES = (
-    "fleet/__init__.py",
-    "fleet/hashring.py",
-    "fleet/launcher.py",
-    "fleet/membership.py",
-    "fleet/router.py",
-    "fleet/state.py",
-    "fleet/wire.py",
-)
-
-
-def _module_scope_jax_imports(path: pathlib.Path):
-    """``import jax`` / ``from jax...`` statements at module scope
-    (anything not nested inside a function body)."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    rel = path.relative_to(PACKAGE_DIR)
-    found = []
-
-    def walk(body):
-        for node in body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue  # deferred imports are the sanctioned pattern
-            if isinstance(node, ast.Import):
-                for alias in node.names:
-                    root = alias.name.split(".")[0]
-                    if root == "jax":
-                        found.append(
-                            f"{rel}:{node.lineno}: import {alias.name}")
-            elif isinstance(node, ast.ImportFrom):
-                root = (node.module or "").split(".")[0]
-                if root == "jax":
-                    found.append(
-                        f"{rel}:{node.lineno}: from {node.module} import")
-            elif isinstance(node, (ast.If, ast.Try, ast.With,
-                                   ast.ClassDef)):
-                for attr in ("body", "orelse", "finalbody", "handlers"):
-                    sub = getattr(node, attr, None)
-                    if not sub:
-                        continue
-                    for item in sub:
-                        if isinstance(item, ast.excepthandler):
-                            walk(item.body)
-                    walk([s for s in sub
-                          if not isinstance(s, ast.excepthandler)])
-
-    walk(tree.body)
-    return found
 
 
 def test_fleet_router_modules_never_import_jax_at_module_scope():
     """AST half of the bus-only-host contract: no router-role fleet
     module imports jax (or a submodule) at module scope."""
-    violations = []
-    for rel in ROUTER_ROLE_MODULES:
-        path = PACKAGE_DIR / rel
-        assert path.is_file(), f"stale ROUTER_ROLE_MODULES entry {rel}"
-        violations.extend(_module_scope_jax_imports(path))
-    assert not violations, (
+    findings = _run(RouterJaxImportRule())
+    assert not findings, (
         "router-role fleet modules must start on a bus-only host "
         "(import jax lazily, in worker-role code only):\n"
-        + "\n".join(violations)
+        + "\n".join(f.format() for f in findings)
     )
-
-
-#: modules carrying compiled-in chaos injection points (ISSUE 7
-#: satellite): every `_CHAOS` touch outside the module-scope singleton
-#: capture must sit under an `if _CHAOS.enabled:` guard, so disabled
-#: chaos costs exactly one attribute read + one branch per point —
-#: zero allocation, zero calls (the same discipline obs.trace pins)
-CHAOS_INSTRUMENTED = (
-    "fleet/router.py",
-    "fleet/wire.py",
-    "fleet/worker.py",
-)
-
-
-def _is_enabled_guard(node: ast.If) -> bool:
-    t = node.test
-    return (isinstance(t, ast.Attribute) and t.attr == "enabled"
-            and isinstance(t.value, ast.Name) and t.value.id == "_CHAOS")
-
-
-def _unguarded_chaos_uses(path: pathlib.Path):
-    """`_CHAOS` references outside (a) the module-scope
-    ``_CHAOS = default_chaos()`` capture, (b) an ``if _CHAOS.enabled:``
-    test, (c) the body of such a guard."""
-    tree = ast.parse(path.read_text(), filename=str(path))
-    rel = path.relative_to(PACKAGE_DIR)
-    found = []
-    points = [0]
-
-    def walk(node, guarded):
-        if isinstance(node, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "_CHAOS"
-                for t in node.targets):
-            return  # the singleton capture
-        if isinstance(node, ast.If) and _is_enabled_guard(node):
-            points[0] += 1
-            for child in node.body:
-                walk(child, True)
-            for child in node.orelse:
-                walk(child, guarded)
-            return
-        if isinstance(node, ast.Name) and node.id == "_CHAOS" \
-                and not guarded:
-            found.append(
-                f"{rel}:{node.lineno}: _CHAOS use outside an "
-                "`if _CHAOS.enabled:` guard")
-        for child in ast.iter_child_nodes(node):
-            walk(child, guarded)
-
-    walk(tree, False)
-    return found, points[0]
 
 
 def test_chaos_injection_points_are_noops_when_disabled():
     """AST contract for the never-abort chaos layer (docs/chaos.md):
     with chaos off, every compiled-in injection point is a single
-    predictable branch on the hot path — any `_CHAOS` call reachable
-    without passing the `enabled` test fails tier-1 the commit it
-    appears."""
-    violations = []
-    total_points = 0
-    for rel in CHAOS_INSTRUMENTED:
-        path = PACKAGE_DIR / rel
-        assert path.is_file(), f"stale CHAOS_INSTRUMENTED entry {rel}"
-        found, n_points = _unguarded_chaos_uses(path)
-        violations.extend(found)
-        assert n_points >= 1, f"{rel} lost its injection point"
-        total_points += n_points
-    assert not violations, (
+    predictable branch on the hot path."""
+    rule = ChaosGuardRule()
+    findings, _ = run_rules([rule], _ctx())
+    assert not findings, (
         "chaos injection must be free when disabled (guard every "
         "_CHAOS touch with `if _CHAOS.enabled:`):\n"
-        + "\n".join(violations)
+        + "\n".join(f.format() for f in findings)
     )
-    assert total_points >= 4  # the walk actually sees the points
+    # the walk actually saw the injection points (the rule itself fails
+    # when a module drops to zero or the total sinks below the floor)
+    assert _ctx().reports.get("chaos_points", 0) >= 4
 
 
 def test_fleet_router_import_path_is_transitively_jax_free():

@@ -693,3 +693,22 @@ def test_status_cli_scrapes_running_endpoint(capsys):
         assert "FAIL" in out
     finally:
         app.observability.close()
+
+
+def test_bus_publish_counter_created_on_first_touch():
+    """A topic that misses both the bind_metrics snapshot and
+    add_topic's counter creation (the concurrent-join race) must be
+    counted on first publish, never KeyError the hot path."""
+    from fmda_tpu.obs import MetricsRegistry
+    from fmda_tpu.stream.bus import InProcessBus
+
+    reg = MetricsRegistry()
+    bus = InProcessBus(("a",))
+    bus.bind_metrics(reg)
+    bus.add_topic("late")
+    # simulate the lost-counter interleaving (bind_metrics snapshot
+    # taken before add_topic, add_topic seeing no counter dict yet)
+    bus._publish_counters.pop("late")
+    bus.publish("late", {"x": 1})
+    bus.publish_many("late", [{"x": 2}, {"x": 3}])
+    assert reg.counter("bus_published_total", topic="late").value == 3
