@@ -13,6 +13,12 @@ rule id                   severity    contract
                                       call site
 ``jax-api-drift``         error       every jax.* reference on the kernel
                                       surface resolves against installed JAX
+                                      (zero-baseline hard gate: drift is
+                                      never grandfathered)
+``compat-required``       error       version-sensitive jax spellings
+                                      (fmda_tpu.compat.SHIMMED_SYMBOLS) are
+                                      used only through the compat shim on
+                                      the kernel surface
 ``bus-topics``            error       published topic literals are declared
                                       or consumed somewhere
 ``logging-hygiene``       error       no print()/foreign loggers in library
@@ -29,6 +35,7 @@ Entry points: ``python -m fmda_tpu lint`` (exit 0 = clean vs baseline,
 ``docs/analysis.md`` for the baseline workflow and how to write a rule.
 """
 
+from fmda_tpu.analysis.compat_required import CompatRequiredRule
 from fmda_tpu.analysis.drift import DRIFT_SCOPE, JaxApiDriftRule
 from fmda_tpu.analysis.engine import (
     DEFAULT_BASELINE,
@@ -72,6 +79,7 @@ __all__ = [
     "rule_catalog",
     "BusTopicRule",
     "ChaosGuardRule",
+    "CompatRequiredRule",
     "JaxApiDriftRule",
     "JitPurityRule",
     "LockDisciplineRule",
@@ -93,6 +101,7 @@ def default_rules(*, drift: bool = True):
         LockDisciplineRule(),
         JitPurityRule(),
         BusTopicRule(),
+        CompatRequiredRule(),
     ]
     if drift:
         rules.append(JaxApiDriftRule())

@@ -1,0 +1,64 @@
+"""compat-required: version-sensitive jax spellings stay in compat.py.
+
+The drift scanner (:mod:`fmda_tpu.analysis.drift`) catches references
+that do not resolve against the *installed* jax — but that gate is
+one-sided: on a host running the newer jax, the new spelling resolves
+fine, lint stays green, and the port silently reintroduces the exact
+version coupling ``fmda_tpu/compat.py`` exists to absorb.  This rule is
+the other jaw of the vise.  It confines every spelling listed in
+:data:`fmda_tpu.compat.SHIMMED_SYMBOLS` — old *and* new — to the compat
+module itself: a direct use anywhere on the kernel surface (``ops/``,
+``parallel/``, ``models/``) is a finding, whatever jax is installed,
+so call sites can only reach the arbitrated name through the shim.
+
+Pure AST + the symbol dict; never imports jax (``compat`` resolves its
+shims lazily), so the rule runs on jax-free hosts and under
+``lint --no-drift``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from fmda_tpu.analysis.drift import _AliasCollector, _RefCollector, _in_scope
+from fmda_tpu.analysis.engine import Finding, LintContext, ParsedModule, Rule
+from fmda_tpu.compat import SHIMMED_SYMBOLS
+
+
+class CompatRequiredRule(Rule):
+    id = "compat-required"
+    severity = "error"
+    description = ("version-sensitive jax symbols are used only through "
+                   "fmda_tpu.compat on the kernel surface")
+
+    def check(self, module: ParsedModule, ctx: LintContext) -> List[Finding]:
+        if not _in_scope(module.rel):
+            return []
+        aliases = _AliasCollector()
+        aliases.visit(module.tree)
+        refs = _RefCollector(aliases.aliases)
+        refs.visit(module.tree)
+        found: List[Finding] = []
+        reported = set()
+        for line, dotted in sorted(set(aliases.symbols) | set(refs.refs)):
+            hit = _shimmed_prefix(dotted)
+            if hit is None or hit in reported:
+                continue
+            reported.add(hit)  # one finding per symbol per module
+            found.append(self.finding(
+                module.rel, line,
+                f"version-sensitive jax symbol used directly: {hit} — "
+                f"import `{SHIMMED_SYMBOLS[hit]}` from fmda_tpu.compat "
+                f"instead"))
+        return found
+
+
+def _shimmed_prefix(dotted: str) -> str | None:
+    """The listed symbol ``dotted`` is or extends (maximal attribute
+    chains can run past the symbol: ``jax.lax.axis_size.__doc__``)."""
+    parts = dotted.split(".")
+    for i in range(len(parts), 1, -1):
+        prefix = ".".join(parts[:i])
+        if prefix in SHIMMED_SYMBOLS:
+            return prefix
+    return None

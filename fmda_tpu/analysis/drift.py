@@ -26,10 +26,14 @@ prefix and ``getattr``-ing the rest.  Only static module-path
 references are judged — values passed around as objects are invisible,
 so this is a lower bound on drift, never a false alarm on style.
 
-Grandfathered drift lives in the baseline like any other finding (the
-pre-existing inventory is baselined with a pointer at the ROADMAP
-item); a *new* unresolved symbol fails lint the commit it appears, so
-the kernel surface can't silently drift further.
+This rule is a **zero-baseline hard gate** (``grandfatherable =
+False``): an unresolved symbol fails lint the commit it appears, with
+no grandfathering — a baseline entry carrying this rule's id is itself
+a gate failure (``LintResult.forbidden_baseline``).  The pre-existing
+84-test inventory was carried that way once; the port through
+``fmda_tpu/compat.py`` retired it, and the companion ``compat-required``
+rule (:mod:`fmda_tpu.analysis.compat_required`) keeps version-sensitive
+spellings confined to the shim so the set stays empty.
 """
 
 from __future__ import annotations
@@ -111,6 +115,7 @@ class JaxApiDriftRule(Rule):
     severity = "error"
     description = ("every jax.* reference on the kernel surface must "
                    "resolve against the installed JAX")
+    grandfatherable = False  # zero-baseline: drift is fixed, never filed
 
     def __init__(self) -> None:
         #: dotted -> resolvable? (shared across modules, one import each)

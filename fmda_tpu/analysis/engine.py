@@ -151,6 +151,12 @@ class Rule:
     id: str = ""
     severity: str = "warning"
     description: str = ""
+    #: ``False`` makes the rule a zero-baseline hard gate: its findings
+    #: can never be grandfathered, and any baseline entry carrying its
+    #: id is itself a gate failure (``LintResult.forbidden_baseline``).
+    #: The drift rule runs this way — new API drift fails lint the
+    #: commit it appears, no debt register.
+    grandfatherable: bool = True
 
     def check(self, module: ParsedModule, ctx: LintContext) -> List[Finding]:
         return []
@@ -266,15 +272,20 @@ class LintResult:
     baselined: List[Finding] = field(default_factory=list)
     suppressed: int = 0
     stale_baseline: List[Dict[str, str]] = field(default_factory=list)
+    #: baseline entries for zero-baseline rules (``grandfatherable =
+    #: False``) — forbidden debt: the gate fails until they are removed
+    forbidden_baseline: List[Dict[str, str]] = field(default_factory=list)
     n_modules: int = 0
     reports: Dict[str, object] = field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
-        # stale entries gate too: the CLI, the bench phase, and the
-        # tier-1 test must agree — a paid-off debt left in the baseline
-        # is a red build everywhere, not a stderr whisper
-        return not self.new and not self.stale_baseline
+        # stale/forbidden entries gate too: the CLI, the bench phase,
+        # and the tier-1 test must agree — a paid-off debt left in the
+        # baseline (or one smuggled under a zero-baseline rule) is a
+        # red build everywhere, not a stderr whisper
+        return (not self.new and not self.stale_baseline
+                and not self.forbidden_baseline)
 
     def as_dict(self) -> Dict[str, object]:
         """The ``lint --json`` document.  Schema is load-bearing (CI
@@ -287,6 +298,7 @@ class LintResult:
             "baselined": [f.as_dict() for f in self.baselined],
             "suppressed": self.suppressed,
             "stale_baseline": list(self.stale_baseline),
+            "forbidden_baseline": list(self.forbidden_baseline),
             "reports": self.reports,
         }
 
@@ -317,7 +329,11 @@ def run_rules(rules: Sequence[Rule],
         raw.extend(rule.finish(ctx))
         for f in raw:
             module = by_rel.get(f.path)
-            if module is not None and f.rule in ignored_rules(module, f.line):
+            # zero-baseline rules accept neither baseline entries nor
+            # the inline hatch — a hard gate with an escape hatch is a
+            # soft gate (their findings are reported, never suppressed)
+            if (rule.grandfatherable and module is not None
+                    and f.rule in ignored_rules(module, f.line)):
                 suppressed += 1
                 continue
             findings.append(f)
@@ -346,9 +362,15 @@ def run_lint(
     # as stale debt
     ran = {r.id for r in rules}
     entries = [e for e in entries if e["rule"] in ran]
+    # zero-baseline rules admit NO grandfathering: their entries never
+    # match findings (so the findings stay new) and are reported as
+    # forbidden debt that fails the gate until pruned
+    hard = {r.id for r in rules if not r.grandfatherable}
+    forbidden = [e for e in entries if e["rule"] in hard]
+    entries = [e for e in entries if e["rule"] not in hard]
     new, old, stale = apply_baseline(findings, entries)
     return LintResult(
         new=new, baselined=old, suppressed=suppressed,
-        stale_baseline=stale, n_modules=len(ctx.modules),
-        reports=dict(ctx.reports),
+        stale_baseline=stale, forbidden_baseline=forbidden,
+        n_modules=len(ctx.modules), reports=dict(ctx.reports),
     )

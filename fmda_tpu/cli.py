@@ -18,9 +18,11 @@ operations are subcommands over one file-backed warehouse:
                 attribution) from a ``--trace-out`` file or a running
                 ``/trace`` endpoint;
 - ``lint``      framework-aware static analysis over the package
-                (lock discipline, jit purity, JAX API drift, topic
-                cross-checks, hygiene rules); exit 0 = clean against
-                the baseline, 1 = new findings, 2 = usage error.
+                (lock discipline, jit purity, JAX API drift as a
+                zero-baseline hard gate, compat-shim confinement,
+                topic cross-checks, hygiene rules); exit 0 = clean
+                against the baseline, 1 = new findings, 2 = usage
+                error.
 
 Every command is a thin composition of the public library API — anything
 the CLI does is one import away in a notebook.
@@ -1192,11 +1194,18 @@ def cmd_lint(args) -> int:
             f"stale baseline entry (debt paid — prune it): "
             f"[{e['rule']}] {e['path']}: {e['message']}",
             file=sys.stderr)
+    for e in result.forbidden_baseline:
+        print(
+            f"forbidden baseline entry ([{e['rule']}] is a zero-baseline "
+            f"hard gate — fix the code, never grandfather it): "
+            f"{e['path']}: {e['message']}",
+            file=sys.stderr)
     print(f"{result.n_modules} modules: {len(result.new)} new finding(s), "
           f"{len(result.baselined)} baselined, "
           f"{result.suppressed} suppressed, "
           f"{len(result.stale_baseline)} stale baseline entr"
-          f"{'y' if len(result.stale_baseline) == 1 else 'ies'}")
+          f"{'y' if len(result.stale_baseline) == 1 else 'ies'}, "
+          f"{len(result.forbidden_baseline)} forbidden")
     return 0 if result.ok else 1
 
 

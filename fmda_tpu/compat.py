@@ -1,0 +1,175 @@
+"""Version shims for the JAX APIs that drift across releases.
+
+The kernel surface (``ops/``, ``parallel/``, ``models/``) was written
+against a newer JAX than the one installed here, and the delta — four
+symbols, inventoried mechanically by the API-drift scanner
+(``python -m fmda_tpu lint``, ``artifacts/jax_api_drift.json``) — walled
+the Pallas kernels, ring attention, and sequence-parallel training off
+from tier-1 for eight PRs.  This module is the repo's single seam with
+that churn: each shim probes the installed API on first use and selects
+the available spelling, so the kernel code imports ONE stable name and
+never branches on ``jax.__version__``.
+
+==================  =======================================================
+shim                spellings it arbitrates
+==================  =======================================================
+``CompilerParams``  ``pltpu.CompilerParams`` (new) vs
+                    ``pltpu.TPUCompilerParams`` (<= 0.4.x)
+``axis_size``       ``jax.lax.axis_size`` (new) vs ``lax.psum(1, axis)``
+                    — the unit-psum constant-folds to a static int, so
+                    ``range(axis_size(...))`` stays trace-time static
+``pcast``           ``jax.lax.pcast`` (new varying-manual-axes typing) vs
+                    identity — versions without the vma type system need
+                    no cast (run shard_map with the rep checker off)
+``shard_map``       ``jax.shard_map`` (new, ``check_vma=``) vs
+                    ``jax.experimental.shard_map.shard_map`` (old,
+                    ``check_rep=``); the kwarg is translated
+==================  =======================================================
+
+Everything resolves lazily (PEP 562): importing this module never
+imports jax, so jax-free tooling (the analysis engine, the fleet
+router's import path) can read :data:`SHIMMED_SYMBOLS` without paying
+for a backend.  The ``compat-required`` analyzer rule closes the loop
+statically — any direct use of a spelling listed in
+:data:`SHIMMED_SYMBOLS` inside ``ops/``/``parallel/``/``models/`` is a
+lint finding, so the shim cannot be bypassed as the surface grows, and
+the ``jax-api-drift`` rule is a zero-baseline hard gate, so a *fifth*
+drifted symbol fails lint the commit it appears.
+
+Upgrade workflow (docs/analysis.md "The compat workflow"): scanner
+inventory -> add/adjust the shim entry here -> port call sites to the
+shim -> the drift gate goes back to zero.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence
+
+#: Every version-sensitive spelling this module arbitrates, mapped to
+#: the shim attribute that covers it.  This dict is the contract shared
+#: with :class:`fmda_tpu.analysis.compat_required.CompatRequiredRule`:
+#: a dotted reference listed here appearing anywhere on the kernel
+#: surface outside this module is a lint finding.  Importing it is
+#: jax-free by design (the analyzer runs on jax-free hosts).
+SHIMMED_SYMBOLS: Dict[str, str] = {
+    "jax.experimental.pallas.tpu.CompilerParams": "CompilerParams",
+    "jax.experimental.pallas.tpu.TPUCompilerParams": "CompilerParams",
+    "jax.lax.axis_size": "axis_size",
+    "jax.lax.pcast": "pcast",
+    "jax.shard_map": "shard_map",
+    "jax.experimental.shard_map.shard_map": "shard_map",
+}
+
+__all__ = [
+    "CompilerParams",
+    "SHIMMED_SYMBOLS",
+    "axis_size",
+    "pcast",
+    "shard_map",
+]
+
+
+def _resolve_compiler_params() -> Any:
+    """``pallas_call(compiler_params=...)`` dataclass under either name.
+
+    Both spellings take the same ``dimension_semantics=`` field the
+    kernels pass; newer jax renamed the class, not the schema.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    new = getattr(pltpu, "CompilerParams", None)
+    if new is not None:
+        return new
+    return pltpu.TPUCompilerParams
+
+
+def _resolve_axis_size() -> Callable[[str], int]:
+    import jax
+
+    native = getattr(jax.lax, "axis_size", None)
+    if native is not None:
+        return native
+
+    def axis_size(axis_name) -> int:
+        """Size of a named mesh axis, inside shard_map/pmap bodies.
+
+        ``psum`` of the Python constant 1 constant-folds to the axis
+        size as a static int — the pre-``jax.lax.axis_size`` idiom — so
+        callers can keep using it in ``range(...)`` at trace time.
+        """
+        return jax.lax.psum(1, axis_name)
+
+    return axis_size
+
+
+def _resolve_pcast() -> Callable[..., Any]:
+    import jax
+
+    native = getattr(jax.lax, "pcast", None)
+    if native is not None:
+        return native
+
+    def pcast(x, axes, to=None):
+        """Identity: this jax predates the varying-manual-axes type
+        system, so there is nothing to cast — values inside shard_map
+        are untyped w.r.t. replication (pair with ``check_vma=False``,
+        which the shimmed :func:`shard_map` maps to ``check_rep=False``).
+        """
+        del axes, to
+        return x
+
+    return pcast
+
+
+def _resolve_shard_map() -> Callable[..., Any]:
+    import jax
+
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+
+        def shard_map(f=None, **kwargs):
+            if f is None:  # bare-kwargs decorator form
+                return lambda fn: shard_map(fn, **kwargs)
+            return native(f, **kwargs)
+
+        return shard_map
+
+    from jax.experimental.shard_map import shard_map as old_shard_map
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True):
+        """Old-API shard_map with the new keyword surface: ``check_vma``
+        (the new name for the output-replication/varying checker)
+        translates to ``check_rep``."""
+        if f is None:
+            return lambda fn: shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=check_vma)
+        return old_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma)
+
+    return shard_map
+
+
+_RESOLVERS: Dict[str, Callable[[], Any]] = {
+    "CompilerParams": _resolve_compiler_params,
+    "axis_size": _resolve_axis_size,
+    "pcast": _resolve_pcast,
+    "shard_map": _resolve_shard_map,
+}
+
+
+def __getattr__(name: str) -> Any:
+    """Probe the installed jax on first access and cache the winner in
+    the module dict (later lookups never re-enter here)."""
+    resolver = _RESOLVERS.get(name)
+    if resolver is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = resolver()
+    globals()[name] = value
+    return value
+
+
+def __dir__() -> Sequence[str]:
+    return sorted(set(globals()) | set(_RESOLVERS))

@@ -59,6 +59,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fmda_tpu.compat import CompilerParams
+
 #: Q/K block edge.  128 = MXU tile edge = Mosaic lane count; T must be a
 #: multiple (flash_supported gates on it).
 _BLOCK = 128
@@ -187,7 +189,7 @@ def _fwd_impl(
             pltpu.VMEM((_BLOCK, 128), jnp.float32),
             pltpu.VMEM((_BLOCK, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -347,7 +349,7 @@ def _bwd_impl(
             pltpu.VMEM((_BLOCK, d), jnp.float32),
             pltpu.VMEM((_BLOCK, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -363,7 +365,7 @@ def _bwd_impl(
         out_specs=[qspec2],
         out_shape=[jax.ShapeDtypeStruct((bn, t, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((_BLOCK, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,

@@ -63,6 +63,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fmda_tpu.compat import CompilerParams
+
 
 # Conservative per-core VMEM budget for a kernel's whole working set
 # (blocks + constants + scratch).  Real VMEM is ~16 MB/core; staying
@@ -239,7 +241,7 @@ def _gru_scan_pallas_fwd_impl(
             jax.ShapeDtypeStruct((batch, hidden), xp.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((batch, hidden), xp.dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -391,7 +393,7 @@ def _gru_scan_pallas_bwd_impl(
             jax.ShapeDtypeStruct((1, 3 * hidden), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((batch, hidden), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

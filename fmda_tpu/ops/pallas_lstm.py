@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fmda_tpu.compat import CompilerParams
+
 from fmda_tpu.ops.pallas_gru import _VMEM_BUDGET, _default_block_t
 
 
@@ -174,7 +176,7 @@ def _lstm_fwd_impl(
             pltpu.VMEM((batch, hidden), xp.dtype),
             pltpu.VMEM((batch, hidden), xp.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -349,7 +351,7 @@ def _lstm_bwd_impl(
             pltpu.VMEM((batch, hidden), jnp.float32),
             pltpu.VMEM((batch, hidden), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

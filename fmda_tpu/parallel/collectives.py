@@ -13,6 +13,8 @@ from typing import List, Tuple
 import jax
 import jax.numpy as jnp
 
+from fmda_tpu.compat import axis_size
+
 
 def all_reduce_sum(x: jax.Array, axis_name: str) -> jax.Array:
     """Sum across the mesh axis (ICI all-reduce)."""
@@ -34,7 +36,7 @@ def all_gather(
 def ring_shift(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     """Rotate values around the mesh axis ring (ppermute); the neighbor
     exchange used for the sequence-parallel hidden-state handoff."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm: List[Tuple[int, int]] = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 
@@ -43,7 +45,7 @@ def shift_right(x: jax.Array, axis_name: str, fill: jax.Array) -> jax.Array:
     """Send each shard's value to the next device (no wraparound); the
     first device receives ``fill``.  The boundary-respecting variant of
     :func:`ring_shift` for non-cyclic scans."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     shifted = jax.lax.ppermute(
         x, axis_name, [(i, i + 1) for i in range(n - 1)]
     )
@@ -54,7 +56,7 @@ def shift_right(x: jax.Array, axis_name: str, fill: jax.Array) -> jax.Array:
 def shift_left(x: jax.Array, axis_name: str, fill: jax.Array) -> jax.Array:
     """Send each shard's value to the previous device; the last device
     receives ``fill``."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     shifted = jax.lax.ppermute(
         x, axis_name, [(i + 1, i) for i in range(n - 1)]
     )

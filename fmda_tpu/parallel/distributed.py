@@ -42,6 +42,16 @@ def initialize(
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None and is_init():
         return
+    # CPU cross-process collectives default to "none" on jax releases
+    # that carry the knob — without Gloo every multi-process CPU
+    # computation (including device_put's replication assert) dies with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Releases without the knob pick a working implementation themselves.
+    if "cpu" in (jax.config.jax_platforms or ""):
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):  # knob gone or gloo not built
+            pass
     kwargs = {}
     if local_device_ids is not None:
         kwargs["local_device_ids"] = list(local_device_ids)
@@ -67,6 +77,30 @@ def make_global_batch(
     )
 
 
+def place_replicated(mesh: Mesh, tree):
+    """Replicate a host-identical tree over ``mesh``, multi-process safe.
+
+    ``jax.device_put`` onto a sharding that spans processes first runs a
+    host-side equality assert (``multihost_utils.assert_equal``) — a
+    cross-process *computation* some CPU builds cannot run (and whose
+    Gloo broadcast has crashed on size-mismatched frames).  The
+    data-loading path sidesteps it: every process contributes its local
+    (identical, by the caller's contract) value and jax assembles the
+    global array with no host-side collective.  Leaves come back fresh
+    (the host round-trip copies), so the result is donation-safe.
+    """
+    sharding = replicated_sharding(mesh)
+    if jax.process_count() == 1:
+        from fmda_tpu.parallel.sp_train import place_fresh_copy
+
+        return place_fresh_copy(tree, sharding)
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(
+            sharding, np.asarray(a)),
+        tree,
+    )
+
+
 def shard_train_inputs_multihost(
     mesh: Mesh,
     x_local: np.ndarray,
@@ -88,14 +122,11 @@ def shard_train_inputs_multihost(
     ``device_put`` may alias the caller's tree when placement already
     matches — the first step would then delete the caller's originals.
     """
-    from fmda_tpu.parallel.sp_train import place_fresh_copy
-
     x = make_global_batch(
         mesh, x_local, PartitionSpec(dp_axis, sp_axis))
     y = make_global_batch(mesh, y_local, PartitionSpec(dp_axis))
-    replicated = replicated_sharding(mesh)
-    return (x, y, place_fresh_copy(params, replicated),
-            place_fresh_copy(opt_state, replicated))
+    return (x, y, place_replicated(mesh, params),
+            place_replicated(mesh, opt_state))
 
 
 def place_local_batch(mesh: Mesh, batch, dp_axis: str = "dp"):

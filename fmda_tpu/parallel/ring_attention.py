@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fmda_tpu.compat import axis_size, shard_map
 from fmda_tpu.ops.attention import (
     finalize_online_state,
     flash_available,
@@ -85,7 +86,7 @@ def _ring_attention_flash(
     """
     from fmda_tpu.ops.pallas_attention import _NEG, flash_attention_with_lse
 
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     batch, n_heads, t_local, d_head = q.shape
     f32 = jnp.float32
@@ -145,7 +146,7 @@ def ring_attention(
 
     Returns this device's output shard (B, N, T_local, D), in q's dtype.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     batch, n_heads, t_local, d_head = q.shape
 
@@ -245,7 +246,7 @@ def sp_attn_apply(
     # head across the sharded time axis (same collective structure as
     # seq_parallel.sp_bigru_apply): the global last position lives on the
     # last sp shard; max/avg pool reduce locally then cross the axis
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     last_local = x[:, -1]
     last_hidden = all_reduce_sum(
         jnp.where(idx == n - 1, last_local, jnp.zeros_like(last_local)),
@@ -279,7 +280,7 @@ def make_attn_sp_forward(
     :func:`fmda_tpu.parallel.seq_parallel.make_sp_forward`."""
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(dp_axis, sp_axis)),
         out_specs=P(dp_axis),
@@ -314,7 +315,7 @@ def make_ring_attention(
 
     @jax.jit
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec,
         # pallas_call outputs don't carry vma annotations, so the static
         # checker can't type the flash fold; the specs are still enforced

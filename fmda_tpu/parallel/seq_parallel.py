@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from fmda_tpu.compat import axis_size, pcast, shard_map
 from fmda_tpu.config import ModelConfig
 from fmda_tpu.ops.gru import GRUWeights, gru_scan, input_projection, select_scan_fn
 from fmda_tpu.parallel.collectives import (
@@ -68,13 +69,13 @@ def sp_gru_scan(
       (h_last, hs_local): the *global* final hidden state (replicated on
       every sp device) and this device's per-step hiddens (B, T_local, H).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
 
     # Mark the (replicated) initial carry as varying over the mesh axes the
     # inputs vary on, so the lax.scan carry type matches the per-device gate
     # outputs (shard_map's varying-manual-axes typing).
-    h0 = jax.lax.pcast(h0, vary_axes or (axis_name,), to="varying")
+    h0 = pcast(h0, vary_axes or (axis_name,), to="varying")
     carry = h0
     hs_local = jnp.zeros(xp_local.shape[:2] + (w_hh.shape[-1],), xp_local.dtype)
     h_final = jnp.zeros_like(h0)
@@ -127,7 +128,7 @@ def sp_gru_scan_pipelined(
     Constraints: batch divisible by ``n_microbatches``.
     Returns the same (h_last, hs_local) as :func:`sp_gru_scan`.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     batch = xp_local.shape[0]
     if batch % n_microbatches != 0:
@@ -138,7 +139,7 @@ def sp_gru_scan_pipelined(
     mbs = batch // n_microbatches
     hidden = w_hh.shape[-1]
 
-    h0 = jax.lax.pcast(h0, vary_axes or (axis_name,), to="varying")
+    h0 = pcast(h0, vary_axes or (axis_name,), to="varying")
     fill = h0[:mbs]  # shape donor only; slot-0 devices override with h0 slices
 
     stage_pos = (n - 1 - idx) if reverse else idx  # device's pipeline slot
@@ -358,7 +359,7 @@ def make_sp_forward(
     """
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(), P(dp_axis, sp_axis)),
         out_specs=P(dp_axis),

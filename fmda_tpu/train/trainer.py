@@ -100,9 +100,12 @@ class Trainer:
 
     def _place_state(self, state: TrainState) -> TrainState:
         if self.mesh is not None:
-            from fmda_tpu.parallel.mesh import replicated_sharding
+            # multi-process safe: plain device_put onto a sharding that
+            # spans processes runs a host-side cross-process assert some
+            # CPU builds cannot execute (parallel/distributed.py)
+            from fmda_tpu.parallel.distributed import place_replicated
 
-            state = jax.device_put(state, replicated_sharding(self.mesh))
+            state = place_replicated(self.mesh, state)
         return state
 
     def init_state(self, rng: jax.Array) -> TrainState:

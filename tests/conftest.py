@@ -27,6 +27,13 @@ if not _KEEP_PLATFORM:
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
 
+# NOTE: do NOT enable jax's persistent compilation cache here.  It was
+# tried (PR 9) to absorb the suite's compile cost on the one-core CI
+# box and looked great on paper — but executables deserialized from the
+# cache SIGABRT this jax/jaxlib CPU build mid-suite (observed inside a
+# donated-buffer train step in test_train), killing the whole pytest
+# process.  A slow suite beats an aborted one.
+
 import jax  # noqa: E402
 
 if not _KEEP_PLATFORM:

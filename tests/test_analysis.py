@@ -16,6 +16,7 @@ import fmda_tpu
 from fmda_tpu.analysis import (
     BusTopicRule,
     ChaosGuardRule,
+    CompatRequiredRule,
     Finding,
     JaxApiDriftRule,
     JitPurityRule,
@@ -320,6 +321,122 @@ def test_drift_report_inventory_shape():
     assert rep["jax_version"]
 
 
+def test_drift_rule_is_zero_baseline(tmp_path):
+    """The drift rule admits NO grandfathering: its findings stay new
+    even when a matching baseline entry exists, and the entry itself is
+    reported as forbidden debt that fails the gate."""
+    src = ("import jax\n"
+           "x = jax.numpy.definitely_not_an_api_zz\n")
+    modules = [ParsedModule.from_source(src, "ops/fake.py")]
+    ctx = LintContext(PACKAGE_DIR, modules)
+    path = tmp_path / "baseline.json"
+    save_baseline(
+        [{"rule": "jax-api-drift", "path": "ops/fake.py",
+          "message": ("unresolved jax reference: "
+                      "jax.numpy.definitely_not_an_api_zz"),
+          "justification": "trying to grandfather drift"}],
+        path)
+    result = run_lint([JaxApiDriftRule()], ctx=ctx, baseline_path=path)
+    assert not result.ok
+    assert len(result.new) == 1  # NOT matched away by the entry
+    assert not result.baselined
+    assert [e["rule"] for e in result.forbidden_baseline] == ["jax-api-drift"]
+
+
+def test_drift_rule_ignores_the_inline_hatch_too():
+    # a hard gate with an escape hatch is a soft gate: the generic
+    # `# lint: ignore[jax-api-drift] reason` hatch must NOT suppress
+    # drift findings (it keeps working for grandfatherable rules)
+    src = ("import jax\n"
+           "x = jax.numpy.definitely_not_an_api_zz"
+           "  # lint: ignore[jax-api-drift] dodge the gate\n")
+    findings, suppressed, _ = run_on(JaxApiDriftRule(), {"ops/fake.py": src})
+    assert len(findings) == 1 and suppressed == 0
+
+
+# ---------------------------------------------------------------------------
+# compat-required: version-sensitive spellings stay in compat.py
+# ---------------------------------------------------------------------------
+
+
+def test_compat_rule_flags_direct_shimmed_symbol():
+    # every arbitrated spelling, old and new, through both import styles
+    src = ("import jax\n"
+           "from jax.experimental.pallas import tpu as pltpu\n"
+           "from jax.experimental.shard_map import shard_map\n"
+           "a = pltpu.TPUCompilerParams(dimension_semantics=())\n"
+           "b = pltpu.CompilerParams\n"
+           "c = jax.lax.axis_size('sp')\n"
+           "d = jax.lax.pcast\n"
+           "e = jax.shard_map\n")
+    findings, _, _ = run_on(CompatRequiredRule(), {"parallel/fake.py": src})
+    flagged = {f.message.split(": ", 1)[1].split(" —")[0] for f in findings}
+    assert flagged == {
+        "jax.experimental.pallas.tpu.TPUCompilerParams",
+        "jax.experimental.pallas.tpu.CompilerParams",
+        "jax.experimental.shard_map.shard_map",
+        "jax.lax.axis_size",
+        "jax.lax.pcast",
+        "jax.shard_map",
+    }
+    assert all(f.severity == "error" for f in findings)
+    assert all("fmda_tpu.compat" in f.message for f in findings)
+
+
+def test_compat_rule_clean_paths():
+    # the sanctioned shape: shim imports + untouched jax APIs; and the
+    # same direct use OUTSIDE the kernel surface is none of this rule's
+    # business (compat.py itself lives at the package root, out of scope)
+    good = ("import jax\n"
+            "from fmda_tpu.compat import CompilerParams, axis_size\n"
+            "n = axis_size('sp')\n"
+            "y = jax.lax.psum(1, 'sp')\n"
+            "z = jax.numpy.ones\n")
+    findings, _, _ = run_on(CompatRequiredRule(), {"ops/fake.py": good})
+    assert not findings
+    out_of_scope = ("import jax\n"
+                    "e = jax.shard_map\n")
+    findings, _, _ = run_on(
+        CompatRequiredRule(), {"stream/fake.py": out_of_scope})
+    assert not findings
+
+
+def test_compat_rule_catches_chains_past_the_symbol():
+    src = ("import jax\n"
+           "doc = jax.lax.axis_size.__doc__\n")
+    findings, _, _ = run_on(CompatRequiredRule(), {"models/fake.py": src})
+    assert len(findings) == 1 and "jax.lax.axis_size" in findings[0].message
+
+
+def test_compat_shims_resolve_against_installed_jax():
+    """Every shim must produce a working object on THIS jax — the whole
+    point of probing at import is that either spelling works."""
+    from fmda_tpu import compat
+
+    assert compat.CompilerParams(dimension_semantics=("arbitrary",))
+    assert callable(compat.shard_map)
+    assert callable(compat.pcast)
+    assert callable(compat.axis_size)
+    # the symbol list and the shims stay in sync
+    assert set(compat.SHIMMED_SYMBOLS.values()) <= set(compat.__all__)
+
+
+def test_compat_module_imports_jax_free():
+    """compat must stay importable (and SHIMMED_SYMBOLS readable) without
+    jax — the analyzer runs on jax-free hosts."""
+    import subprocess
+    import sys
+
+    code = ("import sys\n"
+            "from fmda_tpu.compat import SHIMMED_SYMBOLS\n"
+            "assert 'jax' not in sys.modules, 'compat imported jax eagerly'\n"
+            "assert SHIMMED_SYMBOLS\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True,
+                          cwd=str(PACKAGE_DIR.parent))
+    assert proc.returncode == 0, proc.stderr
+
+
 # ---------------------------------------------------------------------------
 # Bus topics
 # ---------------------------------------------------------------------------
@@ -456,7 +573,8 @@ def test_lint_json_schema(capsys):
     doc = json.loads(capsys.readouterr().out)
     # schema is load-bearing for CI scripts: extend, don't rename
     assert set(doc) == {"ok", "n_modules", "new", "baselined",
-                        "suppressed", "stale_baseline", "reports"}
+                        "suppressed", "stale_baseline",
+                        "forbidden_baseline", "reports"}
     assert doc["ok"] is True and rc == 0
     assert doc["n_modules"] > 50
     assert "bus_topics" in doc["reports"]
@@ -539,7 +657,6 @@ def test_lint_single_rule_filter(capsys):
     out = capsys.readouterr().out
     assert "0 new finding(s)" in out
     # rule filtering must not report other rules' baseline as stale
-    # (the 9 drift entries are ignored, not stale — else rc would be 1)
     assert "0 stale baseline entries" in out
 
 
@@ -548,20 +665,52 @@ def test_lint_single_rule_filter(capsys):
 # ---------------------------------------------------------------------------
 
 
-def test_repo_is_lint_clean_against_baseline():
+@pytest.fixture(scope="module")
+def repo_lint_result():
+    """One full-suite run shared by the tier-1 gate tests — the drift
+    resolver's jax imports make each run seconds, not milliseconds."""
+    return run_lint(default_rules())
+
+
+def test_repo_is_lint_clean_against_baseline(repo_lint_result):
     """Tier-1 equivalent of ``python -m fmda_tpu lint`` exiting 0: zero
-    non-baselined findings across every rule (drift included), and no
-    stale debt entries hiding in the baseline."""
-    result = run_lint(default_rules())
+    non-baselined findings across every rule (drift + compat-required
+    included), no stale debt entries hiding in the baseline, and no
+    entries smuggled under a zero-baseline rule."""
+    result = repo_lint_result
     assert result.n_modules > 50
     assert not result.new, "new static-analysis findings:\n" + "\n".join(
         f.format() for f in result.new)
     assert not result.stale_baseline, (
         "baseline entries whose debt was paid — prune them:\n"
         + json.dumps(result.stale_baseline, indent=2))
-    # the drift inventory stays in sync with the grandfathered findings
+    assert not result.forbidden_baseline, (
+        "baseline entries for zero-baseline rules — fix the code:\n"
+        + json.dumps(result.forbidden_baseline, indent=2))
+    # the kernel surface carries ZERO drift against the installed jax,
+    # under an EMPTY drift baseline (the 84-test failure set retired in
+    # PR 9 stays retired: a fifth drifted symbol fails this test the
+    # commit it appears, with nowhere to grandfather it)
     rep = result.reports["jax_api_drift"]
-    baselined_syms = {f.message.split(": ", 1)[1]
-                      for f in result.baselined
-                      if f.rule == "jax-api-drift"}
-    assert set(rep["symbols"]) == baselined_syms
+    assert rep["n_symbols"] == 0, (
+        "jax API drift on the kernel surface:\n"
+        + json.dumps(rep["symbols"], indent=2))
+    drift_entries = [e for e in load_baseline()
+                     if e["rule"] == "jax-api-drift"]
+    assert drift_entries == []
+
+
+def test_committed_drift_artifact_matches_live_scan(repo_lint_result):
+    """``artifacts/jax_api_drift.json`` is the committed inventory other
+    docs cite — it must stay bit-in-sync with what the scanner reports
+    live, or the artifact silently rots (regenerate with
+    ``python -m fmda_tpu lint --drift-report artifacts/jax_api_drift.json``).
+    """
+    artifact = PACKAGE_DIR.parent / "artifacts" / "jax_api_drift.json"
+    assert artifact.is_file(), f"missing committed artifact: {artifact}"
+    committed = json.loads(artifact.read_text())
+    live = repo_lint_result.reports["jax_api_drift"]
+    assert committed == live, (
+        "committed drift artifact out of sync with a live scanner run — "
+        "regenerate it:\n  python -m fmda_tpu lint --drift-report "
+        "artifacts/jax_api_drift.json")

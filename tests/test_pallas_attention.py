@@ -10,6 +10,9 @@ import numpy as np
 import pytest
 
 import jax
+# jax.export is a real submodule on every supported jax, but older
+# releases only expose it as a `jax` attribute after an explicit import
+import jax.export  # noqa: F401
 import jax.numpy as jnp
 
 from fmda_tpu.ops.attention import mha

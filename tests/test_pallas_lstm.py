@@ -10,6 +10,9 @@ import numpy as np
 import pytest
 
 import jax
+# jax.export is a real submodule on every supported jax, but older
+# releases only expose it as a `jax` attribute after an explicit import
+import jax.export  # noqa: F401
 import jax.numpy as jnp
 
 from fmda_tpu.ops.lstm import LSTMWeights, lstm_input_projection, lstm_scan
@@ -142,12 +145,16 @@ def test_pallas_lstm_bf16_numerics_close_to_scan(reverse):
             rtol=5e-2, atol=5e-2)
 
 
-@pytest.mark.parametrize("reverse", [False, True])
-@pytest.mark.parametrize(
-    "batch,seq,hidden",
-    [(256, 30, 32), (16, 1024, 32)],
-    ids=["flagship", "longctx"],
-)
+# ~4 s of Mosaic lowering per combo: tier-1 keeps one lowering per
+# bench shape (directions alternated); the full matrix runs under slow
+@pytest.mark.parametrize("batch,seq,hidden,reverse", [
+    pytest.param(256, 30, 32, False, id="flagship-fwd"),
+    pytest.param(16, 1024, 32, True, id="longctx-rev"),
+    pytest.param(256, 30, 32, True, id="flagship-rev",
+                 marks=pytest.mark.slow),
+    pytest.param(16, 1024, 32, False, id="longctx-fwd",
+                 marks=pytest.mark.slow),
+])
 def test_pallas_lstm_lowers_for_tpu(batch, seq, hidden, reverse):
     """Mosaic TPU lowering of the fwd+bwd pair at the bench shapes via
     jax.export — no hardware required."""

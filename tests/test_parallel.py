@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from fmda_tpu.compat import shard_map
 from fmda_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from fmda_tpu.models.bigru import BiGRU
 from fmda_tpu.ops.gru import GRUWeights, gru_layer, input_projection
@@ -49,7 +50,7 @@ def test_sp_gru_scan_matches_single_device(reverse):
     h_last_ref, hs_ref = gru_layer(x, w, reverse=reverse)
 
     @jax.jit
-    @lambda f: jax.shard_map(
+    @lambda f: shard_map(
         f, mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=(P(), P(None, "sp"))
     )
     def sharded(w_, x_local):
@@ -83,7 +84,7 @@ def test_sp_pipelined_scan_matches_single_device(reverse, n_micro):
     h_last_ref, hs_ref = gru_layer(x, w, h0, reverse=reverse)
 
     @jax.jit
-    @lambda f: jax.shard_map(
+    @lambda f: shard_map(
         f, mesh=mesh, in_specs=(P(), P(), P(None, "sp")),
         out_specs=(P(), P(None, "sp")), check_vma=False,
     )
@@ -159,6 +160,8 @@ def test_sp_forward_multilayer_matches_model(bidirectional, n_micro):
         np.asarray(logits), np.asarray(expected), atol=1e-5)
 
 
+@pytest.mark.slow  # ~12 s of 8-dev compile: single-layer
+# differentiability stays tier-1; stacking adds no new collective
 def test_sp_forward_multilayer_is_differentiable():
     cfg = ModelConfig(hidden_size=8, n_features=6, output_size=4,
                       dropout=0.0, use_pallas=False, n_layers=2)
@@ -252,7 +255,7 @@ def test_sp_scan_with_pallas_local_blocks(n_micro):
 
     def make(scan_fn):
         @jax.jit
-        @lambda f: jax.shard_map(
+        @lambda f: shard_map(
             f, mesh=mesh, in_specs=(P(), P(None, "sp")),
             out_specs=(P(), P(None, "sp")),
             # pallas_call outputs carry no vma annotation; the production

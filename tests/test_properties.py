@@ -1,8 +1,23 @@
-"""Property-based tests (hypothesis) for the framework's core invariants."""
+"""Property-based tests (hypothesis) for the framework's core invariants.
+
+Runs under real hypothesis when the wheel is present; otherwise under
+tests/_minihyp.py — a deterministic, dependency-free subset with the
+same decorator surface — so this file collects (and the properties
+actually run) on the hermetic CI image too.  It was tier-1's only
+collection error from seed until PR 9.
+"""
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+try:
+    from hypothesis import given, settings, strategies as st
+
+    _FALLBACK = False
+except ModuleNotFoundError:  # hermetic image: no hypothesis wheel
+    from _minihyp import given, settings, strategies as st
+
+    _FALLBACK = True
 
 from fmda_tpu.data.normalize import chunk_norm_params, normalize
 from fmda_tpu.data.windows import chunk_ranges, train_val_test_split, window_index_matrix
@@ -15,6 +30,11 @@ from fmda_tpu.ops.indicators import (
 from fmda_tpu.stream.bus import InProcessBus
 
 SETTINGS = dict(max_examples=40, deadline=None)
+
+# the kernel property test pays a fresh interpret-mode compile per
+# example; under the fallback (every CI run) trim the sweep to keep
+# tier-1 inside its wall budget — real hypothesis keeps the full count
+_KERNEL_EXAMPLES = 8 if _FALLBACK else 15
 
 
 # ------------------------------------------------------------- rolling ops
@@ -163,7 +183,7 @@ def test_bus_order_and_offsets_under_retention(ops, capacity):
     reverse=st.booleans(),
     seed=st.integers(min_value=0, max_value=2**31 - 1),
 )
-@settings(max_examples=15, deadline=None)
+@settings(max_examples=_KERNEL_EXAMPLES, deadline=None)
 def test_pallas_kernel_matches_scan_property(batch, seq, hidden, reverse, seed):
     """Fused-kernel forward AND gradients == lax.scan for arbitrary small
     shapes/directions (interpret mode) — the shape envelope the fixed

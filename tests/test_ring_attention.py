@@ -212,7 +212,12 @@ def test_ring_attention_gradients_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [
+    False,
+    # ~28 s apiece on the one-core CI box; the causal program is tier-1
+    # via test_sp_transformer_flash_fold_matches_single_device[True]
+    pytest.param(True, marks=pytest.mark.slow),
+])
 def test_sp_transformer_matches_single_device(causal):
     """The full sequence-sharded TemporalTransformer forward (embed + ring
     attention blocks + MLPs + pool-concat head over collectives) equals
@@ -260,6 +265,9 @@ def test_sp_transformer_flash_fold_matches_single_device(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
 
 
+@pytest.mark.slow  # ~30 s of 8-dev-mesh compile on the one-core CI box:
+# the f32 sp-transformer parity tests above cover the program per
+# direction inside the tier-1 wall budget; dtype semantics ride here
 def test_sp_transformer_bf16_matches_single_device():
     """The sp path must follow the module's dtype semantics (params cast
     to bf16 for the matmuls, LN stats in f32) — not silently run f32."""
@@ -282,6 +290,9 @@ def test_sp_transformer_bf16_matches_single_device():
         np.asarray(out), np.asarray(ref), atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.slow  # ~35 s: two full sp train-step compiles; the flash
+# fold's forward parity + gradients are tier-1 via the tests above, and
+# the jnp-fold train step is tier-1 via test_scaleout
 def test_sp_train_step_flash_fold_matches_jnp_fold():
     """One FULL train step (remat + shard_map + flash custom-vjp + Adam)
     with the fused ring fold equals the jnp-fold step: same loss, same

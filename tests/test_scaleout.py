@@ -157,6 +157,9 @@ def test_multiticker_mixed_training_learns():
     assert history["train"][-1].accuracy > history["train"][0].accuracy
 
 
+@pytest.mark.slow  # ~12 s: two extra sp train-step compiles; the plain
+# long-context sp step stays tier-1 and remat correctness is asserted on
+# the attn path by the (slow) flash-fold train-step test
 def test_sp_train_step_remat_matches_plain():
     """remat=True (recompute the forward in the backward pass) must be a
     pure memory/compute trade: same loss trajectory as the plain step."""
