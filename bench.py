@@ -1559,6 +1559,59 @@ def phase_runtime_chaos_soak() -> dict:
     return result
 
 
+def phase_pipeline_chaos_soak() -> dict:
+    """Data-plane chaos soak (ISSUE 10): synthetic feeds → join engine →
+    write-ahead-journaled warehouse → solo Predictor, in-process, under
+    a seeded plan that takes one side feed down (degraded-mode joins),
+    makes the warehouse unreachable (journal spill + backfill), and
+    kills the engine mid-stream (checkpoint restore + crash-replay
+    dedupe).  Hard gates (docs/chaos.md "Data-plane faults"):
+
+    - exit 0 with ``ingested == landed + Σ loss counters`` across the
+      engine kill/restore (zero unaccounted rows);
+    - degraded-mode entered AND exited (rows emitted with last-known
+      side features during the outage, clean joins after recovery);
+    - journal spilled AND drained to zero;
+    - post-chaos probe bars land through the recovered pipeline and are
+      served by the predictor;
+    - clean-path rows bit-identical to an unfaulted replay (raw landed
+      bytes).
+
+    The plan replays from FMDA_CHAOS_SEED.
+    """
+    from fmda_tpu.chaos.pipeline import (
+        generate_pipeline_plan, run_pipeline_soak)
+
+    seed = int(os.environ.get("FMDA_CHAOS_SEED", "0"))
+    rounds = 30
+    plan = generate_pipeline_plan(seed, rounds)
+    out = run_pipeline_soak(
+        plan, seed=seed, rounds=rounds, predictor=True,
+        compare_unfaulted=True)
+    result = {
+        "seed": seed,
+        "rounds": rounds,
+        "plan": out["plan"],
+        "chaos_injected": out["chaos_injected"],
+        "ingested": out["ingested"],
+        "landed": out["landed"],
+        "losses": out["losses"],
+        "unaccounted": out["unaccounted"],
+        "degraded_rows": out["degraded_rows"],
+        "journal": out["journal"],
+        "engine_restarts": out["engine_restarts"],
+        "served": out["served"],
+        "identity": out.get("identity", {}),
+        "gates": out["gates"],
+    }
+    failed = [g for g, ok in out["gates"].items() if not ok]
+    if failed:
+        result["error"] = (
+            f"data-plane never-abort gates failed: {failed} (seed "
+            f"{seed} reproduces the plan; see docs/chaos.md)")
+    return result
+
+
 def phase_obs_overhead() -> dict:
     """Observability-plane cost on the engine.step hot loop: the same
     synthetic replay driven twice per repetition — once with the obs
@@ -1775,6 +1828,7 @@ _PHASES = {
     "predictor_fleet_smoke": phase_predictor_fleet,
     "runtime_multihost_smoke": phase_runtime_multihost,
     "runtime_chaos_soak": phase_runtime_chaos_soak,
+    "pipeline_chaos_soak": phase_pipeline_chaos_soak,
     "obs_overhead": phase_obs_overhead,
     "trace_overhead": phase_trace_overhead,
     "analysis_lint": phase_analysis_lint,
@@ -2207,6 +2261,7 @@ def main() -> None:
         ("predictor_fleet_smoke", 300.0),
         ("runtime_multihost_smoke", 420.0),
         ("runtime_chaos_soak", 600.0),
+        ("pipeline_chaos_soak", 420.0),
         ("obs_overhead", 300.0),
         ("trace_overhead", 300.0),
         ("flagship_bf16", 300.0),

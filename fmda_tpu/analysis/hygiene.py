@@ -52,15 +52,24 @@ ROUTER_ROLE_MODULES = (
     "fleet/wire.py",
 )
 
-#: modules carrying compiled-in chaos injection points
-CHAOS_INSTRUMENTED = (
-    "fleet/router.py",
-    "fleet/wire.py",
-    "fleet/worker.py",
-)
+#: modules carrying compiled-in chaos injection points, with the
+#: per-module floor of guarded ``if _CHAOS.enabled:`` sites each must
+#: keep (serving tier: router.pump / wire.request / link exchanges /
+#: worker.step; data plane: engine.step / warehouse.append /
+#: feed:<topic>).  A refactor that drops a module below its floor fails
+#: tier-1 the commit it lands.
+CHAOS_POINT_FLOORS = {
+    "fleet/router.py": 1,
+    "fleet/wire.py": 1,
+    "fleet/worker.py": 1,
+    "stream/engine.py": 1,
+    "stream/warehouse.py": 1,
+    "ingest/session.py": 1,
+}
+CHAOS_INSTRUMENTED = tuple(CHAOS_POINT_FLOORS)
 
 #: the chaos modules together must keep at least this many guarded points
-CHAOS_MIN_POINTS = 4
+CHAOS_MIN_POINTS = 7
 
 
 def _stale_entries(rule: Rule, ctx: LintContext, rels, list_name: str
@@ -262,9 +271,12 @@ class ChaosGuardRule(Rule):
 
         walk(module.tree, False)
         self._points[module.rel] = points[0]
-        if points[0] < 1:
+        floor = CHAOS_POINT_FLOORS[module.rel]
+        if points[0] < floor:
             found.append(self.finding(
-                module.rel, 0, "module lost its chaos injection point"))
+                module.rel, 0,
+                f"module carries {points[0]} guarded injection "
+                f"point(s), floor is {floor}"))
         return found
 
     def finish(self, ctx: LintContext) -> List[Finding]:

@@ -78,6 +78,15 @@ class Application:
             if warehouse is not None
             else Warehouse(self.config.features, self.config.warehouse)
         )
+        wc = self.config.warehouse
+        if wc.journal_path and warehouse is None:
+            # warehouse-outage survival: failed landings spill to a
+            # durable journal and backfill on recovery (an injected
+            # warehouse keeps its own durability story)
+            from fmda_tpu.stream.journal import BufferedWarehouse
+
+            self.warehouse = BufferedWarehouse(
+                self.warehouse, wc.journal_path, bound=wc.journal_bound)
         ec = self.config.engine
         self.engine = StreamEngine(
             self.bus,
@@ -89,6 +98,7 @@ class Application:
             ),
             checkpoint_every=ec.checkpoint_every,
             join_backend=ec.join_backend,
+            staleness_deadline_s=ec.staleness_deadline_s,
             metrics=reg if reg.enabled else None,
         )
         self.session = None

@@ -41,14 +41,20 @@ __all__ = [
     "chaos_families",
     "configure_chaos",
     "default_chaos",
+    "generate_pipeline_plan",
     "plan_from_config",
     "run_chaos_soak",
+    "run_pipeline_soak",
 ]
 
 
-def __getattr__(name):  # PEP 562 — the soak pulls the launcher lazily
+def __getattr__(name):  # PEP 562 — the soaks pull heavy deps lazily
     if name == "run_chaos_soak":
         from fmda_tpu.chaos.soak import run_chaos_soak
 
         return run_chaos_soak
+    if name in ("run_pipeline_soak", "generate_pipeline_plan"):
+        from fmda_tpu.chaos import pipeline
+
+        return getattr(pipeline, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

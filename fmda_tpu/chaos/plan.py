@@ -10,11 +10,14 @@ two runs of one plan observe identical sequences).
 
 Two target families share the schedule:
 
-- **injection points** — named call sites compiled into the serving code
-  (``wire.request``, ``router.pump``, ``worker.step``, ``link:<wid>``)
-  plus the opt-in wrappers (``bus``, ``warehouse`` —
-  :mod:`fmda_tpu.chaos.wrap`).  The process-default
-  :class:`~fmda_tpu.chaos.inject.ChaosRuntime` evaluates these;
+- **injection points** — named call sites compiled into the serving
+  code: the fleet tier's ``wire.request``, ``router.pump``,
+  ``worker.step``, ``link:<wid>`` and the data plane's ``engine.step``
+  (the join engine), ``warehouse.append`` (the landing path) and
+  ``feed:<topic>`` (one ingest feed) — plus the opt-in wrappers
+  (``bus``, ``warehouse`` — :mod:`fmda_tpu.chaos.wrap`).  The
+  process-default :class:`~fmda_tpu.chaos.inject.ChaosRuntime`
+  evaluates these;
 - **orchestrated targets** — whole processes (``worker:<wid>``,
   ``router``) that the soak driver (:mod:`fmda_tpu.chaos.soak`) kills
   and revives for real.
@@ -168,6 +171,12 @@ class FaultPlan:
         delay_s: float = 0.02,
         corrupts: int = 0,
         warehouse_kills: int = 0,
+        warehouse_outage_steps: Optional[int] = None,
+        engine_kills: int = 0,
+        engine_kill_steps: int = 2,
+        feed_outages: int = 0,
+        feed_topics: Sequence[str] = (),
+        feed_outage_steps: int = 6,
         settle_steps: int = 5,
     ) -> "FaultPlan":
         """Derive a schedule from one seed — pure function of its
@@ -236,8 +245,25 @@ class FaultPlan:
             add("partition", f"link:{wid}", partition_steps)
         for _ in range(bus_blips):
             add("kill", "bus", blip_steps)
+        # feed outages carry the widest windows of the data-plane set —
+        # place them before the narrower warehouse/engine events so the
+        # schedule packs (a window the plan has no room for is dropped)
+        feed_victims = list(feed_topics)
+        for _ in range(feed_outages):
+            if not feed_victims:
+                break
+            topic = feed_victims.pop(rng.randrange(len(feed_victims)))
+            add("kill", f"feed:{topic}", feed_outage_steps)
         for _ in range(warehouse_kills):
-            add("kill", "warehouse", blip_steps)
+            # the compiled-in landing point (stream/warehouse.py): every
+            # insert in the window raises, the write-ahead journal spills
+            add("kill", "warehouse.append",
+                warehouse_outage_steps
+                if warehouse_outage_steps is not None else blip_steps)
+        for _ in range(engine_kills):
+            # the join engine "process dies": steps raise for the whole
+            # window, the driver restores from the checkpoint after it
+            add("kill", "engine.step", engine_kill_steps)
         for _ in range(delays):
             # only points the soak driver's own process evaluates:
             # "worker.step" lives in the spawned worker processes, whose

@@ -629,6 +629,44 @@ def _cmd_fleet_chaos(args, cfg) -> int:
     return 0 if out["gates_ok"] else 1
 
 
+def cmd_chaos_pipeline(args) -> int:
+    """chaos-pipeline: the data-plane chaos soak — synthetic feeds →
+    join engine → journaled warehouse → predictor, in-process, under a
+    seeded fault plan (feed outage, warehouse outage, engine kill),
+    hard-gating the never-abort contract for the whole pipeline
+    (docs/chaos.md "Data-plane faults").  Exit 1 iff a gate fails."""
+    from fmda_tpu.chaos.pipeline import (
+        generate_pipeline_plan,
+        run_pipeline_soak,
+    )
+    from fmda_tpu.chaos.plan import FaultPlan
+
+    cfg = _config(args)
+    cc = cfg.chaos
+    seed = args.seed if args.seed is not None else cc.seed
+    if args.plan:
+        plan = FaultPlan.load(args.plan)
+    else:
+        plan = generate_pipeline_plan(
+            seed, args.rounds,
+            feed_outages=cc.feed_outages,
+            feed_outage_steps=cc.feed_outage_steps,
+            warehouse_outages=cc.warehouse_outages,
+            warehouse_outage_steps=cc.warehouse_outage_steps,
+            engine_kills=cc.engine_kills,
+            engine_kill_steps=cc.engine_kill_steps,
+            settle_steps=cc.settle_steps)
+    out = run_pipeline_soak(
+        plan,
+        seed=seed,
+        rounds=args.rounds,
+        predictor=not args.no_predictor,
+        compare_unfaulted=not args.no_reference,
+    )
+    print(json.dumps(out, indent=2, default=str))
+    return 0 if out["gates_ok"] else 1
+
+
 def _cmd_fleet_local(args) -> int:
     """serve-fleet --role local: the single-command topology — spawn
     router (inline) + N worker processes, drive the synthetic fleet
@@ -1478,6 +1516,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (grouped trace dicts)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "chaos-pipeline", parents=[common],
+        help="data-plane chaos soak: feeds -> engine -> journaled "
+             "warehouse -> predictor under a seeded fault plan "
+             "(docs/chaos.md); exit 1 iff a never-abort gate fails")
+    p.add_argument("--seed", type=int, default=None,
+                   help="plan + market seed (default: [chaos] seed; "
+                        "FMDA_CHAOS_SEED drives the bench phase)")
+    p.add_argument("--rounds", type=int, default=30,
+                   help="virtual steps the plan schedules over")
+    p.add_argument("--plan", default=None, metavar="FILE",
+                   help="explicit fault-plan JSON instead of the "
+                        "seeded data-plane schedule (the reproduction "
+                        "path)")
+    p.add_argument("--no-predictor", action="store_true",
+                   help="skip the jitted Predictor stage (jax-free, "
+                        "faster; drops the probes-served gate)")
+    p.add_argument("--no-reference", action="store_true",
+                   help="skip the unfaulted reference replay (faster; "
+                        "drops the bit-identity gate)")
+    p.set_defaults(fn=cmd_chaos_pipeline)
 
     p = sub.add_parser(
         "lint",

@@ -98,6 +98,14 @@ class WarehouseConfig:
     path: str = ":memory:"  # sqlite path or file
     database_name: str = "stock_data"
     table_name: str = "stock_data_joined"
+    #: Write-ahead journal file for warehouse-outage survival
+    #: (fmda_tpu.stream.journal.BufferedWarehouse): failed landings
+    #: spill here durably and a backfill loop drains them on recovery,
+    #: idempotent on timestamp.  None disables the buffer (a failed
+    #: insert raises through the engine step, pre-ISSUE-10 behavior).
+    journal_path: Optional[str] = None
+    #: Bound on journaled rows; overflow sheds the oldest, counted.
+    journal_bound: int = 65536
     # MySQL parity fields (unused by the sqlite backend)
     user: str = "admin"
     password: str = "admin"
@@ -446,6 +454,16 @@ class EngineConfig:
     checkpoint_every: int = 1
     #: Engine state file (offsets + in-flight join state); None disables.
     checkpoint_path: Optional[str] = None
+    #: Degraded-mode join deadline (stream-time seconds): a side stream
+    #: whose watermark trails the newest book tick by more than this
+    #: stops blocking the join — rows emit with the stream's last-known
+    #: (or absent) values, counted per topic, and the ``feed_degraded``
+    #: health check flips until the feed recovers.  None keeps the
+    #: strict inner-join stall.  Keep it below
+    #: ``watermark_s + 2*join_tolerance_s`` (660 s at the default
+    #: feature config) or waiting ticks can lose their healthy matches
+    #: to watermark eviction (a counted drop) before the ghost arrives.
+    staleness_deadline_s: Optional[int] = None
 
 
 #: Fleet-runtime defaults shared by RuntimeConfig and the direct
@@ -682,6 +700,22 @@ class ChaosConfig:
     #: and the post-chaos window the "ticks served after the last
     #: fault" gate measures in.
     settle_steps: int = 5
+
+    # -- data-plane soak knobs (fmda_tpu.chaos.pipeline; the fleet soak
+    # above ignores these) ---------------------------------------------
+
+    #: Side-feed outage windows per pipeline soak (degraded-mode joins).
+    feed_outages: int = 1
+    #: Virtual steps a feed stays down.
+    feed_outage_steps: int = 8
+    #: Warehouse-unreachable windows per pipeline soak (journal spill).
+    warehouse_outages: int = 1
+    #: Virtual steps the warehouse stays down.
+    warehouse_outage_steps: int = 4
+    #: Engine kill/restore cycles per pipeline soak.
+    engine_kills: int = 1
+    #: Virtual steps the engine stays dead before its restore.
+    engine_kill_steps: int = 2
 
 
 @dataclass(frozen=True)

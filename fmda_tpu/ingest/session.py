@@ -30,6 +30,7 @@ from fmda_tpu.config import (
     TOPIC_VIX,
     TOPIC_VOLUME,
 )
+from fmda_tpu.chaos.inject import default_chaos
 from fmda_tpu.ingest.clients import AlphaVantageClient, IEXClient, TradierCalendarClient
 from fmda_tpu.ingest.scrapers import COTScraper, EconomicCalendarScraper, VIXScraper
 from fmda_tpu.obs.trace import default_tracer
@@ -37,6 +38,12 @@ from fmda_tpu.stream.bus import MessageBus
 from fmda_tpu.utils.timeutils import forex_market_hours, get_timezone, stock_market_hours
 
 log = logging.getLogger("fmda_tpu.ingest")
+
+#: chaos injection singleton, captured once at import: ``feed:<topic>``
+#: points let a fault plan take one feed down for a window — the
+#: existing per-feed isolation absorbs the raise, and the engine's
+#: degraded-mode join keeps rows flowing (docs/chaos.md)
+_CHAOS = default_chaos()
 
 
 class SessionDriver:
@@ -108,6 +115,10 @@ class SessionDriver:
 
         def attempt(name: str, fn: Callable[[], Optional[Dict]], topic: str) -> None:
             try:
+                if _CHAOS.enabled:
+                    # an injected feed outage is a failed fetch: the
+                    # except below counts it like any dead endpoint
+                    _CHAOS.check("feed:" + topic)
                 message = fn()
                 if message is not None:
                     self.bus.publish(topic, message)

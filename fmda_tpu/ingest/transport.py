@@ -32,12 +32,49 @@ INGEST_COUNTER_NAMES = (
     "ingest_retries_total",
     "ingest_ratelimit_waits_total",
     "ingest_ratelimit_wait_seconds_total",
+    "ingest_circuit_open_total",
+    "ingest_circuit_shortcircuit_total",
 )
 INGEST_HISTOGRAM_NAMES = ("ingest_request_seconds",)
 
 
 class TransportError(Exception):
-    """Network failure or non-2xx response."""
+    """Network failure or non-2xx response.
+
+    ``status`` carries the HTTP status when one was received (None for
+    connection-level failures); ``retry_after_s`` carries a parsed
+    ``Retry-After`` header in seconds when the server sent one — the
+    retry layer honors it on 429/503 instead of guessing."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        status: Optional[int] = None,
+        retry_after_s: Optional[float] = None,
+    ) -> None:
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+def _parse_retry_after(value) -> Optional[float]:
+    """Seconds form of a ``Retry-After`` header value (the HTTP-date
+    form is rare on rate limiters and a wrong clock would turn it into
+    a pathological sleep — unparseable values are simply ignored)."""
+    if value is None:
+        return None
+    try:
+        out = float(str(value).strip())
+    except ValueError:
+        return None
+    return out if out >= 0 else None
+
+
+def _url_host(url: str) -> str:
+    from urllib.parse import urlparse
+
+    return urlparse(url).netloc or url
 
 
 class Transport(Protocol):
@@ -86,6 +123,15 @@ class UrllibTransport:
                 with urllib.request.urlopen(
                         request, timeout=self.timeout_s) as resp:
                     return resp.read()
+        except urllib.error.HTTPError as e:  # pragma: no cover - live only
+            # carry the status + Retry-After so the retry layer can obey
+            # a rate limiter / recovering feed instead of hammering it
+            self._m_failures.inc()
+            retry_after = _parse_retry_after(
+                e.headers.get("Retry-After") if e.headers else None)
+            raise TransportError(
+                f"GET {url} failed: {e}",
+                status=int(e.code), retry_after_s=retry_after) from e
         except urllib.error.URLError as e:  # pragma: no cover - live only
             self._m_failures.inc()
             raise TransportError(f"GET {url} failed: {e}") from e
@@ -187,7 +233,19 @@ class SessionReplayTransport:
 class RetryTransport:
     """Retry-with-backoff wrapper (SURVEY.md §5: the reference retries only
     once, with a fixed 15 s sleep, and only in serving — here any transport
-    gets exponential-backoff retries with per-attempt logging)."""
+    gets exponential-backoff retries with per-attempt logging).
+
+    Backoff uses **full jitter** (delay drawn uniformly from
+    ``[0, backoff_s * 2^attempt]``): the session drivers all tick on the
+    same cadence, so un-jittered backoff retries every feed's clients in
+    lockstep against a recovering host — the classic thundering-herd
+    shape.  ``jitter=False`` restores the deterministic schedule (and
+    ``rng`` injects a seeded source for tests).  A 429/503 response
+    carrying ``Retry-After`` overrides the computed delay — the server
+    knows its own recovery better than our schedule — capped at the
+    schedule's largest backoff (``backoff_s * 2^(attempts-1)``) so a
+    pathological header can never park the cadence loop.
+    """
 
     def __init__(
         self,
@@ -196,16 +254,29 @@ class RetryTransport:
         backoff_s: float = 1.0,
         sleep_fn=None,
         *,
+        jitter: bool = True,
+        rng=None,
         metrics=None,
     ) -> None:
+        import random
         import time
 
         self.inner = inner
         self.attempts = attempts
         self.backoff_s = backoff_s
         self.sleep_fn = sleep_fn or time.sleep
+        self.jitter = jitter
+        self._rng = rng if rng is not None else random.Random()
         reg = metrics if metrics is not None else default_registry()
         self._m_retries = reg.counter("ingest_retries_total")
+
+    def _delay(self, attempt: int, error: TransportError) -> float:
+        cap = self.backoff_s * (2 ** attempt)
+        if (error.status in (429, 503)
+                and error.retry_after_s is not None):
+            budget = self.backoff_s * (2 ** (self.attempts - 1))
+            return min(error.retry_after_s, budget)
+        return self._rng.uniform(0.0, cap) if self.jitter else cap
 
     def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
         last: Optional[Exception] = None
@@ -215,7 +286,7 @@ class RetryTransport:
             except TransportError as e:
                 last = e
                 if attempt < self.attempts - 1:
-                    delay = self.backoff_s * (2**attempt)
+                    delay = self._delay(attempt, e)
                     log.warning(
                         "GET %s failed (attempt %d/%d): %s; retrying in %.1fs",
                         url, attempt + 1, self.attempts, e, delay,
@@ -225,6 +296,112 @@ class RetryTransport:
         raise TransportError(
             f"GET {url} failed after {self.attempts} attempts"
         ) from last
+
+
+class CircuitOpenError(TransportError):
+    """Short-circuited request: the host's breaker is open (the feed has
+    been failing consecutively and its probe timer has not elapsed)."""
+
+
+class CircuitBreakerTransport:
+    """Per-host circuit breaker (docs/chaos.md "Data-plane faults").
+
+    The hardened transport stack bounds one GET at ~69 s worst case
+    (attempts × timeout + backoff) — survivable once, but a *dead* feed
+    pays that wall on every cadence tick, starving the other feeds' slot
+    in the tick loop.  The breaker makes a dead host fail in
+    microseconds instead: ``failure_threshold`` consecutive failures
+    trip the host **open** (counted, logged); while open every request
+    short-circuits with :class:`CircuitOpenError` (a ``TransportError``
+    — the session driver's per-feed isolation handles it unchanged);
+    after ``reset_timeout_s`` the next request is let through as a
+    **half-open probe** — success closes the breaker, failure re-opens
+    it for another timer period.  State is per *host*, so one dead feed
+    never opens the breaker for the rest.
+    """
+
+    def __init__(
+        self,
+        inner: Transport,
+        *,
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 120.0,
+        clock=None,
+        metrics=None,
+    ) -> None:
+        import time
+
+        self.inner = inner
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.reset_timeout_s = reset_timeout_s
+        self.clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        #: host -> {"failures", "state", "opened_at"} where state is
+        #: "closed" | "open" | "probe" (one half-open probe in flight)
+        self._hosts: Dict[str, Dict[str, object]] = {}
+        reg = metrics if metrics is not None else default_registry()
+        self._m_trips = reg.counter("ingest_circuit_open_total")
+        self._m_short = reg.counter("ingest_circuit_shortcircuit_total")
+
+    def state(self, url_or_host: str) -> str:
+        """Current breaker state for a host (monitoring/tests)."""
+        host = _url_host(url_or_host)
+        with self._lock:
+            entry = self._hosts.get(host)
+            return str(entry["state"]) if entry else "closed"
+
+    def _admit(self, host: str) -> None:
+        """Decide whether this request may pass (raises when open)."""
+        with self._lock:
+            entry = self._hosts.get(host)
+            if entry is None or entry["state"] == "closed":
+                return
+            if entry["state"] == "open" and (
+                    self.clock() - entry["opened_at"]
+                    >= self.reset_timeout_s):
+                # timer elapsed: this request becomes the half-open probe
+                entry["state"] = "probe"
+                log.warning(
+                    "circuit for %s half-open: probing with this request",
+                    host)
+                return
+            # open (timer running) or another probe already in flight
+            self._m_short.inc()
+            raise CircuitOpenError(
+                f"circuit open for {host}: {entry['failures']} consecutive "
+                f"failures; next probe in <= {self.reset_timeout_s:.0f}s")
+
+    def _record(self, host: str, ok: bool) -> None:
+        with self._lock:
+            entry = self._hosts.setdefault(
+                host, {"failures": 0, "state": "closed", "opened_at": 0.0})
+            if ok:
+                if entry["state"] != "closed" or entry["failures"]:
+                    log.warning("circuit for %s closed (probe succeeded)",
+                                host)
+                entry.update(failures=0, state="closed")
+                return
+            entry["failures"] = int(entry["failures"]) + 1
+            tripped = (entry["state"] == "probe"
+                       or entry["failures"] >= self.failure_threshold)
+            if tripped and entry["state"] != "open":
+                entry.update(state="open", opened_at=self.clock())
+                self._m_trips.inc()
+                log.warning(
+                    "circuit for %s OPEN after %d consecutive failure(s); "
+                    "probing again in %.0fs", host, entry["failures"],
+                    self.reset_timeout_s)
+
+    def get(self, url: str, headers: Optional[Dict[str, str]] = None) -> bytes:
+        host = _url_host(url)
+        self._admit(host)
+        try:
+            body = self.inner.get(url, headers)
+        except TransportError:
+            self._record(host, ok=False)
+            raise
+        self._record(host, ok=True)
+        return body
 
 
 #: Process-wide per-host last-request map shared by every
@@ -336,24 +513,34 @@ def live_transport(
     attempts: int = 3,
     backoff_s: float = 1.0,
     min_interval_s: float = 1.0,
+    breaker_threshold: int = 3,
+    breaker_reset_s: float = 120.0,
 ) -> Transport:
     """The hardened default for live operation: stdlib HTTP behind
-    per-host rate limiting behind exponential-backoff retries.
+    per-host rate limiting behind jittered exponential-backoff retries
+    behind a per-host circuit breaker.
 
-    Worst-case wall per GET is bounded (attempts x timeout plus
+    Worst-case wall per GET is bounded (attempts x timeout plus up to
     ``backoff_s * (2^attempts - 1)`` of sleep — ~69 s at the defaults),
     so a dead feed degrades to a logged :class:`TransportError` the
     session driver isolates per feed (ingest/session.py), never a stuck
-    tick loop.  Clients and scrapers construct this when not handed an
-    explicit transport (tests inject replay/recording transports).
+    tick loop — and after ``breaker_threshold`` consecutive dead ticks
+    the breaker stops paying even that wall: the host fails instantly
+    until its half-open probe succeeds.  Clients and scrapers construct
+    this when not handed an explicit transport (tests inject
+    replay/recording transports).
     """
-    return RetryTransport(
-        RateLimitTransport(
-            UrllibTransport(timeout_s, user_agent),
-            min_interval_s=min_interval_s,
+    return CircuitBreakerTransport(
+        RetryTransport(
+            RateLimitTransport(
+                UrllibTransport(timeout_s, user_agent),
+                min_interval_s=min_interval_s,
+            ),
+            attempts=attempts,
+            backoff_s=backoff_s,
         ),
-        attempts=attempts,
-        backoff_s=backoff_s,
+        failure_threshold=breaker_threshold,
+        reset_timeout_s=breaker_reset_s,
     )
 
 

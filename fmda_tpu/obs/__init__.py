@@ -25,6 +25,7 @@ from fmda_tpu.obs.events import EventLog
 from fmda_tpu.obs.observability import (
     Observability,
     engine_families,
+    journal_families,
     runtime_families,
     stage_timer_families,
 )
@@ -61,6 +62,7 @@ __all__ = [
     "default_registry",
     "default_tracer",
     "engine_families",
+    "journal_families",
     "render_prometheus",
     "runtime_families",
     "stage_timer_families",

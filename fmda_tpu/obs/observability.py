@@ -98,10 +98,20 @@ def engine_families(engine) -> Snapshot:
          "value": st["emitted"]},
         {"name": "engine_dropped_total", "labels": {},
          "value": st["dropped"]},
+        {"name": "engine_checkpoint_corrupt_total", "labels": {},
+         "value": st.get("checkpoint_corrupt", 0)},
     ]
+    for topic, n in st.get("degraded_rows", {}).items():
+        counters.append({
+            "name": "engine_degraded_rows_total",
+            "labels": {"topic": topic},
+            "value": n,
+        })
     gauges = [
         {"name": "engine_pending_joins", "labels": {},
          "value": st["pending"]},
+        {"name": "engine_degraded_streams", "labels": {},
+         "value": len(st.get("degraded_streams", ()))},
     ]
     for topic, lag in st["consumer_lag"].items():
         gauges.append({
@@ -120,6 +130,25 @@ def engine_families(engine) -> Snapshot:
     out["counters"] = counters + out.get("counters", [])
     out["gauges"] = gauges
     return out
+
+
+def journal_families(warehouse) -> Snapshot:
+    """Write-ahead-journal stats (fmda_tpu.stream.journal) -> registry
+    samples: spill/backfill/shed counters + the pending-backlog gauge
+    an operator watches through a warehouse outage."""
+    stats = warehouse.journal_stats()
+    pending = stats.pop("pending", 0)
+    return {
+        "counters": [
+            {"name": f"warehouse_journal_{name}_total", "labels": {},
+             "value": value}
+            for name, value in sorted(stats.items())
+        ],
+        "gauges": [
+            {"name": "warehouse_journal_pending", "labels": {},
+             "value": pending},
+        ],
+    }
 
 
 class Observability:
@@ -241,6 +270,11 @@ class Observability:
         if bind_wh is not None:
             bind_wh(self.registry)
 
+        journal_stats = getattr(warehouse, "journal_stats", None)
+        if journal_stats is not None:
+            self.registry.register_collector(
+                "warehouse_journal", lambda: journal_families(warehouse))
+
         def check_bus() -> Tuple[bool, object]:
             topics = bus.topics()
             return bool(topics), f"{len(topics)} topics"
@@ -251,8 +285,35 @@ class Observability:
                 return bool(healthy()), "probe write"
             return True, "no probe (non-sqlite backend)"
 
+        def check_feed_degraded() -> Tuple[bool, object]:
+            # flips degraded while any side stream is past its staleness
+            # deadline (rows are flowing with last-known features —
+            # counted degradation an operator must see), recovers the
+            # moment the feed's watermark catches back up
+            stale = engine.degraded_streams()
+            if not stale:
+                return True, "all feeds fresh"
+            rows = engine.stats["degraded_rows"]
+            return False, {
+                t: f"{rows.get(t, 0)} degraded rows" for t in stale}
+
         self.checks["bus"] = check_bus
         self.checks["warehouse"] = check_warehouse
+        self.checks["feed_degraded"] = check_feed_degraded
+        if journal_stats is not None:
+            def check_journal() -> Tuple[bool, object]:
+                stats = journal_stats()
+                pending = stats["pending"]
+                if pending == 0:
+                    return True, (
+                        f"empty ({stats['backfilled_rows']} backfilled, "
+                        f"{stats['shed_rows']} shed lifetime)")
+                return False, (
+                    f"{pending} rows awaiting backfill "
+                    f"({stats['spilled_rows']} spilled, "
+                    f"{stats['drain_failures']} drain failures)")
+
+            self.checks["warehouse_journal"] = check_journal
         self.checks["last_tick"] = self._check_last_tick
 
     def track_fleet(self, gateway) -> None:

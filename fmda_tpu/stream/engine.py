@@ -49,6 +49,7 @@ from fmda_tpu.config import (
     TOPIC_VIX,
     TOPIC_VOLUME,
 )
+from fmda_tpu.chaos.inject import default_chaos
 from fmda_tpu.obs.trace import default_tracer, now_ns
 from fmda_tpu.ops.microstructure import deep_features, wick_percentage
 from fmda_tpu.stream.bus import MessageBus
@@ -57,6 +58,11 @@ from fmda_tpu.utils.timeutils import floor_epoch, parse_ts, to_epoch
 from fmda_tpu.utils.tracing import StageTimer
 
 log = logging.getLogger("fmda_tpu.stream")
+
+#: chaos injection singleton, captured once at import (the tracer's
+#: discipline): ``engine.step`` is a compiled-in injection point so a
+#: fault plan can kill/stall the join engine mid-stream (docs/chaos.md)
+_CHAOS = default_chaos()
 
 
 @dataclass
@@ -68,6 +74,12 @@ class _Event:
     #: (deep/book events only — the book tick IS the traced entity);
     #: None when the producer wasn't tracing
     trace: Optional[str] = None
+    #: True for a ghost event the engine synthesised for a stale side
+    #: stream (degraded-mode join): the payload is that stream's
+    #: last-known values (or empty — fillna 0 lands zeros).  A join
+    #: consuming a ghost counts in ``degraded_rows``; real events are
+    #: preferred over ghosts when both fall in a match window.
+    degraded: bool = False
 
 
 @dataclass
@@ -84,11 +96,24 @@ class _StreamBuffer:
     floor_s: int
     buckets: Dict[int, List[_Event]] = field(default_factory=dict)
     max_ts: int = -1
+    #: payload of the newest *real* event ever ingested — the
+    #: "last-known values" a degraded-mode join falls back to while the
+    #: feed is down; None until the stream first delivers
+    last_payload: Optional[Dict[str, float]] = None
 
     def add(self, event: _Event) -> None:
         self.buckets.setdefault(
             floor_epoch(event.ts, self.floor_s), []).append(event)
         self.max_ts = max(self.max_ts, event.ts)
+        if event.ts == self.max_ts:
+            self.last_payload = event.payload
+
+    def add_ghost(self, event: _Event) -> None:
+        """Insert a degraded-mode ghost WITHOUT advancing ``max_ts`` (or
+        ``last_payload``): the watermark tracks only what the feed really
+        delivered, so recovery detection and eviction stay honest."""
+        self.buckets.setdefault(
+            floor_epoch(event.ts, self.floor_s), []).append(event)
 
     def watermark(self, delay_s: int) -> int:
         return self.max_ts - delay_s if self.max_ts >= 0 else -1
@@ -105,12 +130,17 @@ class _StreamBuffer:
                 del self.buckets[boundary]
 
     def match(self, deep_ts: int, tolerance_s: int) -> Optional[_Event]:
-        """Earliest event with equal floor and ts in [deep_ts, deep_ts+tol]."""
+        """Earliest event with equal floor and ts in [deep_ts, deep_ts+tol].
+
+        Real events beat ghosts regardless of timestamp: a feed that
+        recovers inside a tick's match window should serve real values
+        even though the ghost (minted at ``deep_ts``) sorts earliest."""
         best: Optional[_Event] = None
         for e in self.buckets.get(floor_epoch(deep_ts, self.floor_s), ()):
             if not (deep_ts <= e.ts <= deep_ts + tolerance_s):
                 continue
-            if best is None or e.ts < best.ts:
+            if (best is None or (best.degraded and not e.degraded)
+                    or (best.degraded == e.degraded and e.ts < best.ts)):
                 best = e
         return best
 
@@ -260,6 +290,7 @@ class StreamEngine:
         from_end: bool = False,
         checkpoint_every: int = 1,
         join_backend: str = "python",
+        staleness_deadline_s: Optional[int] = None,
         metrics=None,
     ) -> None:
         self.bus = bus
@@ -267,6 +298,13 @@ class StreamEngine:
         self.features = features
         self.signal_topic = signal_topic
         self.checkpoint_path = checkpoint_path
+        #: Degraded-mode join deadline (stream-time seconds): once a side
+        #: stream's watermark trails the newest book tick by more than
+        #: this, the engine stops stalling on it and joins with the
+        #: stream's last-known (or absent) values instead — each such
+        #: row counted per topic in ``degraded_rows``.  None (default)
+        #: keeps the strict inner-join stall semantics.
+        self.staleness_deadline_s = staleness_deadline_s
         #: Checkpoint cadence in steps.  1 = after every step (strongest
         #: durability, the default); N > 1 amortises the state write over
         #: replay/backtest churn — a crash then replays at most the last N
@@ -299,6 +337,18 @@ class StreamEngine:
         #: only — payloads stay in the Python buffers/pending list); the
         #: "native" backend is bit-identical to "python", test-locked
         self._core = None
+        if join_backend == "native" and staleness_deadline_s is not None:
+            # degraded-mode preference (a real event beats a ghost
+            # inside a match window) lives in the python scheduler's
+            # match(); the C++ core's earliest-ts rule would pick the
+            # ghost after a feed recovers mid-window, silently diverging
+            # from the python path.  Loud fallback, same discipline as
+            # an absent toolchain: the python path is bit-identical.
+            log.warning(
+                "degraded-mode joins (staleness_deadline_s=%s) run on "
+                "the python join scheduler; ignoring join_backend="
+                "'native'", staleness_deadline_s)
+            join_backend = "python"
         if join_backend == "native":
             from fmda_tpu.stream.native_join import (
                 NativeJoinCore, NativeJoinUnavailable,
@@ -346,9 +396,28 @@ class StreamEngine:
         )
         self._emitted = 0
         self._dropped = 0
+        #: degraded-mode accounting: rows emitted with ghost features,
+        #: per side topic, plus the timestamps of those rows (pruned with
+        #: the landed-dedupe set) so a chaos harness can exclude them
+        #: from bit-identity comparisons
+        self._degraded_rows: Dict[str, int] = {
+            t: 0 for t in self._side_streams}
+        self._degraded_ts: set = set()
+        #: corrupt/truncated checkpoint files survived (counted fresh
+        #: starts — see :meth:`restore`)
+        self._checkpoint_corrupt = 0
         #: newest book-tick timestamp ingested (epoch s) — the stream-time
         #: "now" that watermark ages in :attr:`stats` are measured against
         self._max_deep_ts = -1
+        #: first book-tick timestamp ever ingested: the degraded-mode
+        #: reference for a side stream that has NEVER delivered (its
+        #: watermark is undefined, so staleness is measured as how far
+        #: book time has advanced since the session started)
+        self._first_deep_ts = -1
+        #: warehouse backfill hook (fmda_tpu.stream.journal): drained
+        #: once per step so a spilled journal recovers even on idle
+        #: ticks; None for plain warehouses (one attribute read per step)
+        self._wh_drain = getattr(warehouse, "drain_journal", None)
         #: per-stage wall-clock accounting (SURVEY.md §5: the reference has
         #: no tracing; here every step exposes ingest/join/land/signal time)
         self.timer = StageTimer()
@@ -362,8 +431,17 @@ class StreamEngine:
         #: span recorder (fmda_tpu.obs.trace) — the process-default
         #: tracer, captured once; disabled = one branch per step
         self._tracer = default_tracer()
-        if checkpoint_path and os.path.exists(checkpoint_path):
-            self.restore()
+        if checkpoint_path:
+            tmp = f"{checkpoint_path}.tmp"
+            if os.path.exists(tmp):
+                # a kill mid-checkpoint() leaves the tmp behind
+                # (os.replace never committed it); the durable file is
+                # authoritative — a stale tmp must never be mistaken for
+                # state or block the next atomic replace
+                log.warning("removing leftover checkpoint tmp %s", tmp)
+                os.remove(tmp)
+            if os.path.exists(checkpoint_path):
+                self.restore()
 
     # -- parsing -------------------------------------------------------------
 
@@ -409,6 +487,8 @@ class StreamEngine:
         for event in deep_events:
             bisect.insort(self._pending_deep, event, key=lambda e: e.ts)
             self._max_deep_ts = max(self._max_deep_ts, event.ts)
+            if self._first_deep_ts < 0:
+                self._first_deep_ts = event.ts
             if self._core is not None:
                 self._core.add_deep(event.ts)
         parsers = self._side_parsers
@@ -427,6 +507,59 @@ class StreamEngine:
                     self._core.add_side(idx, event.ts)
         return polled_any
 
+    # -- degraded-mode joins (docs/chaos.md "Data-plane faults") -------------
+
+    def degraded_streams(self) -> Tuple[str, ...]:
+        """Side streams currently past the staleness deadline: their
+        watermark trails the newest book tick by more than
+        ``staleness_deadline_s`` (a stream that has never delivered is
+        measured from the first book tick instead).  Empty when the
+        feature is disabled or every feed is fresh — recovery is
+        automatic the moment real events advance the watermark."""
+        dl = self.staleness_deadline_s
+        if dl is None or self._max_deep_ts < 0:
+            return ()
+        wm_s = self.features.watermark_s
+        out = []
+        for topic, buf in self._side_streams.items():
+            wm = buf.watermark(wm_s)
+            ref = wm if wm >= 0 else self._first_deep_ts - wm_s
+            if self._max_deep_ts - ref > dl:
+                out.append(topic)
+        return tuple(out)
+
+    def _apply_degraded_mode(self) -> None:
+        """Mint ghost events so stale streams stop blocking the join:
+        for every pending book tick with no real match in a degraded
+        stream, a ghost carrying the stream's last-known payload (empty
+        if it never delivered — fillna lands zeros) is inserted at the
+        tick's own timestamp.  The normal join path (python or native)
+        then emits the row; the consumed ghost is what increments
+        ``degraded_rows``.  Ghosts never advance watermarks, so the
+        stream re-joins cleanly the moment it recovers."""
+        degraded = self.degraded_streams()
+        if not degraded:
+            return
+        # _core is always None here: the constructor forces the python
+        # scheduler when a staleness deadline is configured (the C++
+        # core has no real-beats-ghost match rule)
+        tol = self.features.join_tolerance_s
+        for topic in degraded:
+            buf = self._side_streams[topic]
+            for deep_ev in self._pending_deep:
+                if buf.match(deep_ev.ts, tol) is not None:
+                    continue
+                ghost = _Event(
+                    deep_ev.ts, deep_ev.ts_str,
+                    dict(buf.last_payload or {}), degraded=True)
+                buf.add_ghost(ghost)
+
+    def _count_degraded(self, ts_str: str, topics) -> None:
+        for topic in topics:
+            self._degraded_rows[topic] += 1
+        if topics:
+            self._degraded_ts.add(ts_str)
+
     # -- join ----------------------------------------------------------------
 
     def step(self) -> int:
@@ -434,6 +567,12 @@ class StreamEngine:
 
         Returns the number of rows emitted this step.
         """
+        if _CHAOS.enabled:
+            # a kill window on this point is the "engine process died
+            # mid-stream" fault: the step raises before touching any
+            # state, exactly like a SIGKILL between steps — the driver
+            # rebuilds from the checkpoint via restore()
+            _CHAOS.check("engine.step")
         if self._obs_step_hist is None:
             return self._step()
         t0 = _time.perf_counter()
@@ -447,16 +586,29 @@ class StreamEngine:
         tr = self._tracer
         tracing = tr.enabled  # one branch; ns stamps only when tracing
         t_step0_ns = now_ns() if tracing else 0
+        if self._wh_drain is not None:
+            # backfill a spilled write-ahead journal before this step's
+            # rows land (ordering: journaled rows are older); a no-op
+            # when the journal is empty, swallowed-failure when the
+            # store is still down (the journal keeps the rows)
+            self._wh_drain()
         with self.timer.stage("ingest"):
             polled_any = self._ingest()
+        if self.staleness_deadline_s is not None and self._pending_deep:
+            self._apply_degraded_mode()
         emitted_rows: List[Dict[str, float]] = []
         still_pending: List[_Event] = []
         #: Timestamp -> in-band trace context for rows emitted this step
         row_traces: Dict[str, str] = {}
+        #: Timestamp -> side topics joined via ghost (counted only for
+        #: rows that actually land — a crash-replayed duplicate row must
+        #: not double-count degradation)
+        row_degraded: Dict[str, List[str]] = {}
 
         with self.timer.stage("join"):
             if self._core is not None:
-                emitted_rows, still_pending = self._join_native(row_traces)
+                emitted_rows, still_pending = self._join_native(
+                    row_traces, row_degraded)
             else:
                 for deep_ev in self._pending_deep:  # insertion-sorted by ts
                     matches: Dict[str, _Event] = {}
@@ -489,6 +641,10 @@ class StreamEngine:
                         for m in matches.values():
                             row.update(m.payload)
                         emitted_rows.append(row)
+                        ghosted = [t for t, m in matches.items()
+                                   if m.degraded]
+                        if ghosted:
+                            row_degraded[deep_ev.ts_str] = ghosted
                         if deep_ev.trace is not None:
                             row_traces[deep_ev.ts_str] = deep_ev.trace
 
@@ -533,6 +689,9 @@ class StreamEngine:
             with self.timer.stage("signal"):
                 for row in emitted_rows:
                     self._landed_ts.add(row["Timestamp"])
+                    self._count_degraded(
+                        row["Timestamp"],
+                        row_degraded.get(row["Timestamp"], ()))
                     msg: Dict[str, object] = {"Timestamp": row["Timestamp"]}
                     if row_traces:
                         # propagate the book tick's trace context onto
@@ -559,10 +718,18 @@ class StreamEngine:
                     tr.add_span_wire(
                         wire, "signal", "bus", t_land1_ns, t_sig1_ns)
 
-        # bound buffer state by the global watermark
+        # bound buffer state by the global watermark; a degraded stream's
+        # stalled watermark is excluded from the min (its book ticks flow
+        # through on ghosts, so a long feed outage must not pin every
+        # OTHER buffer's memory at the outage start)
+        degraded = set(self.degraded_streams())
         horizon = min(
-            (b.watermark(fc.watermark_s) for b in self._side_streams.values()),
-            default=-1,
+            (b.watermark(fc.watermark_s)
+             for t, b in self._side_streams.items() if t not in degraded),
+            default=(
+                self._max_deep_ts - fc.watermark_s
+                if degraded else -1
+            ),
         )
         if horizon > 0:
             for buf in self._side_streams.values():
@@ -575,6 +742,9 @@ class StreamEngine:
                 cutoff = horizon - 2 * fc.join_tolerance_s
                 self._landed_ts = {
                     t for t in self._landed_ts if to_epoch(t) >= cutoff
+                }
+                self._degraded_ts = {
+                    t for t in self._degraded_ts if to_epoch(t) >= cutoff
                 }
 
         if self.checkpoint_path:
@@ -604,7 +774,9 @@ class StreamEngine:
         )
 
     def _join_native(
-        self, row_traces: Optional[Dict[str, str]] = None
+        self,
+        row_traces: Optional[Dict[str, str]] = None,
+        row_degraded: Optional[Dict[str, List[str]]] = None,
     ) -> Tuple[List[Dict[str, float]], List[_Event]]:
         """Join decisions from the C++ scheduler; payload assembly here."""
         from collections import defaultdict
@@ -625,9 +797,15 @@ class StreamEngine:
             deep_ev = by_ts[tup[0]].pop(0)
             row: Dict[str, float] = {"Timestamp": deep_ev.ts_str}
             row.update(deep_ev.payload)
+            ghost_topics = []
             for i, topic in enumerate(self._stream_topics):
-                row.update(self._find_side_event(topic, tup[1 + i]).payload)
+                m = self._find_side_event(topic, tup[1 + i])
+                row.update(m.payload)
+                if m.degraded:
+                    ghost_topics.append(topic)
             rows.append(row)
+            if ghost_topics and row_degraded is not None:
+                row_degraded[deep_ev.ts_str] = ghost_topics
             if row_traces is not None and deep_ev.trace is not None:
                 row_traces[deep_ev.ts_str] = deep_ev.trace
         still_pending = [
@@ -671,7 +849,18 @@ class StreamEngine:
             "pending": len(self._pending_deep),
             "consumer_lag": lag,
             "watermark_age_s": ages,
+            "degraded_rows": dict(self._degraded_rows),
+            "degraded_streams": list(self.degraded_streams()),
+            "checkpoint_corrupt": self._checkpoint_corrupt,
         }
+
+    @property
+    def degraded_row_timestamps(self) -> Tuple[str, ...]:
+        """Timestamps of rows that landed with ghost features (bounded:
+        pruned with the landed-dedupe set).  Chaos harnesses use this to
+        exclude degraded rows from bit-identity comparisons; operators
+        use it to audit what a feed outage actually touched."""
+        return tuple(sorted(self._degraded_ts))
 
     # -- checkpoint / resume -------------------------------------------------
 
@@ -695,6 +884,8 @@ class StreamEngine:
             d = {"ts": e.ts, "ts_str": e.ts_str, "payload": e.payload}
             if e.trace is not None:  # keep checkpoints small when untraced
                 d["trace"] = e.trace
+            if e.degraded:
+                d["degraded"] = True
             return d
 
         state = {
@@ -702,10 +893,14 @@ class StreamEngine:
             "emitted": self._emitted,
             "dropped": self._dropped,
             "max_deep_ts": self._max_deep_ts,
+            "first_deep_ts": self._first_deep_ts,
+            "degraded_rows": self._degraded_rows,
+            "degraded_ts": sorted(self._degraded_ts),
             "pending_deep": [dump_event(e) for e in self._pending_deep],
             "buffers": {
                 t: {
                     "max_ts": b.max_ts,
+                    "last_payload": b.last_payload,
                     "events": [dump_event(e) for e in b.events],
                 }
                 for t, b in self._side_streams.items()
@@ -719,21 +914,61 @@ class StreamEngine:
         self._dirty = False
 
     def restore(self) -> None:
-        with open(self.checkpoint_path) as fh:
-            state = json.load(fh)
+        """Rebuild engine state from the checkpoint file.
+
+        A corrupt or truncated checkpoint (a kill mid-write on a
+        filesystem without atomic replace, disk trouble, a foreign
+        writer) is survived as a *counted fresh start*: the bad file is
+        moved aside to ``<path>.corrupt`` (forensics), the
+        ``checkpoint_corrupt`` counter increments, and the engine keeps
+        its fresh construction-time state — consumers replay from offset
+        0 and the landed-tick dedupe makes the re-landing idempotent, so
+        the cost is replay work, never duplicated rows.  The state dict
+        is parsed *fully* before any of it is applied: a checkpoint that
+        fails halfway through validation cannot leave the engine
+        half-restored (offsets moved, buffers not).
+        """
 
         def load_event(d: dict) -> _Event:
-            return _Event(d["ts"], d["ts_str"], d["payload"],
-                          trace=d.get("trace"))
+            return _Event(int(d["ts"]), d["ts_str"], dict(d["payload"]),
+                          trace=d.get("trace"),
+                          degraded=bool(d.get("degraded", False)))
 
-        for topic, offset in state["offsets"].items():
+        try:
+            with open(self.checkpoint_path) as fh:
+                state = json.load(fh)
+            offsets = {t: int(o) for t, o in state["offsets"].items()}
+            pending = [load_event(d)
+                       for d in state.get("pending_deep", [])]
+            buffers = {
+                topic: (int(dump["max_ts"]), dump.get("last_payload"),
+                        [load_event(d) for d in dump["events"]])
+                for topic, dump in state.get("buffers", {}).items()
+            }
+        except (OSError, json.JSONDecodeError, KeyError, TypeError,
+                ValueError, AttributeError) as e:
+            self._checkpoint_corrupt += 1
+            log.warning(
+                "corrupt/truncated checkpoint %s (%s): counted fresh "
+                "start — bus replay + landed-tick dedupe make this "
+                "exact, not lossy", self.checkpoint_path, e)
+            try:
+                os.replace(self.checkpoint_path,
+                           f"{self.checkpoint_path}.corrupt")
+            except OSError:
+                pass  # already gone / unwritable dir: nothing to keep
+            return
+
+        for topic, offset in offsets.items():
             if topic in self._consumers:
                 self._consumers[topic].seek(offset)
         self._emitted = state.get("emitted", 0)
         self._dropped = state.get("dropped", 0)
-        self._pending_deep = [
-            load_event(d) for d in state.get("pending_deep", [])
-        ]
+        for topic, n in state.get("degraded_rows", {}).items():
+            if topic in self._degraded_rows:
+                self._degraded_rows[topic] = int(n)
+        self._degraded_ts = set(state.get("degraded_ts", ()))
+        self._pending_deep = pending
         # the join loop trusts sorted order; make the invariant
         # self-establishing for checkpoints from any writer
         self._pending_deep.sort(key=lambda e: e.ts)
@@ -742,18 +977,27 @@ class StreamEngine:
         # otherwise restore with no age signal until the next tick);
         # older checkpoints fall back to the newest still-pending tick
         self._max_deep_ts = state.get("max_deep_ts", self._max_deep_ts)
+        self._first_deep_ts = state.get(
+            "first_deep_ts", self._first_deep_ts)
         if self._pending_deep:
             self._max_deep_ts = max(
                 self._max_deep_ts, self._pending_deep[-1].ts)
-        for topic, dump in state.get("buffers", {}).items():
+        for topic, (max_ts, last_payload, events) in buffers.items():
             if topic in self._side_streams:
                 buf = self._side_streams[topic]
                 buf.buckets = {}
-                for d in dump["events"]:
-                    buf.add(load_event(d))
+                for e in events:
+                    if e.degraded:  # ghosts must not touch the watermark
+                        buf.add_ghost(e)
+                    else:
+                        buf.add(e)
                 # the watermark can be ahead of any buffered event (post-
-                # eviction); restore it exactly
-                buf.max_ts = dump["max_ts"]
+                # eviction); restore it exactly.  Same for last_payload —
+                # the newest real event may long be evicted (older
+                # checkpoints lack the field: keep what add() derived).
+                buf.max_ts = max_ts
+                if last_payload is not None:
+                    buf.last_payload = last_payload
         if self._core is not None:
             # mirror the restored state into a FRESH C++ scheduler (the
             # Python side fully reset above; appending to a used core

@@ -1,0 +1,305 @@
+"""Write-ahead journal: warehouse-outage survival for the landing path.
+
+The engine's contract is "land + signal, never abort" — but the
+reference's only answer to an unreachable store is a crashed consumer
+(spark_consumer.py has no write failure handling at all), and our own
+``Warehouse.insert_rows`` raised straight through the engine step.
+:class:`BufferedWarehouse` puts a bounded, *durable* write-ahead buffer
+in front of any warehouse (embedded SQLite or the MariaDB adapter —
+anything with ``insert_rows``/``has_timestamp``):
+
+- a failed ``insert_rows`` **spills** the rows to a local JSONL journal
+  file (counted, never silent) and reports success to the engine — the
+  row is durable on disk, the signal still fires, serving skips the
+  not-yet-landed row counted (``missing_rows``/``serve_errors``);
+- a **backfill** drain re-lands journaled rows once the store answers
+  again — called from the engine step loop (idle ticks drain too) and
+  from every ``insert_rows`` (ordering: journaled rows are older than
+  the rows being landed, so they go first);
+- landing is **idempotent on timestamp**: every drained row is probed
+  with ``has_timestamp`` before insert, so a crash between the store
+  commit and the journal compaction replays into a counted skip, never
+  a duplicate row;
+- the journal is **bounded**: overflow sheds the oldest rows, counted
+  (``shed_rows``) — same never-silent shedding contract as the fleet
+  gateway queue;
+- a process restart **recovers** the journal from disk (rows are
+  flushed line-by-line; a torn trailing line from a mid-write kill is
+  dropped, counted).
+
+The file is the durability unit: each spilled row is one JSON line,
+flushed immediately; compaction (after drains/sheds) rewrites through
+the ``tmp + os.replace`` idiom so a crash mid-compact keeps the previous
+journal intact.  ``flush()`` is OS-buffer durability (survives process
+death); full fsync-per-row durability would serialize the landing hot
+path on disk latency for a failure mode (kernel panic in the spill
+window) the timestamp-idempotent replay already absorbs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+log = logging.getLogger("fmda_tpu.stream")
+
+
+class BufferedWarehouse:
+    """Warehouse proxy that journals rows the backing store rejects.
+
+    Implements the full warehouse surface by delegation (``__getattr__``
+    keeps it in lockstep with whatever the backing warehouse grows, the
+    :class:`~fmda_tpu.chaos.wrap.ChaosWarehouse` discipline); the
+    overrides below are exactly the methods whose answers must include
+    journaled-but-unlanded rows so the engine's crash-replay dedupe
+    stays exact across an outage.
+    """
+
+    def __init__(
+        self,
+        inner,
+        journal_path: str,
+        *,
+        bound: int = 65536,
+    ) -> None:
+        self._inner = inner
+        self._path = journal_path
+        self._bound = max(1, int(bound))
+        # guards the pending list/set, the counters, and the file handle
+        self._lock = threading.Lock()
+        self._pending: List[Dict[str, float]] = []
+        self._pending_ts: set = set()
+        self._counters: Dict[str, int] = {
+            "spilled_rows": 0,
+            "backfilled_rows": 0,
+            "shed_rows": 0,
+            "dedupe_skipped": 0,
+            "drain_failures": 0,
+            "poison_rows": 0,
+            "recovered_rows": 0,
+            "corrupt_lines": 0,
+        }
+        self._fh = None
+        with self._lock:
+            self._recover_locked()
+
+    # -- journal mechanics (callers hold self._lock) -------------------------
+
+    def _recover_locked(self) -> None:
+        """Load a journal left behind by a previous incarnation."""
+        if not os.path.exists(self._path):
+            return
+        rows: List[Dict[str, float]] = []
+        with open(self._path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    # a torn trailing line from a mid-write kill; the
+                    # row re-lands from bus replay through the dedupe
+                    self._counters["corrupt_lines"] += 1
+        if len(rows) > self._bound:
+            self._counters["shed_rows"] += len(rows) - self._bound
+            rows = rows[-self._bound:]
+        self._pending = rows
+        self._pending_ts = {r.get("Timestamp") for r in rows}
+        self._counters["recovered_rows"] += len(rows)
+        if rows:
+            log.warning(
+                "recovered %d journaled row(s) from %s; backfill will "
+                "drain them once the store answers", len(rows), self._path)
+        # compact unconditionally: torn/shed lines must not survive on
+        # disk to be re-parsed (and re-counted) by every incarnation
+        self._rewrite_locked()
+
+    def _handle_locked(self):
+        if self._fh is None:
+            self._fh = open(self._path, "a")
+        return self._fh
+
+    def _rewrite_locked(self) -> None:
+        """Compact the journal file to exactly the pending rows (tmp +
+        atomic replace: a crash mid-compact keeps the previous file)."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        tmp = f"{self._path}.tmp"
+        with open(tmp, "w") as fh:
+            for row in self._pending:
+                fh.write(json.dumps(row) + "\n")
+        os.replace(tmp, self._path)
+
+    def _spill_locked(self, rows: Sequence[Dict[str, float]],
+                      reason: str) -> int:
+        fh = self._handle_locked()
+        for row in rows:
+            fh.write(json.dumps(row) + "\n")
+        fh.flush()
+        self._pending.extend(dict(r) for r in rows)
+        self._pending_ts.update(r.get("Timestamp") for r in rows)
+        self._counters["spilled_rows"] += len(rows)
+        overflow = len(self._pending) - self._bound
+        if overflow > 0:
+            shed = self._pending[:overflow]
+            self._pending = self._pending[overflow:]
+            self._pending_ts = {
+                r.get("Timestamp") for r in self._pending}
+            self._counters["shed_rows"] += len(shed)
+            log.warning(
+                "journal overflow: shed %d oldest row(s) (bound %d)",
+                len(shed), self._bound)
+            self._rewrite_locked()
+        log.warning(
+            "warehouse append failed (%s): %d row(s) journaled to %s "
+            "(%d pending)", reason, len(rows), self._path,
+            len(self._pending))
+        return len(rows)
+
+    # -- the landing path ----------------------------------------------------
+
+    def insert_rows(self, rows: Sequence[Dict[str, float]]) -> int:
+        """Land rows, spilling to the journal when the store refuses.
+
+        Returns the row count either way — from the engine's point of
+        view the rows are durably accepted; whether they are in the
+        store or the journal is visible in :meth:`journal_stats`, the
+        ``warehouse_journal`` health check, and the logs, never in an
+        exception on the landing hot path."""
+        rows = list(rows)
+        if not rows:
+            return 0
+        self.drain_journal()
+        with self._lock:
+            if self._pending:
+                # the store is still down (drain left rows behind):
+                # journal the new rows too, preserving landing order
+                return self._spill_locked(rows, "store still down")
+        try:
+            return self._inner.insert_rows(rows)
+        except (KeyError, ValueError, TypeError, IndexError):
+            # programming-shaped failures (unknown columns, bad row
+            # dicts) must stay loud — journaling them would retry a bug
+            # forever
+            raise
+        except Exception as e:  # noqa: BLE001 — transport/store-shaped
+            # failure (ConnectionError incl. injected ChaosFault,
+            # sqlite3/mysql errors, closed handles): the outage the
+            # journal exists for
+            with self._lock:
+                return self._spill_locked(rows, repr(e))
+
+    def drain_journal(self, max_rows: Optional[int] = None) -> int:
+        """Re-land journaled rows; returns how many landed.
+
+        Never raises: a store still down leaves the remaining rows in
+        the journal (counted ``drain_failures``).  Each row is probed
+        with the store's ``has_timestamp`` first, so replay after a
+        crash between commit and compaction skips counted instead of
+        double-landing.  A row the store rejects for a *data-shaped*
+        reason (bad columns/values — rows spill before the store ever
+        validated them) is dropped and counted (``poison_rows``) with
+        an error log: retrying a poison row forever would wedge every
+        future landing into the journal behind it.
+        """
+        with self._lock:
+            if not self._pending:
+                return 0
+            batch = list(self._pending if max_rows is None
+                         else self._pending[:max_rows])
+        landed = 0
+        skipped = 0
+        poisoned = 0
+        done = 0  # rows settled (landed/deduped/poisoned), in order
+        failure = None
+        for row in batch:
+            ts = row.get("Timestamp")
+            try:
+                if ts is not None and self._inner.has_timestamp(ts):
+                    skipped += 1
+                elif self._inner.insert_rows([row]):
+                    landed += 1
+            except (KeyError, ValueError, TypeError, IndexError) as e:
+                poisoned += 1
+                log.error(
+                    "journaled row %s is unlandable (%r): dropped "
+                    "(poison_rows)", ts, e)
+            except Exception as e:  # noqa: BLE001 — still down: keep
+                # this row and everything after it
+                failure = e
+                break
+            done += 1
+        with self._lock:
+            self._pending = self._pending[done:]
+            self._pending_ts = {
+                r.get("Timestamp") for r in self._pending}
+            self._counters["backfilled_rows"] += landed
+            self._counters["dedupe_skipped"] += skipped
+            self._counters["poison_rows"] += poisoned
+            if failure is not None:
+                self._counters["drain_failures"] += 1
+            if done:
+                self._rewrite_locked()
+            remaining = len(self._pending)
+        if failure is not None:
+            log.warning(
+                "journal drain stopped (%r): %d row(s) still pending",
+                failure, remaining)
+        if done:
+            log.warning(
+                "journal backfill: %d row(s) landed, %d deduped, %d "
+                "poisoned, %d still pending", landed, skipped, poisoned,
+                remaining)
+        return landed
+
+    # -- dedupe-exactness overrides ------------------------------------------
+
+    def has_timestamp(self, ts: str) -> bool:
+        """True when the row is in the store OR the journal — the
+        engine's crash-replay dedupe must treat a journaled row as
+        landed, or replay would spill a duplicate copy."""
+        with self._lock:
+            if ts in self._pending_ts:
+                return True
+        return bool(self._inner.has_timestamp(ts))
+
+    def recent_timestamps(self, limit: int) -> List[str]:
+        """Store tail plus the journal tail, so a restarted engine's
+        landed-tick seed covers rows an outage left in the journal."""
+        out = self._inner.recent_timestamps(limit)
+        with self._lock:
+            tail = [r.get("Timestamp") for r in self._pending[-limit:]]
+        return out + [t for t in tail if t is not None]
+
+    # -- observability -------------------------------------------------------
+
+    def journal_stats(self) -> Dict[str, int]:
+        """Counters + current backlog (the ``warehouse_journal`` health
+        check and obs collector read this)."""
+        with self._lock:
+            return {**self._counters, "pending": len(self._pending)}
+
+    @property
+    def journal_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- delegation ----------------------------------------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def __len__(self) -> int:  # dunder lookups bypass __getattr__
+        return len(self._inner)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+        self._inner.close()

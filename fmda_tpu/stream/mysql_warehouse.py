@@ -247,6 +247,17 @@ def join_statement_sql(fc: FeatureConfig, table: str) -> str:
     )
 
 
+def insert_sql(fc: FeatureConfig, table: str) -> str:
+    """Parameterized landing INSERT over the config-generated column set
+    (the write half of the config→schema property: the same
+    ``table_columns()`` order the DDL and the embedded warehouse use, so
+    the engine can land through either backend)."""
+    cols = fc.table_columns()
+    col_list = "Timestamp, " + ", ".join(f"`{c}`" for c in cols)
+    placeholders = ", ".join(["%s"] * (1 + len(cols)))
+    return f"INSERT INTO {table} ({col_list}) VALUES ({placeholders});"
+
+
 # ---------------------------------------------------------------------------
 # Gated client
 # ---------------------------------------------------------------------------
@@ -296,6 +307,52 @@ class MySQLWarehouse:
         self._cursor.execute(
             f"SELECT COUNT(ID) FROM {self.config.table_name}")
         return int(self._cursor.fetchone()[0])
+
+    def insert_rows(self, rows: Sequence[dict]) -> int:
+        """Land joined feature rows — same contract as the embedded
+        Warehouse (unknown keys rejected, missing keys stored as 0), so
+        the engine and the write-ahead journal front either backend."""
+        if not rows:
+            return 0
+        cols = self.features.table_columns()
+        known = frozenset(cols) | {"Timestamp"}
+        values = []
+        for row in rows:
+            if not known.issuperset(row.keys()):
+                unknown = sorted(set(row) - known)
+                raise KeyError(f"unknown feature columns: {unknown}")
+            get = row.get
+            values.append(
+                [get("Timestamp")] + [float(get(c) or 0.0) for c in cols])
+        self._cursor.executemany(
+            insert_sql(self.features, self.config.table_name), values)
+        self._cnx.commit()
+        return len(values)
+
+    def has_timestamp(self, ts: str) -> bool:
+        """Point existence probe (the engine dedupe / journal-drain
+        idempotency hook)."""
+        self._cursor.execute(
+            f"SELECT 1 FROM {self.config.table_name} "
+            "WHERE Timestamp = %s LIMIT 1;", (ts,))
+        return self._cursor.fetchone() is not None
+
+    def recent_timestamps(self, limit: int) -> List[str]:
+        """Newest ``limit`` timestamps (the engine's landed-dedupe seed)."""
+        self._cursor.execute(
+            f"SELECT Timestamp FROM {self.config.table_name} "
+            "ORDER BY ID DESC LIMIT %s;", (int(limit),))
+        return [r[0] for r in self._cursor.fetchall()]
+
+    def healthy(self) -> bool:
+        """Probe that the server still answers — the ``/healthz``
+        warehouse check, same contract as the embedded backend."""
+        try:
+            self._cursor.execute("SELECT 1;")
+            self._cursor.fetchone()
+            return True
+        except Exception:  # noqa: BLE001 — any failure IS the signal
+            return False
 
     def fetch(self, ids: Sequence[int]):
         """Feature rows in the *requested id order* (multi-join row order is

@@ -23,8 +23,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from fmda_tpu.chaos.inject import default_chaos
 from fmda_tpu.config import FeatureConfig, TARGET_COLUMNS, WarehouseConfig
 from fmda_tpu.ops.indicators import build_targets, derived_features
+
+#: chaos injection singleton, captured once at import: a fault window on
+#: ``warehouse.append`` makes every landing raise — the "warehouse is
+#: unreachable" outage the write-ahead journal survives (docs/chaos.md)
+_CHAOS = default_chaos()
 
 
 def _quote(col: str) -> str:
@@ -122,6 +128,11 @@ class Warehouse:
         """Append joined feature rows; unknown keys rejected, missing keys
         stored as 0 (the engine's fillna(0), spark_consumer.py:480).
         Each row dict must carry 'Timestamp'."""
+        if _CHAOS.enabled:
+            # raised BEFORE any DB work, like a connection drop at call
+            # time: nothing partial commits, the caller's spill/journal
+            # path owns the rows
+            _CHAOS.check("warehouse.append")
         if not rows:
             return 0
         cols = self._columns
@@ -200,6 +211,25 @@ class Warehouse:
                 (int(limit),),
             ).fetchall()
         return [r[0] for r in rows]
+
+    def raw_rows_for(self, ts_list: Sequence[str]) -> Dict[str, Tuple]:
+        """Raw landed table values keyed by timestamp (newest row per
+        timestamp), straight from SQL — no derived views, no caches.
+        This is the bit-identity surface chaos soaks compare: a clean
+        row's *landed* bytes must match an unfaulted replay even when a
+        degraded neighbor legitimately shifts the windowed views."""
+        ts_list = list(ts_list)
+        if not ts_list:
+            return {}
+        cols = ", ".join(_quote(c) for c in self._columns)
+        qmarks = ", ".join("?" * len(ts_list))
+        with self._lock:
+            rows = self._conn.execute(
+                f"SELECT Timestamp, {cols} FROM {self.table} "
+                f"WHERE Timestamp IN ({qmarks}) ORDER BY ID",
+                ts_list,
+            ).fetchall()
+        return {r[0]: tuple(r[1:]) for r in rows}
 
     def has_timestamp(self, ts: str) -> bool:
         """Point-indexed existence check — the engine's dedupe fallback

@@ -36,6 +36,11 @@ class FakeServer:
         self.join_rows: Dict[int, Tuple[float, ...]] = {}
         #: id -> (up1, up2, down1, down2)
         self.target_rows: Dict[int, Tuple[float, ...]] = {}
+        #: landed INSERT rows in arrival order: (timestamp, values)
+        self.landed: List[Tuple[str, Tuple[float, ...]]] = []
+        self.commits: int = 0
+        #: set True to make every statement raise (outage simulation)
+        self.down: bool = False
 
     def seed(self, join_rows: Dict[int, Sequence[float]],
              target_rows: Dict[int, Sequence[float]]) -> None:
@@ -58,6 +63,22 @@ class _Cursor:
         s.statements.append(sql)
         stmt = sql.strip()
         upper = stmt.upper()
+        if s.down:
+            raise ConnectionError("fake server down")
+        if upper.startswith("SELECT 1 FROM"):  # has_timestamp probe
+            ts = params[0]
+            self._result = (
+                [(1,)] if any(t == ts for t, _ in s.landed) else [])
+            return
+        if upper == "SELECT 1;":  # health probe
+            self._result = [(1,)]
+            return
+        if upper.startswith("SELECT TIMESTAMP FROM"):  # recent tail
+            if "ORDER BY ID DESC" not in stmt:
+                raise AssertionError("recent_timestamps without ORDER BY")
+            limit = int(params[0])
+            self._result = [(t,) for t, _ in reversed(s.landed)][:limit]
+            return
         if upper.startswith("CREATE DATABASE"):
             s.databases.add(stmt.split()[-1].rstrip(";"))
             return
@@ -101,6 +122,16 @@ class _Cursor:
             )
         self._result = [(i,) + rows[i] for i in sorted(found)]
 
+    def executemany(self, sql: str, rows: Sequence[Sequence]) -> None:
+        s = self._server
+        s.statements.append(sql)
+        if s.down:
+            raise ConnectionError("fake server down")
+        if not sql.strip().upper().startswith("INSERT INTO"):
+            raise AssertionError(f"unexpected executemany: {sql[:80]}")
+        for row in rows:
+            s.landed.append((row[0], tuple(row[1:])))
+
     def fetchone(self) -> Optional[tuple]:
         return self._result[0] if self._result else None
 
@@ -118,6 +149,11 @@ class _Connection:
 
     def cursor(self) -> _Cursor:
         return _Cursor(self._server)
+
+    def commit(self) -> None:
+        if self._server.down:
+            raise ConnectionError("fake server down")
+        self._server.commits += 1
 
     def close(self) -> None:
         pass

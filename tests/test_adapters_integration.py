@@ -189,3 +189,47 @@ def test_mysql_wire_protocol_fixture(mysql_env):
     wh.fetch([2, 1, 3])
     wh.fetch_targets([3])
     _check_fixture("mysql_wire.json", mysql_env.statements)
+
+
+def test_mysql_warehouse_landing_surface(mysql_env):
+    """The write half of the adapter (ISSUE 10): config-generated
+    INSERT, timestamp probe, recent tail, health probe — the surface
+    the engine and the write-ahead journal need to front MariaDB."""
+    from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse
+
+    fc = _small_features()
+    wh = MySQLWarehouse(fc, WarehouseConfig(backend="mysql"))
+    assert wh.healthy()
+    row = {c: 1.0 for c in fc.table_columns()}
+    row["Timestamp"] = "2020-02-07 09:30:00"
+    assert wh.insert_rows([row, {**row, "Timestamp":
+                                 "2020-02-07 09:35:00"}]) == 2
+    assert mysql_env.commits == 1
+    assert wh.has_timestamp("2020-02-07 09:30:00")
+    assert not wh.has_timestamp("1999-01-01 00:00:00")
+    assert wh.recent_timestamps(1) == ["2020-02-07 09:35:00"]
+    with pytest.raises(KeyError, match="unknown feature columns"):
+        wh.insert_rows([{**row, "bogus": 1.0}])
+
+
+def test_journal_fronts_mysql_outage(mysql_env, tmp_path):
+    """BufferedWarehouse over the MariaDB adapter: an outage spills to
+    the journal, recovery backfills — the same contract as the embedded
+    backend (the journal is backend-agnostic by construction)."""
+    from fmda_tpu.stream.journal import BufferedWarehouse
+    from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse
+
+    fc = _small_features()
+    wh = BufferedWarehouse(
+        MySQLWarehouse(fc, WarehouseConfig(backend="mysql")),
+        str(tmp_path / "j.jsonl"))
+    row = {c: 1.0 for c in fc.table_columns()}
+    mysql_env.down = True
+    assert not wh.healthy()
+    assert wh.insert_rows(
+        [{**row, "Timestamp": "2020-02-07 09:30:00"}]) == 1
+    assert wh.journal_pending == 1
+    mysql_env.down = False
+    assert wh.drain_journal() == 1
+    assert wh.journal_pending == 0
+    assert wh.has_timestamp("2020-02-07 09:30:00")

@@ -70,6 +70,7 @@ class TestPhaseRegistry:
             "predictor_fleet_smoke",
             "runtime_multihost_smoke",
             "runtime_chaos_soak",
+            "pipeline_chaos_soak",
             "obs_overhead",
             "trace_overhead",
             "analysis_lint",

@@ -94,6 +94,21 @@ def test_views_narrow_without_volume(fc):
     assert len(select_part.split(", ")) == no_vol.n_features
 
 
+def test_insert_sql_covers_table_columns_in_ddl_order(fc):
+    from fmda_tpu.stream.mysql_warehouse import insert_sql
+
+    sql = insert_sql(fc, "stock_data_joined")
+    assert sql.startswith("INSERT INTO stock_data_joined (Timestamp, ")
+    cols = fc.table_columns()
+    # every schema column present, in DDL order, fully parameterized
+    body = sql[sql.index("(") + 1:sql.index(")")]
+    assert body == "Timestamp, " + ", ".join(f"`{c}`" for c in cols)
+    assert sql.count("%s") == len(cols) + 1
+    # config reshapes the statement like it reshapes the DDL
+    small = dataclasses.replace(fc, get_vix=False)
+    assert "`VIX`" not in insert_sql(small, "t")
+
+
 def test_gated_clients_raise_without_packages():
     from fmda_tpu.stream.kafka_bus import KafkaBus
     from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse

@@ -427,7 +427,7 @@ def test_healthz_ok_then_flips_on_induced_failures():
         health = json.loads(body)
         assert health["status"] == "ok"
         assert set(health["checks"]) == {
-            "bus", "warehouse", "last_tick", "chaos"}
+            "bus", "warehouse", "feed_degraded", "last_tick", "chaos"}
         assert all(c["ok"] for c in health["checks"].values())
 
         # induced bus failure: the transport stops answering

@@ -32,9 +32,63 @@ def test_retry_transport_recovers():
 
     sleeps = []
     t = RetryTransport(Flaky(), attempts=3, backoff_s=0.5,
-                       sleep_fn=sleeps.append)
+                       sleep_fn=sleeps.append, jitter=False)
     assert t.get("http://x") == b"ok"
-    assert sleeps == [0.5, 1.0]  # exponential backoff
+    assert sleeps == [0.5, 1.0]  # exponential backoff (jitter disabled)
+
+
+def test_retry_transport_full_jitter_bounds_and_seeds():
+    """The default backoff is FULL jitter: each delay is uniform in
+    [0, backoff_s * 2^attempt] (synchronized cadence loops must not
+    retry in lockstep against a recovering feed), deterministic under
+    an injected rng."""
+    import random
+
+    class Dead:
+        def get(self, url, headers=None):
+            raise TransportError("down")
+
+    def run(seed):
+        sleeps = []
+        t = RetryTransport(Dead(), attempts=4, backoff_s=0.5,
+                           sleep_fn=sleeps.append,
+                           rng=random.Random(seed))
+        with pytest.raises(TransportError):
+            t.get("http://x")
+        return sleeps
+
+    a, b = run(7), run(7)
+    assert a == b and len(a) == 3  # seeded: reproducible
+    for attempt, delay in enumerate(a):
+        assert 0.0 <= delay <= 0.5 * (2 ** attempt)
+    assert run(8) != a  # actually random, not a constant schedule
+
+
+def test_retry_transport_honors_retry_after_capped():
+    """A 429/503 carrying Retry-After overrides the computed backoff —
+    exactly when small, capped at the schedule's largest backoff when
+    the server asks for a pathological wait; non-rate-limit statuses
+    ignore the header."""
+    class RateLimited:
+        def __init__(self, status, retry_after):
+            self.status, self.retry_after = status, retry_after
+
+        def get(self, url, headers=None):
+            raise TransportError("throttled", status=self.status,
+                                 retry_after_s=self.retry_after)
+
+    def delays(status, retry_after):
+        sleeps = []
+        t = RetryTransport(RateLimited(status, retry_after), attempts=3,
+                           backoff_s=1.0, sleep_fn=sleeps.append)
+        with pytest.raises(TransportError):
+            t.get("http://x")
+        return sleeps
+
+    assert delays(429, 2.5) == [2.5, 2.5]  # honored exactly
+    assert delays(503, 900.0) == [4.0, 4.0]  # capped at backoff*2^(n-1)
+    for d in delays(500, 900.0):  # not a rate-limit status: jittered
+        assert d <= 4.0
 
 
 def test_retry_transport_exhausts():
@@ -114,28 +168,84 @@ def test_rate_limit_transports_share_per_host_state():
     assert _tr._SHARED_LAST == {}
 
 
-def test_live_transport_is_wired_retry_over_ratelimit():
-    """The hardened default the clients/scrapers construct: retries on
-    the outside (so each retry re-passes the rate limiter), stdlib
+def test_live_transport_is_wired_breaker_over_retry_over_ratelimit():
+    """The hardened default the clients/scrapers construct: the circuit
+    breaker outermost (a tripped host skips the whole retry wall),
+    retries inside it (each retry re-passes the rate limiter), stdlib
     transport at the core, and a bounded worst case."""
     from fmda_tpu.ingest.transport import (
-        RateLimitTransport, RetryTransport, UrllibTransport, live_transport)
+        CircuitBreakerTransport, RateLimitTransport, RetryTransport,
+        UrllibTransport, live_transport)
 
-    t = live_transport(attempts=4, backoff_s=0.5, min_interval_s=3.0)
-    assert isinstance(t, RetryTransport)
-    assert t.attempts == 4
-    assert isinstance(t.inner, RateLimitTransport)
-    assert t.inner.min_interval_s == 3.0
-    assert isinstance(t.inner.inner, UrllibTransport)
+    t = live_transport(attempts=4, backoff_s=0.5, min_interval_s=3.0,
+                       breaker_threshold=2, breaker_reset_s=60.0)
+    assert isinstance(t, CircuitBreakerTransport)
+    assert t.failure_threshold == 2 and t.reset_timeout_s == 60.0
+    assert isinstance(t.inner, RetryTransport)
+    assert t.inner.attempts == 4
+    assert isinstance(t.inner.inner, RateLimitTransport)
+    assert t.inner.inner.min_interval_s == 3.0
+    assert isinstance(t.inner.inner.inner, UrllibTransport)
 
 
 def test_clients_default_to_hardened_transport():
     from fmda_tpu.ingest.clients import IEXClient
     from fmda_tpu.ingest.scrapers import VIXScraper
-    from fmda_tpu.ingest.transport import RetryTransport
+    from fmda_tpu.ingest.transport import CircuitBreakerTransport
 
-    assert isinstance(IEXClient("tok").transport, RetryTransport)
-    assert isinstance(VIXScraper().transport, RetryTransport)
+    assert isinstance(IEXClient("tok").transport, CircuitBreakerTransport)
+    assert isinstance(VIXScraper().transport, CircuitBreakerTransport)
+
+
+def test_circuit_breaker_trips_and_half_open_recovers():
+    """N consecutive failures trip a host open (requests short-circuit
+    without touching the inner transport — no ~69s retry wall per
+    cadence tick); after the reset timer one probe goes through: failure
+    re-opens, success closes.  Per-host state: a dead feed never opens
+    the breaker for a healthy one."""
+    from fmda_tpu.ingest.transport import (
+        CircuitBreakerTransport, CircuitOpenError)
+
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+            self.down = True
+
+        def get(self, url, headers=None):
+            self.calls += 1
+            if self.down and "dead.example" in url:
+                raise TransportError("down")
+            return b"ok"
+
+    now = {"t": 100.0}
+    inner = Flaky()
+    t = CircuitBreakerTransport(
+        inner, failure_threshold=2, reset_timeout_s=30.0,
+        clock=lambda: now["t"])
+    for _ in range(2):
+        with pytest.raises(TransportError):
+            t.get("http://dead.example/x")
+    assert t.state("http://dead.example/x") == "open"
+    # open: short-circuits, inner never called
+    calls = inner.calls
+    with pytest.raises(CircuitOpenError):
+        t.get("http://dead.example/x")
+    assert inner.calls == calls
+    # other hosts unaffected
+    assert t.get("http://live.example/y") == b"ok"
+    # timer elapses: the half-open probe fails -> re-open, timer resets
+    now["t"] += 31.0
+    with pytest.raises(TransportError):
+        t.get("http://dead.example/x")
+    assert t.state("http://dead.example") == "open"
+    with pytest.raises(CircuitOpenError):
+        t.get("http://dead.example/x")
+    # next probe succeeds -> closed, traffic flows again
+    now["t"] += 31.0
+    inner.down = False
+    assert t.get("http://dead.example/x") == b"ok"
+    assert t.state("http://dead.example") == "closed"
+    assert t.get("http://dead.example/x") == b"ok"
 
 
 # ----------------------------------------------------------------- races
