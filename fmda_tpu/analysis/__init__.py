@@ -21,6 +21,10 @@ rule id                   severity    contract
                                       the kernel surface
 ``bus-topics``            error       published topic literals are declared
                                       or consumed somewhere
+``hot-path-json``         error       data-plane modules (fleet/, runtime/,
+                                      stream transport) call json only in
+                                      the codec module or at annotated
+                                      control-plane sites
 ``logging-hygiene``       error       no print()/foreign loggers in library
                                       code
 ``span-wall-clock``       error       span code never reads the wall clock
@@ -51,6 +55,7 @@ from fmda_tpu.analysis.engine import (
     run_rules,
     save_baseline,
 )
+from fmda_tpu.analysis.hot_json import HotPathJsonRule
 from fmda_tpu.analysis.hygiene import (
     ChaosGuardRule,
     LoggingHygieneRule,
@@ -80,6 +85,7 @@ __all__ = [
     "BusTopicRule",
     "ChaosGuardRule",
     "CompatRequiredRule",
+    "HotPathJsonRule",
     "JaxApiDriftRule",
     "JitPurityRule",
     "LockDisciplineRule",
@@ -102,6 +108,7 @@ def default_rules(*, drift: bool = True):
         JitPurityRule(),
         BusTopicRule(),
         CompatRequiredRule(),
+        HotPathJsonRule(),
     ]
     if drift:
         rules.append(JaxApiDriftRule())

@@ -86,7 +86,8 @@ class Application:
             from fmda_tpu.stream.journal import BufferedWarehouse
 
             self.warehouse = BufferedWarehouse(
-                self.warehouse, wc.journal_path, bound=wc.journal_bound)
+                self.warehouse, wc.journal_path, bound=wc.journal_bound,
+                fmt=wc.journal_format)
         ec = self.config.engine
         self.engine = StreamEngine(
             self.bus,

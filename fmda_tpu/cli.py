@@ -376,9 +376,23 @@ def _fleet_worker_model(args, cfg):
     return model_cfg, params
 
 
+def _fleet_wire_override(args, cfg):
+    """Fold ``--wire-format`` into cfg.fleet (every serve-fleet role:
+    the rollback switch must work from the command line alone)."""
+    if getattr(args, "wire_format", None):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, fleet=dataclasses.replace(
+                cfg.fleet, wire_format=args.wire_format))
+    return cfg
+
+
 def _fleet_runtime_overrides(args, cfg):
     """Fold the shared serve-fleet batching flags into cfg.runtime."""
     import dataclasses
+
+    cfg = _fleet_wire_override(args, cfg)
 
     bucket_sizes = (tuple(int(b) for b in args.bucket_sizes.split(","))
                     if args.bucket_sizes else None)
@@ -437,7 +451,8 @@ def _cmd_fleet_worker(args) -> int:
     from fmda_tpu.stream.bus import InProcessBus
 
     model_cfg, params = _fleet_worker_model(args, cfg)
-    bus = SocketBus.connect(args.connect)
+    wire_format = cfg.fleet.wire_format
+    bus = SocketBus.connect(args.connect, wire_format=wire_format)
     data_bus = None
     data_server = None
     data_address = None
@@ -447,14 +462,17 @@ def _cmd_fleet_worker(args) -> int:
         # the serving hot loop never crosses a socket
         data_bus = InProcessBus(
             (fleet_worker_topic(args.worker_id), TOPIC_FLEET_PREDICTION))
-        data_server = BusServer(data_bus, host=cfg.fleet.host).start()
+        data_server = BusServer(
+            data_bus, host=cfg.fleet.host,
+            wire_format=wire_format).start()
         data_address = data_server.address
     # split-topology workers re-dial the control bus after a router/
     # broker restart (the data plane is local, serving never stops);
     # shared-bus workers exit cleanly after the grace instead — their
     # whole transport is the one broker
     reconnect = (None if args.shared_bus
-                 else (lambda: SocketBus.connect(args.connect)))
+                 else (lambda: SocketBus.connect(
+                     args.connect, wire_format=wire_format)))
     worker = FleetWorker(
         args.worker_id, bus, model_cfg, params,
         config=cfg.fleet, runtime=cfg.runtime, capacity=args.sessions,
@@ -501,13 +519,14 @@ def _cmd_fleet_broker(args) -> int:
     # every request into multi-ms queueing delay under concurrency —
     # drop it so round-trip latency tracks actual work
     sys.setswitchinterval(0.0005)
-    cfg = _config(args)
+    cfg = _fleet_wire_override(args, _config(args))
     n = args.workers if args.workers is not None else cfg.fleet.n_workers
     worker_ids = [f"{cfg.fleet.worker_prefix}{i}" for i in range(n)]
     topics = tuple(DEFAULT_TOPICS) + fleet_topics(worker_ids)
     bus = _build_local_bus(cfg, topics)
     port = args.listen if args.listen is not None else cfg.fleet.port
-    server = BusServer(bus, host=cfg.fleet.host, port=port).start()
+    server = BusServer(bus, host=cfg.fleet.host, port=port,
+                       wire_format=cfg.fleet.wire_format).start()
     # the one line launchers parse to find the ephemeral port
     print(f"BROKER {server.address}", flush=True)
     deadline = (time.monotonic() + args.duration_s
@@ -533,7 +552,7 @@ def _cmd_fleet_router(args) -> int:
 
     from fmda_tpu.fleet.router import FleetRouter
 
-    cfg = _config(args)
+    cfg = _fleet_wire_override(args, _config(args))
     if args.trace or args.trace_out:
         from fmda_tpu.obs.trace import configure_tracing
 
@@ -542,7 +561,8 @@ def _cmd_fleet_router(args) -> int:
     if args.connect:
         from fmda_tpu.fleet.wire import SocketBus
 
-        bus = SocketBus.connect(args.connect)
+        bus = SocketBus.connect(
+            args.connect, wire_format=cfg.fleet.wire_format)
         fleet_cfg = cfg.fleet
     else:
         import dataclasses
@@ -561,7 +581,8 @@ def _cmd_fleet_router(args) -> int:
             port=args.listen if args.listen is not None
             else cfg.fleet.port)
         server = BusServer(bus, host=fleet_cfg.host,
-                           port=fleet_cfg.port).start()
+                           port=fleet_cfg.port,
+                           wire_format=fleet_cfg.wire_format).start()
         print(f"router bus server on {server.address}; start workers "
               f"with: python -m fmda_tpu serve-fleet --role worker "
               f"--connect {server.address} --worker-id w<N>",
@@ -674,7 +695,7 @@ def _cmd_fleet_local(args) -> int:
     from fmda_tpu.fleet.launcher import launch_local_fleet, spawn_supported
     from fmda_tpu.runtime.loadgen import FleetLoadConfig, run_fleet_load
 
-    cfg = _config(args)
+    cfg = _fleet_wire_override(args, _config(args))
     if not spawn_supported():
         print(json.dumps(
             {"skipped": "subprocess spawn unavailable on this host"}))
@@ -1361,6 +1382,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--connect bus too (an external broker topology, "
                         "e.g. Kafka-shaped) instead of hosting this "
                         "worker's own inbox/results bus")
+    p.add_argument("--wire-format", default=None,
+                   choices=["auto", "binary", "json"],
+                   help="frame encoding on every SocketBus link "
+                        "(overrides [fleet] wire_format; json = the "
+                        "rollback format, docs/multihost.md)")
     p.add_argument("--duration-s", type=float, default=0.0,
                    help="safety-valve runtime bound for --role "
                         "worker/router (0 = until stopped)")

@@ -106,6 +106,13 @@ class WarehouseConfig:
     journal_path: Optional[str] = None
     #: Bound on journaled rows; overflow sheds the oldest, counted.
     journal_bound: int = 65536
+    #: Journal record layout: ``jsonl`` (one JSON line per row — the
+    #: human-inspectable debug format) or ``binary`` (length-prefixed
+    #: packed-column codec frames, fmda_tpu.stream.codec — the same
+    #: layout the binary wire speaks; no text round trip on the landing
+    #: hot path).  Recovery auto-detects per record, so flipping this
+    #: never strands an existing journal.
+    journal_format: str = "jsonl"
     # MySQL parity fields (unused by the sqlite backend)
     user: str = "admin"
     password: str = "admin"
@@ -603,6 +610,13 @@ class FleetTopologyConfig:
     #: unreachable (split topology; reconnect re-hellos with the session
     #: report, which is how a restarted router adopts the sessions).
     control_retry_s: float = 1.0
+    #: Frame encoding on every SocketBus link (docs/multihost.md "Wire
+    #: format v2"): ``auto`` negotiates the binary codec at connect and
+    #: falls back to JSON against a peer that does not speak it (mixed-
+    #: version fleets interoperate); ``binary`` insists (still falls
+    #: back, loudly); ``json`` pins the pre-v2 text frames — the
+    #: rollback switch.
+    wire_format: str = "auto"
 
 
 @dataclass(frozen=True)

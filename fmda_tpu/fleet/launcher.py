@@ -249,8 +249,8 @@ def launch_local_fleet(
 
     topics = tuple(DEFAULT_TOPICS) + fleet_topics(worker_ids)
     bus = _build_local_bus(config, topics)
-    server = BusServer(bus, host=fleet_cfg.host,
-                       port=fleet_cfg.port).start()
+    server = BusServer(bus, host=fleet_cfg.host, port=fleet_cfg.port,
+                       wire_format=fleet_cfg.wire_format).start()
     address = server.address
     procs: List[subprocess.Popen] = []
     worker_argv: Dict[str, List[str]] = {}

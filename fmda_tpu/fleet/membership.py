@@ -182,6 +182,12 @@ class Heartbeater:
             "kind": kind,
             "worker": self.worker_id,
             "capacity": self.capacity,
+            # wire-dialect capability (docs/multihost.md "Wire format
+            # v2"): in broker-mediated topologies the router cannot see
+            # the consumer's age from its own broker link, so every
+            # liveness message declares it — absent (pre-v2 senders)
+            # means v1, and the router lowers that worker's payloads
+            "wire": 2,
             **self.announce,
         }
         if stats is not None:

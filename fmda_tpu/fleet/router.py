@@ -77,9 +77,10 @@ from fmda_tpu.config import (
     TOPIC_FLEET_PREDICTION,
     fleet_worker_topic,
 )
+from fmda_tpu.stream import codec
 from fmda_tpu.fleet.hashring import OwnershipTable
 from fmda_tpu.fleet.membership import GOODBYE, HEARTBEAT, HELLO, MembershipView
-from fmda_tpu.fleet.state import encode_norm, encode_row
+from fmda_tpu.fleet.state import encode_norm, encode_row, to_legacy_msgs
 from fmda_tpu.obs.trace import default_tracer, now_ns
 from fmda_tpu.runtime.metrics import RuntimeMetrics
 
@@ -208,6 +209,12 @@ class FleetRouter:
         #: workers we asked for a session report (takeover) whose answer
         #: is still outstanding — one request in flight per worker
         self._report_pending: set = set()
+        #: wire-dialect capability per worker, from the ``wire`` field
+        #: its liveness messages carry (absent = pre-v2): decides per
+        #: consumer whether outgoing payloads use columnar blocks/raw
+        #: arrays or the pre-v2 shapes — on a shared broker the
+        #: router's own link format says nothing about the consumer
+        self._peer_wire: Dict[str, int] = {}
         #: ``from_end=True`` is the RESTART posture (router failover,
         #: docs/chaos.md): skip the control topic's history — replaying
         #: hours-old hellos would resurrect dead workers at receipt-time
@@ -225,8 +232,9 @@ class FleetRouter:
         if connect_fn is None:
             from fmda_tpu.fleet.wire import SocketBus
 
+            wire_format = self.cfg.wire_format
             connect_fn = lambda addr: SocketBus.connect(  # noqa: E731
-                addr, timeout_s=30.0)
+                addr, timeout_s=30.0, wire_format=wire_format)
         self._connect_fn = connect_fn
 
     # -- membership bootstrap ------------------------------------------------
@@ -506,29 +514,39 @@ class FleetRouter:
                         "offset": link.results_offset,
                         "max_records": None,
                     }
+                    # runs of consecutive ticks leave as columnar
+                    # blocks: one contiguous (B, F) f32 array + one
+                    # i64 seq column per run instead of B dicts —
+                    # encoded once, at the link's negotiated format
+                    # (fmda_tpu.stream.codec).  A link that negotiated
+                    # down to JSON instead gets the full pre-v2
+                    # payload shapes (bare-base64 rows, enveloped
+                    # arrays), so a genuinely old peer still parses.
+                    # Error/requeue paths keep the per-tick `msgs`.
+                    wire_msgs = self._lower_for(
+                        wid, link.bus, msgs, direct=True)
                     if batch is not None:
                         ops = []
-                        if msgs:
+                        if wire_msgs:
                             ops.append({
                                 "op": "publish_many",
                                 "topic": fleet_worker_topic(wid),
-                                "values": msgs,
+                                "values": wire_msgs,
                             })
                         ops.append(read_op)
                         resps = link.bus.batch(ops)
                         for op, resp in zip(ops[:-1], resps[:-1]):
                             if "err" in resp:
                                 self.metrics.count(
-                                    "routed_publish_errors",
-                                    len(op["values"]))
+                                    "routed_publish_errors", len(msgs))
                                 log.error(
                                     "router: publish to %s failed: %s",
                                     wid, resp["err"])
                         link_rows = link.bus.unwrap_op(read_op, resps[-1])
                     else:
-                        if msgs:
+                        if wire_msgs:
                             link.bus.publish_many(
-                                fleet_worker_topic(wid), msgs)
+                                fleet_worker_topic(wid), wire_msgs)
                         link_rows = [
                             (r.offset, r.value) for r in link.bus.read(
                                 self.prediction_topic,
@@ -598,10 +616,12 @@ class FleetRouter:
                 try:
                     with self.metrics.timer.stage("route"):
                         topic = fleet_worker_topic(wid)
+                        wire_msgs = self._lower_for(
+                            wid, self.bus, msgs, direct=False)
                         if publish_many is not None:
-                            publish_many(topic, msgs)
+                            publish_many(topic, wire_msgs)
                         else:
-                            for msg in msgs:
+                            for msg in wire_msgs:
                                 self.bus.publish(topic, msg)
                 except KeyError:
                     self.metrics.count("routed_publish_errors", len(msgs))
@@ -650,6 +670,27 @@ class FleetRouter:
                 self.metrics.count("bus_errors")
                 log.warning("shared-bus results poll failed: %s", e)
         return self._fold_results(rows)
+
+    def _lower_for(
+        self, worker_id: str, bus, msgs: List[dict], *, direct: bool,
+    ) -> List[dict]:
+        """Outgoing batch in the consuming WORKER's wire dialect:
+        columnar tick blocks + raw arrays for v2 peers, the full pre-v2
+        payload shapes (bare-base64 rows, enveloped arrays) otherwise.
+        A JSON-negotiated link always lowers (the ``wire_format=json``
+        rollback must roll the dialect back too, and a pre-v2 direct
+        peer can only ever be on a JSON link).  On a ``direct`` link the
+        transport terminates at the worker, so a binary negotiation
+        proves a v2 peer; on the shared bus the router's own broker
+        link says nothing about the consumer, so the worker's declared
+        capability decides (the ``wire`` field its liveness messages
+        carry — absent means pre-v2)."""
+        if not msgs:
+            return msgs
+        legacy = getattr(bus, "negotiated_format", None) == "json"
+        if not direct:
+            legacy = legacy or self._peer_wire.get(worker_id, 1) < 2
+        return to_legacy_msgs(msgs) if legacy else codec.coalesce_ticks(msgs)
 
     def _ensure_link(self, worker_id: str, address: Optional[str]) -> None:
         """(Re)connect the data-plane link a worker announces."""
@@ -805,6 +846,8 @@ class FleetRouter:
         kind = msg.get("kind")
         if kind in (HELLO, HEARTBEAT, GOODBYE):
             wid = msg.get("worker")
+            if wid:
+                self._peer_wire[wid] = int(msg.get("wire", 1))
             if kind == HELLO:
                 # a session-LESS hello is a fresh process whose data bus
                 # restarts at offset 0 — purge any saved resume position.
@@ -958,7 +1001,7 @@ class FleetRouter:
         if active <= owned:
             return
         self._report_pending.add(worker_id)
-        self._enqueue(worker_id, {"kind": "report_sessions"})
+        self._enqueue(worker_id, {"kind": "report_sessions", "wire": 2})
         self.metrics.count("session_reports_requested")
 
     def request_leave(self, worker_id: Optional[str]) -> None:
@@ -1043,6 +1086,9 @@ class FleetRouter:
             "kind": "drain_session",
             "session": sess.session_id,
             "mig": sess.mig,
+            # v2 requester: the worker may export raw-array state;
+            # absent (a pre-v2 router), it lowers to base64 envelopes
+            "wire": 2,
         })
         self.metrics.count("migrations_started")
 

@@ -2,13 +2,14 @@
 
 Session migration's contract is *bit identity*: a session served on its
 new owner must produce exactly the float stream it would have produced
-unmigrated.  JSON float lists round-trip doubles exactly but are slow
-and 4-5x the size for float32 data, so arrays cross the bus as
-``{"d": dtype, "sh": shape, "b": base64(raw bytes)}`` — raw IEEE bytes,
-no textual re-parse, decoded with ``np.frombuffer``.  The same encoding
-carries every tick's feature row: at fleet tick rates the row codec IS
-the router's hot path, and base64 of 432 raw bytes beats a 108-element
-JSON float list by ~4x in both bytes and CPU.
+unmigrated.  Since the binary data plane (ISSUE 12, :mod:`fmda_tpu
+.stream.codec`) the state export moves **raw arrays**: dtype/shape/raw
+IEEE bytes frames on a binary link, tagged base64 only when a link
+negotiated down to the JSON fallback — either way no float→decimal→
+float round trip, and the encode side is format-independent (the wire
+layer lowers arrays per link at frame time).  The decoders also accept
+the pre-v2 ``{"d", "sh", "b"}`` base64 envelope, so state exported by
+an old peer (or parked in an old router's registry) still imports.
 
 numpy only — this runs in the router process (bus-only host, no jax).
 """
@@ -16,12 +17,53 @@ numpy only — this runs in the router process (bus-only host, no jax).
 from __future__ import annotations
 
 import base64
-from typing import Optional
+from typing import Optional, Union
 
 import numpy as np
 
+WireArray = Union[np.ndarray, dict]
 
-def encode_array(a: np.ndarray) -> dict:
+
+def encode_array(a: np.ndarray) -> np.ndarray:
+    """Array -> wire form: the contiguous array itself.  The transport
+    codec carries it raw (binary links) or tagged base64 (JSON links);
+    in-process buses pass it through untouched."""
+    return np.ascontiguousarray(a)
+
+
+def decode_array(d: WireArray) -> np.ndarray:
+    """Wire form -> array.  Accepts the raw array (v2 wire, possibly a
+    read-only view into a received frame — treat as immutable) and the
+    legacy base64 envelope."""
+    if isinstance(d, np.ndarray):
+        return d
+    a = np.frombuffer(base64.b64decode(d["b"]), dtype=np.dtype(d["d"]))
+    return a.reshape(d["sh"]).copy()  # own the buffer (frombuffer is RO)
+
+
+def encode_row(row: np.ndarray) -> np.ndarray:
+    """A (F,) float32 tick row in wire form (the tick hot path).  The
+    copy makes the outgoing queue own the row — the caller may reuse
+    its buffer the moment submit returns."""
+    return np.array(row, np.float32)
+
+
+def decode_row(wire: Union[np.ndarray, str], n_features: int) -> np.ndarray:
+    """Wire form -> (F,) float32 row; accepts the raw array (v2, a
+    zero-copy view) and the legacy bare-base64 string."""
+    if isinstance(wire, np.ndarray):
+        row = np.asarray(wire, np.float32)
+    else:
+        row = np.frombuffer(base64.b64decode(wire), dtype=np.float32)
+    if row.shape != (n_features,):
+        raise ValueError(
+            f"tick row decodes to shape {row.shape}, expected "
+            f"({n_features},)")
+    return row
+
+
+def legacy_array(a: np.ndarray) -> dict:
+    """Array -> the pre-v2 base64 envelope, bit-exact (raw bytes b64)."""
     a = np.ascontiguousarray(a)
     return {
         "d": a.dtype.str,
@@ -30,25 +72,39 @@ def encode_array(a: np.ndarray) -> dict:
     }
 
 
-def decode_array(d: dict) -> np.ndarray:
-    a = np.frombuffer(base64.b64decode(d["b"]), dtype=np.dtype(d["d"]))
-    return a.reshape(d["sh"]).copy()  # own the buffer (frombuffer is RO)
+def to_legacy(value):
+    """Deep-lower every raw array in a wire value to the pre-v2 base64
+    envelope.  Senders apply this on links that negotiated down to JSON
+    (docs/multihost.md "Wire format v2"): the frame *encoding* already
+    fell back at negotiation, but a genuinely pre-v2 peer also needs
+    the pre-v2 payload *shapes* — v2 decoders accept both, so lowering
+    on every JSON link is safe whatever the peer's age."""
+    if isinstance(value, np.ndarray):
+        return legacy_array(value)
+    if isinstance(value, dict):
+        return {k: to_legacy(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [to_legacy(v) for v in value]
+    return value
 
 
-def encode_row(row: np.ndarray) -> str:
-    """A (F,) float32 tick row as bare base64 (the tick hot path — no
-    dtype/shape envelope; both ends know the schema)."""
-    return base64.b64encode(
-        np.ascontiguousarray(row, np.float32).tobytes()).decode("ascii")
+def legacy_tick(msg: dict) -> dict:
+    """A v2 tick message in pre-v2 form: bare-base64 row (the old
+    ``encode_row`` output — no envelope; both ends know the schema)."""
+    out = dict(msg)
+    out["row"] = base64.b64encode(
+        np.ascontiguousarray(out["row"], np.float32).tobytes()
+    ).decode("ascii")
+    return out
 
 
-def decode_row(b64: str, n_features: int) -> np.ndarray:
-    row = np.frombuffer(base64.b64decode(b64), dtype=np.float32)
-    if row.shape != (n_features,):
-        raise ValueError(
-            f"tick row decodes to shape {row.shape}, expected "
-            f"({n_features},)")
-    return row
+def to_legacy_msgs(msgs) -> list:
+    """Lower a router's outgoing batch for a JSON link: per-tick
+    messages with base64 rows (no columnar blocks — an old worker has
+    no ``tick_block`` handler) and enveloped arrays everywhere else
+    (opens carry norm stats, forwarded migrations carry state)."""
+    return [legacy_tick(m) if m.get("kind") == "tick" else to_legacy(m)
+            for m in msgs]
 
 
 def encode_norm(norm) -> Optional[dict]:

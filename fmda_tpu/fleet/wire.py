@@ -10,7 +10,13 @@ on a socket with :class:`BusServer`; every worker connects a
 contract, so gateways/engines/consumers run unchanged over it.
 
 Framing: every request and response is one length-prefixed frame —
-4-byte big-endian length, then that many bytes of UTF-8 JSON.  A
+4-byte big-endian length, then that many bytes of payload.  Since wire
+format v2 (docs/multihost.md) a payload is either UTF-8 JSON **or** a
+binary codec frame (:mod:`fmda_tpu.stream.codec` — magic-byte-first, so
+every receiver auto-detects per frame); clients negotiate the binary
+format with a ``hello`` op at connect and fall back to JSON against a
+server that does not (or is configured not to) speak it, so old and new
+peers interoperate and ``wire_format=json`` is the rollback switch.  A
 connection's requests are strictly serialized by the client (one lock
 around request→response), and the server handles each connection on its
 own thread against the thread-safe backing bus — so two processes
@@ -19,19 +25,28 @@ monotonic, each process's order is preserved) but never *frames* (a
 torn frame would corrupt every later message on the connection; the
 router↔worker transport contract test asserts both properties).
 
+Error taxonomy (symmetric across formats): **transport** errors —
+socket failures, EOF mid-frame, a length prefix past the frame limit —
+kill the connection (``ConnectionError``); **decode** errors — a
+well-framed payload that is not valid JSON or a valid codec frame —
+surface as :class:`FrameDecodeError`, are counted
+(``frames_malformed_total``), and leave the connection usable: the
+frame was fully consumed, so framing alignment is intact and one
+confused peer's message can no longer kill the link.
+
 No jax anywhere near this module: a router host is a bus-only host.
 """
 
 from __future__ import annotations
 
-import json
 import logging
 import socket
 import struct
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from fmda_tpu.chaos.inject import default_chaos
+from fmda_tpu.stream import codec
 from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
 from fmda_tpu.stream.bus import Consumer, Record
 
@@ -45,30 +60,59 @@ _CHAOS = default_chaos()
 #: large is a bug, not a batch).
 MAX_FRAME_BYTES = 64 * 1024 * 1024
 
+#: ``wire_format`` knob values (config ``[fleet] wire_format``):
+#: ``auto`` negotiates binary and falls back, ``binary`` insists (still
+#: falls back, loudly), ``json`` never negotiates — the rollback and
+#: debug format.
+WIRE_FORMATS = ("auto", "binary", "json")
+
 _LEN = struct.Struct(">I")
 
 
+class FrameDecodeError(RuntimeError):
+    """A well-framed payload that failed to decode (not JSON, not a
+    valid codec frame).  The frame was consumed whole, so the
+    connection's framing alignment is intact — callers treat this as a
+    lost *message* (counted), never a lost *link*."""
+
+
+def _check_wire_format(wire_format: str) -> str:
+    if wire_format not in WIRE_FORMATS:
+        raise ValueError(
+            f"wire_format {wire_format!r} not one of {WIRE_FORMATS}")
+    return wire_format
+
+
 class _FrameIO:
-    """Buffered length-prefixed-JSON framing over one socket.
+    """Buffered length-prefixed framing over one socket.
 
     Receives into a process-side buffer with large ``recv`` calls, so a
     frame costs O(frame/1MB) syscalls instead of one per header/body —
     on sandboxed kernels a syscall runs ~100µs, and syscall count IS the
     transport's latency budget.  One ``sendall`` per outgoing frame.
+
+    Payloads are JSON text or binary codec frames; ``recv_frame``
+    auto-detects per frame (``last_binary`` reports which) and
+    ``counts`` tracks per-format frame totals plus malformed payloads.
     """
 
-    __slots__ = ("sock", "_buf")
+    __slots__ = ("sock", "_buf", "counts", "last_binary")
 
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
         self._buf = bytearray()
+        self.counts: Dict[str, int] = {
+            "binary": 0, "json": 0, "malformed": 0}
+        #: format of the most recently decoded incoming frame
+        self.last_binary = False
 
-    def send_frame(self, obj: object) -> None:
-        payload = json.dumps(obj).encode("utf-8")
+    def send_frame(self, obj: object, *, binary: bool = False) -> None:
+        payload = codec.encode_payload(obj, binary=binary)
         if len(payload) > MAX_FRAME_BYTES:
             raise RuntimeError(
                 f"frame of {len(payload)}B exceeds the {MAX_FRAME_BYTES}B "
                 "transport limit")
+        self.counts["binary" if binary else "json"] += 1
         self.sock.sendall(_LEN.pack(len(payload)) + payload)
 
     def _fill(self, need: int) -> bool:
@@ -90,6 +134,9 @@ class _FrameIO:
             return None
         (length,) = _LEN.unpack(self._buf[:_LEN.size])
         if length > MAX_FRAME_BYTES:
+            # transport-level: the framing itself cannot be trusted
+            # past this point, so unlike a payload decode error this
+            # DOES kill the connection
             raise ConnectionError(
                 f"peer announced a {length}B frame (> {MAX_FRAME_BYTES}B "
                 "limit) — stream corrupt or not speaking this protocol")
@@ -98,7 +145,17 @@ class _FrameIO:
             raise ConnectionError("peer closed between header and body")
         body = bytes(self._buf[_LEN.size:total])
         del self._buf[:total]
-        return json.loads(body)
+        # the frame is consumed whole BEFORE decoding: a malformed
+        # payload costs one message, never the connection's alignment
+        try:
+            obj, was_binary = codec.decode_payload(body)
+        except codec.CodecError as e:
+            self.counts["malformed"] += 1
+            raise FrameDecodeError(
+                f"malformed {length}B frame: {e}") from e
+        self.last_binary = was_binary
+        self.counts["binary" if was_binary else "json"] += 1
+        return obj
 
 
 def parse_address(address: str) -> Tuple[str, int]:
@@ -116,20 +173,33 @@ class BusServer:
     One accept-loop thread plus one thread per connection; every op maps
     1:1 onto the backing bus's method, so the server adds transport, not
     semantics.  Op errors travel back as ``{"err", "kind"}`` frames and
-    re-raise client-side; transport errors drop only the one connection.
+    re-raise client-side; transport errors drop only the one connection;
+    decode errors (a malformed frame from a confused peer) are counted
+    and answered with an error frame — the connection survives.
+
+    Responses mirror the request's format (a binary request gets a
+    binary response) unless ``wire_format="json"`` pins everything to
+    JSON; the ``hello`` op tells negotiating clients which formats this
+    server will answer in.
     """
 
     def __init__(
-        self, bus, *, host: str = "127.0.0.1", port: int = 0
+        self, bus, *, host: str = "127.0.0.1", port: int = 0,
+        wire_format: str = "auto",
     ) -> None:
         self.bus = bus
         self._host = host
         self._requested_port = port
+        self._wire_format = _check_wire_format(wire_format)
         self._listener: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()
+        self._ios: set = set()
         self._lock = threading.Lock()
         self._closing = False
+        #: frame totals folded in from closed connections
+        self._frame_totals: Dict[str, int] = {
+            "binary": 0, "json": 0, "malformed": 0}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -158,6 +228,15 @@ class BusServer:
     def stop(self) -> None:
         self._closing = True
         if self._listener is not None:
+            # shutdown BEFORE close: on Linux, closing an fd does not
+            # wake a thread blocked in accept() on it (stop() used to
+            # eat the full 5s join timeout per server — multiplied
+            # across every test teardown and topology shutdown);
+            # shutdown interrupts the accept with an error immediately
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # some platforms refuse shutdown on a listener
             try:
                 self._listener.close()
             except OSError:
@@ -176,6 +255,18 @@ class BusServer:
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5.0)
 
+    def frame_stats(self) -> Dict[str, int]:
+        """Frame totals across every connection this server ever had
+        (live connections sampled in place) — ``binary``/``json``/
+        ``malformed``, the server side of the obs counters."""
+        with self._lock:
+            out = dict(self._frame_totals)
+            ios = list(self._ios)
+        for io in ios:
+            for k, v in io.counts.items():
+                out[k] += v
+        return out
+
     # -- the serve loops ----------------------------------------------------
 
     def _accept_loop(self) -> None:
@@ -193,22 +284,52 @@ class BusServer:
 
     def _serve_client(self, conn: socket.socket) -> None:
         io = _FrameIO(conn)
+        with self._lock:
+            self._ios.add(io)
         try:
             while True:
                 try:
                     req = io.recv_frame()
-                except (ConnectionError, OSError, json.JSONDecodeError):
+                except FrameDecodeError as e:
+                    # one malformed frame from a confused peer used to
+                    # kill the whole link (it was caught with the
+                    # transport errors); decode errors are now counted
+                    # and answered — the connection survives
+                    log.warning("malformed frame (connection kept): %s", e)
+                    try:
+                        io.send_frame({"err": str(e),
+                                       "kind": "FrameDecodeError"})
+                    except (OSError, RuntimeError):
+                        return
+                    continue
+                except (ConnectionError, OSError):
                     return
                 if req is None:
                     return  # clean disconnect
+                # respond in the request's format: binary for binary
+                # peers, JSON for JSON peers and hand-crafted debug
+                # frames — unless this server is pinned to JSON
+                binary = io.last_binary and self._wire_format != "json"
                 resp = self._respond(req)
                 try:
-                    io.send_frame(resp)
+                    io.send_frame(resp, binary=binary)
+                except codec.CodecError:
+                    # a response value the negotiated format cannot
+                    # carry — answer with an error frame instead of
+                    # killing the link
+                    try:
+                        io.send_frame({"err": "unencodable response",
+                                       "kind": "FrameDecodeError"})
+                    except (OSError, RuntimeError):
+                        return
                 except (OSError, RuntimeError):
                     return
         finally:
             with self._lock:
                 self._conns.discard(conn)
+                self._ios.discard(io)
+                for k, v in io.counts.items():
+                    self._frame_totals[k] += v
             try:
                 conn.close()
             except OSError:
@@ -257,6 +378,15 @@ class BusServer:
             return list(bus.topics())
         if op == "ping":
             return "pong"
+        if op == "hello":
+            # wire-format negotiation (v2): the client lists the formats
+            # it speaks; the server picks.  Old servers answer this op
+            # with an unknown-op error, which the client reads as "JSON
+            # only" — old and new peers interoperate either way.
+            formats = req.get("formats") or ()
+            chosen = ("binary" if self._wire_format != "json"
+                      and "binary" in formats else "json")
+            return {"format": chosen, "version": codec.CODEC_VERSION}
         raise RuntimeError(f"unknown bus op {op!r}")
 
 
@@ -270,11 +400,20 @@ class SocketBus:
     serializes frames on the connection.  No auto-reconnect — a broken
     connection raises, and the owner (worker loop) decides whether that
     is fatal (it is: a worker that lost its router must stop serving).
+
+    ``wire_format`` selects the frame encoding: ``auto`` (default)
+    negotiates the binary codec via a ``hello`` op and falls back to
+    JSON against a server that does not offer it; ``binary`` does the
+    same but logs the fallback as a warning; ``json`` skips negotiation
+    entirely (the rollback switch — docs/multihost.md "Wire format v2").
+    ``negotiated_format`` reports the outcome.
     """
 
     def __init__(
-        self, host: str, port: int, *, timeout_s: Optional[float] = 60.0
+        self, host: str, port: int, *, timeout_s: Optional[float] = 60.0,
+        wire_format: str = "auto",
     ) -> None:
+        wire_format = _check_wire_format(wire_format)
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._io = _FrameIO(self._sock)
@@ -283,11 +422,39 @@ class SocketBus:
         self._publish_counters = None
         self._consumed_cb = None
         self.address = f"{host}:{port}"
+        self._binary = False
+        self.negotiated_format = "json"
+        if wire_format != "json":
+            self._negotiate(wire_format)
 
     @classmethod
     def connect(cls, address: str, **kwargs) -> "SocketBus":
         host, port = parse_address(address)
         return cls(host, port, **kwargs)
+
+    def _negotiate(self, wire_format: str) -> None:
+        """One ``hello`` round trip at connect: switch the connection to
+        binary frames when the server offers them, JSON otherwise.
+        Transport failures propagate (the connection is unusable); an
+        op-level error means an old server — fall back silently on
+        ``auto``, loudly on ``binary``."""
+        try:
+            resp = self._request({
+                "op": "hello",
+                "formats": ["binary", "json"],
+                "version": codec.CODEC_VERSION,
+            })
+        except (ConnectionError, OSError):
+            raise
+        except (RuntimeError, KeyError):
+            resp = None  # pre-v2 server: unknown op
+        if isinstance(resp, dict) and resp.get("format") == "binary":
+            self._binary = True
+            self.negotiated_format = "binary"
+        elif wire_format == "binary":
+            log.warning(
+                "bus server at %s does not speak the binary wire format "
+                "— falling back to JSON frames", self.address)
 
     def close(self) -> None:
         with self._lock:
@@ -302,9 +469,22 @@ class SocketBus:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def frame_stats(self) -> Dict[str, int]:
+        """This connection's ``binary``/``json``/``malformed`` frame
+        totals (the client side of the obs counters)."""
+        return dict(self._io.counts)
+
     def bind_metrics(self, registry) -> None:
         """Same per-topic publish/consume counters as the other
-        backends, counted client-side."""
+        backends, counted client-side, plus the wire-format series:
+        ``frames_binary_total``/``frames_json_total``/
+        ``frames_malformed_total`` and the negotiated-format gauge
+        ``wire_format_binary`` (1 = binary frames on this link)."""
+        #: remembered so the owner can re-bind a REPLACEMENT connection
+        #: to the same registry (worker control re-dial): the "wire"
+        #: collector registration replaces the old one by name, so the
+        #: series follow the live link instead of freezing on the dead
+        self.metrics_registry = registry
         topics = self.topics()
         self._publish_counters = {
             t: registry.counter("bus_published_total", topic=t)
@@ -318,6 +498,25 @@ class SocketBus:
             lambda topic, n: consume_counters[topic].inc(n)
         )
 
+        def wire_families():
+            counts = self.frame_stats()
+            return {
+                "counters": [
+                    {"name": "frames_binary_total", "labels": {},
+                     "value": counts["binary"]},
+                    {"name": "frames_json_total", "labels": {},
+                     "value": counts["json"]},
+                    {"name": "frames_malformed_total", "labels": {},
+                     "value": counts["malformed"]},
+                ],
+                "gauges": [
+                    {"name": "wire_format_binary", "labels": {},
+                     "value": 1.0 if self._binary else 0.0},
+                ],
+            }
+
+        registry.register_collector("wire", wire_families)
+
     # -- request plumbing ---------------------------------------------------
 
     def _request(self, req: dict) -> object:
@@ -328,7 +527,7 @@ class SocketBus:
             _CHAOS.check("wire.request")
         with self._lock:
             try:
-                self._io.send_frame(req)
+                self._io.send_frame(req, binary=self._binary)
                 resp = self._io.recv_frame()
             except OSError as e:
                 raise ConnectionError(
@@ -432,7 +631,9 @@ class BufferedPublisher:
     call order) and the worker's step flushes everything — plus its
     inbox read — in one batched frame.  Same ``publish``/
     ``publish_many``/``topics`` surface the gateway already speaks, so
-    it drops in unchanged.
+    it drops in unchanged.  Values are queued as-is — pre-encoded
+    column blocks and raw arrays included — and encoded exactly once,
+    when the batched frame leaves on the negotiated wire format.
     """
 
     def __init__(self, bus: SocketBus) -> None:

@@ -29,6 +29,7 @@ from fmda_tpu.config import (
     TOPIC_FLEET_CONTROL,
     fleet_worker_topic,
 )
+from fmda_tpu.stream import codec
 from fmda_tpu.fleet.membership import Heartbeater
 from fmda_tpu.fleet.state import (
     decode_norm,
@@ -36,6 +37,7 @@ from fmda_tpu.fleet.state import (
     decode_session_state,
     encode_array,
     encode_session_state,
+    to_legacy,
 )
 from fmda_tpu.runtime.batcher import BatcherConfig
 from fmda_tpu.runtime.gateway import FleetGateway
@@ -145,11 +147,12 @@ class FleetWorker:
         #: is how a restarted router adopts this worker's sessions
         self._control_down = False
         #: migrations whose exported state never left this process
-        #: (control publish failed): session -> mig id, re-drained and
-        #: re-exported once the control plane answers again — without
-        #: this the router would wait on a ``session_state`` that is
-        #: never coming and the session would buffer forever
-        self._failed_drains: Dict[str, Optional[str]] = {}
+        #: (control publish failed): session -> (mig id, requester wire
+        #: capability), re-drained and re-exported once the control
+        #: plane answers again — without this the router would wait on
+        #: a ``session_state`` that is never coming and the session
+        #: would buffer forever
+        self._failed_drains: Dict[str, tuple] = {}
         self._last_reconnect: float = float("-inf")
         self._first_bus_error: Optional[float] = None
         if precompile:
@@ -183,7 +186,21 @@ class FleetWorker:
         self.heartbeater.hello(
             self.stats(), extra={"sessions": report} if report else None)
 
-    def session_report(self) -> Dict[str, dict]:
+    def _control_is_json(self) -> bool:
+        """Did the control link negotiate down to the JSON fallback?
+        Then array payloads this worker exports (session reports,
+        migrated state) are lowered to the pre-v2 base64 envelopes too
+        — the peer may genuinely predate the raw-array shapes.  In-
+        process buses have no negotiation: same-code peers, full v2.
+        Router-originated requests additionally declare their own
+        capability in a ``wire`` field (broker-mediated topologies:
+        this link's format says nothing about the router's age) — the
+        request handlers check both signals."""
+        return getattr(self.bus, "negotiated_format", None) == "json"
+
+    def session_report(
+        self, *, legacy: Optional[bool] = None
+    ) -> Dict[str, dict]:
         """Authoritative open-session map: id → next result ``seq`` +
         normalization stats (wire form).  This is what router failover
         rebuilds the session registry from — the workers, not the dead
@@ -199,6 +216,10 @@ class FleetWorker:
                     "x_max": encode_array(x_min + x_range),
                 },
             }
+        if legacy is None:
+            legacy = self._control_is_json()
+        if out and legacy:
+            out = to_legacy(out)
         return out
 
     def stats(self) -> Dict[str, object]:
@@ -306,6 +327,18 @@ class FleetWorker:
         # lost its one broker exits after the grace instead (run loop)
         self._pub = new_bus
         self.heartbeater.bus = new_bus
+        # re-bind the obs series to the LIVE link: without this the
+        # registry's wire collector keeps sampling the dead SocketBus
+        # (frozen frames_*_total, stale wire_format_binary) and the new
+        # link's publishes go uncounted
+        registry = getattr(old, "metrics_registry", None)
+        if registry is not None:
+            bind = getattr(new_bus, "bind_metrics", None)
+            if bind is not None:
+                try:
+                    bind(registry)
+                except (ConnectionError, OSError):
+                    pass  # metrics must never turn a reconnect fatal
         self._control_down = False
         self.metrics.count("control_reconnects")
         log.info("worker %s: control plane reconnected", self.worker_id)
@@ -438,6 +471,8 @@ class FleetWorker:
         kind = msg.get("kind")
         if kind == "tick":
             self._on_tick(msg)
+        elif kind == "tick_block":
+            self._on_tick_block(msg)
         elif kind == "open":
             self._on_open(msg)
         elif kind == "close":
@@ -446,11 +481,15 @@ class FleetWorker:
             self._on_drain_session(msg)
         elif kind == "report_sessions":
             # a router that restarted mid-serve asks for the session map
-            # it lost; the reply is the same shape the hello carries
+            # it lost; the reply is the same shape the hello carries —
+            # lowered to pre-v2 envelopes unless the REQUEST declared a
+            # v2 requester (the link format only describes the broker)
             self._publish_control_counted({
                 "kind": "session_report",
                 "worker": self.worker_id,
-                "sessions": self.session_report(),
+                "sessions": self.session_report(
+                    legacy=(self._control_is_json()
+                            or int(msg.get("wire", 1)) < 2)),
             })
             self.metrics.count("session_reports")
         elif kind == "leave":
@@ -527,21 +566,32 @@ class FleetWorker:
             })
 
     def _on_tick(self, msg: dict) -> None:
-        sid = msg["session"]
+        self._submit_tick(
+            msg["session"], msg["row"], msg.get("seq"), msg.get("trace"))
+
+    def _on_tick_block(self, msg: dict) -> None:
+        """A columnar run of ticks (fmda_tpu.stream.codec): the rows
+        arrive as ONE contiguous (B, F) float32 array — on a binary
+        link a zero-copy view into the received frame — and each tick's
+        staging copy in :meth:`FleetGateway.submit` is the first copy
+        the row ever pays on this host."""
+        for sid, row, seq, trace in codec.iter_ticks(msg):
+            self._submit_tick(sid, row, seq, trace)
+
+    def _submit_tick(self, sid: str, row_wire, seq, trace) -> None:
         if self.pool.handle_for(sid) is None:
             # close/tick race or an open that failed: visible skip
             self.metrics.count("ticks_for_unknown_session")
             return
-        row = decode_row(msg["row"], self.pool.cfg.n_features)
+        row = decode_row(row_wire, self.pool.cfg.n_features)
         if self.gateway.saturated:
             # well-behaved consumer: serve the backlog instead of
             # racing the gateway's shedder (no tick is ever dropped on
             # the floor by the worker itself)
             self.gateway.pump(force=True)
             self.metrics.count("forced_pumps")
-        expected = msg.get("seq")
-        if (expected is not None
-                and self.gateway.session_seq(sid) != expected):
+        if (seq is not None
+                and self.gateway.session_seq(sid) != seq):
             # the streams diverged — ticks were lost in transit (a
             # partitioned link's frame, counted router-side).  Resync
             # to the router's counter: without this, every later
@@ -550,8 +600,8 @@ class FleetWorker:
             # results_missing and the stream re-aligns.  Counted —
             # divergence is a failure event, never silent.
             self.metrics.count("seq_resyncs")
-            self.gateway.resync_seq(sid, int(expected))
-        self.gateway.submit(sid, row, wire=msg.get("trace"))
+            self.gateway.resync_seq(sid, int(seq))
+        self.gateway.submit(sid, row, wire=trace)
 
     def _on_close(self, msg: dict) -> None:
         sid = msg["session"]
@@ -578,6 +628,8 @@ class FleetWorker:
         # current and every pre-drain result is published
         self.gateway.drain()
         state = encode_session_state(self.gateway.export_session(sid))
+        if self._control_is_json() or int(msg.get("wire", 1)) < 2:
+            state = to_legacy(state)  # pre-v2 envelopes for an old peer
         # buffered AFTER the drained results, so the broker lands every
         # pre-drain result before the state (the router's ordering
         # argument leans on exactly this)
@@ -603,7 +655,8 @@ class FleetWorker:
             # current; the stale mig id on any late duplicate is
             # ignored router-side)
             self.metrics.count("drain_export_failed")
-            self._failed_drains[sid] = msg.get("mig")
+            self._failed_drains[sid] = (
+                msg.get("mig"), int(msg.get("wire", 1)))
             return
         self.gateway.close_session(sid)
         self.metrics.count("sessions_migrated_out")
@@ -644,11 +697,12 @@ class FleetWorker:
         failed, now that the control plane answers again.  Each retry
         re-exports fresh state (the session kept serving meanwhile), so
         the router never imports a stale snapshot."""
-        for sid, mig in list(self._failed_drains.items()):
+        for sid, (mig, wire) in list(self._failed_drains.items()):
             if self.pool.handle_for(sid) is None:
                 self._failed_drains.pop(sid, None)  # closed meanwhile
                 continue
             self.metrics.count("drain_export_retries")
-            self._on_drain_session({"session": sid, "mig": mig})
+            self._on_drain_session(
+                {"session": sid, "mig": mig, "wire": wire})
             if sid in self._failed_drains:
                 return  # control plane still down — keep the rest queued

@@ -13,24 +13,27 @@ no external processes:
 - an optional adapter to real Kafka brokers can wrap ``kafka-python`` when
   that package is installed (gated import, parity deployments only).
 
-Values are JSON-serialisable dicts, matching the reference's
-``json.dumps(...).encode('utf-8')`` value serializer.
+Values are wire-serialisable dicts (:mod:`fmda_tpu.stream.codec`): the
+JSON data model plus raw ndarrays/bytes, so the hot path carries packed
+binary columns instead of the reference's ``json.dumps(...)`` text
+(arrays on the bus are treated immutable — decoded wire arrays are
+read-only views already).
 
 Trace context (:mod:`fmda_tpu.obs.trace`) rides **in-band**: a compact
 ``trace`` field stamped into the value dict on publish when a trace is
-active, carried through every backend's JSON round-trip, read back by
+active, carried through every backend's value round-trip, read back by
 consumers via ``record.value.get("trace")``.  With tracing disabled the
 publish hot path pays exactly one branch.
 """
 
 from __future__ import annotations
 
-import json
 import threading
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Protocol, Sequence
 
 from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
+from fmda_tpu.stream import codec
 
 #: Captured once — configure_tracing mutates this singleton in place.
 _TRACER = default_tracer()
@@ -181,9 +184,12 @@ class InProcessBus:
         return self._publish(topic, value)
 
     def _publish(self, topic: str, value: dict) -> int:
-        # round-trip through JSON to enforce serialisability (and decouple
-        # the stored value from caller-side mutation), like a real broker
-        value = json.loads(json.dumps(value))
+        # structural copy to enforce wire-serialisability (and decouple
+        # the stored value from caller-side mutation), like a real
+        # broker — without the old JSON text round trip, and with raw
+        # arrays passing through uncopied (the binary-data-plane value
+        # model; arrays on the bus are treated immutable)
+        value = codec.wire_copy(value)
         with self._lock:
             self._check_topic_locked(topic)
             offset = self._next[topic]
@@ -219,7 +225,7 @@ class InProcessBus:
         without one inherit the active context."""
         if _TRACER.enabled:
             values = stamp_messages(values)
-        values = json.loads(json.dumps(list(values)))
+        values = [codec.wire_copy(v) for v in values]
         if not values:
             return []
         offsets: List[int] = []

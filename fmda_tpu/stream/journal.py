@@ -27,13 +27,27 @@ anything with ``insert_rows``/``has_timestamp``):
   flushed line-by-line; a torn trailing line from a mid-write kill is
   dropped, counted).
 
-The file is the durability unit: each spilled row is one JSON line,
-flushed immediately; compaction (after drains/sheds) rewrites through
-the ``tmp + os.replace`` idiom so a crash mid-compact keeps the previous
-journal intact.  ``flush()`` is OS-buffer durability (survives process
-death); full fsync-per-row durability would serialize the landing hot
-path on disk latency for a failure mode (kernel panic in the spill
-window) the timestamp-idempotent replay already absorbs.
+The file is the durability unit: each spill is flushed immediately;
+compaction (after drains/sheds) rewrites through the ``tmp +
+os.replace`` idiom so a crash mid-compact keeps the previous journal
+intact.  ``flush()`` is OS-buffer durability (survives process death);
+full fsync-per-row durability would serialize the landing hot path on
+disk latency for a failure mode (kernel panic in the spill window) the
+timestamp-idempotent replay already absorbs.
+
+Two record layouts (``fmt``, config ``[warehouse] journal_format``):
+
+- ``jsonl`` (default) — one JSON line per row, human-inspectable with
+  ``tail -f``/``jq``: the debug format;
+- ``binary`` — each spilled batch is one length-prefixed packed-column
+  frame (:mod:`fmda_tpu.stream.codec`: float columns as contiguous f64
+  arrays, no float→decimal→float round trip), the same layout the wire
+  speaks — at fleet drain rates the journal's encode pass sits on the
+  landing hot path exactly like the bus's did.
+
+Recovery auto-detects per record, so a journal written under one
+setting (or a mixed one after a config flip) always replays; torn or
+corrupt trailing records are dropped, counted, in either layout.
 """
 
 from __future__ import annotations
@@ -41,10 +55,60 @@ from __future__ import annotations
 import json
 import logging
 import os
+import struct
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from fmda_tpu.stream import codec
+
 log = logging.getLogger("fmda_tpu.stream")
+
+#: binary journal records: 4-byte big-endian length + one codec frame
+_JLEN = struct.Struct(">I")
+
+JOURNAL_FORMATS = ("jsonl", "binary")
+
+
+def _parse_journal(data: bytes) -> tuple:
+    """``(rows, n_corrupt)`` from raw journal bytes, auto-detecting the
+    per-record layout: a ``{`` byte starts a JSONL row line, anything
+    else a length-prefixed binary frame (whose payload must carry the
+    codec magic).  A record that fails to parse is dropped and counted;
+    a torn length/payload (mid-write kill) ends the scan — everything
+    before it already parsed."""
+    rows: List[Dict[str, float]] = []
+    corrupt = 0
+    i, n = 0, len(data)
+    while i < n:
+        b = data[i]
+        if b in (0x0A, 0x0D):  # blank separator
+            i += 1
+            continue
+        if b == 0x7B:  # '{' — a JSONL row line
+            end = data.find(b"\n", i)
+            line = data[i:n if end < 0 else end]
+            i = n if end < 0 else end + 1
+            try:
+                # lint: ignore[hot-path-json] jsonl recovery — the sanctioned human-inspectable journal layout
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                corrupt += 1
+            continue
+        if i + _JLEN.size > n:
+            corrupt += 1  # torn length prefix
+            break
+        (length,) = _JLEN.unpack_from(data, i)
+        start = i + _JLEN.size
+        if start + length > n:
+            corrupt += 1  # torn trailing frame from a mid-write kill
+            break
+        payload = data[start:start + length]
+        i = start + length
+        try:
+            rows.extend(codec.unpack_rows(codec.decode(payload)))
+        except (codec.CodecError, KeyError, TypeError, ValueError):
+            corrupt += 1
+    return rows, corrupt
 
 
 class BufferedWarehouse:
@@ -64,9 +128,14 @@ class BufferedWarehouse:
         journal_path: str,
         *,
         bound: int = 65536,
+        fmt: str = "jsonl",
     ) -> None:
+        if fmt not in JOURNAL_FORMATS:
+            raise ValueError(
+                f"journal format {fmt!r} not one of {JOURNAL_FORMATS}")
         self._inner = inner
         self._path = journal_path
+        self._fmt = fmt
         self._bound = max(1, int(bound))
         # guards the pending list/set, the counters, and the file handle
         self._lock = threading.Lock()
@@ -89,21 +158,19 @@ class BufferedWarehouse:
     # -- journal mechanics (callers hold self._lock) -------------------------
 
     def _recover_locked(self) -> None:
-        """Load a journal left behind by a previous incarnation."""
+        """Load a journal left behind by a previous incarnation.
+        Auto-detects the record layout byte by byte (JSONL lines start
+        ``{``; binary records with a length prefix + codec magic), so a
+        journal written under either ``journal_format`` — or a mix,
+        after a config flip — always replays."""
         if not os.path.exists(self._path):
             return
-        rows: List[Dict[str, float]] = []
-        with open(self._path) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rows.append(json.loads(line))
-                except json.JSONDecodeError:
-                    # a torn trailing line from a mid-write kill; the
-                    # row re-lands from bus replay through the dedupe
-                    self._counters["corrupt_lines"] += 1
+        with open(self._path, "rb") as fh:
+            data = fh.read()
+        rows, corrupt = _parse_journal(data)
+        # torn/corrupt records (a mid-write kill) are dropped, counted;
+        # the rows re-land from bus replay through the dedupe
+        self._counters["corrupt_lines"] += corrupt
         if len(rows) > self._bound:
             self._counters["shed_rows"] += len(rows) - self._bound
             rows = rows[-self._bound:]
@@ -120,8 +187,17 @@ class BufferedWarehouse:
 
     def _handle_locked(self):
         if self._fh is None:
-            self._fh = open(self._path, "a")
+            self._fh = open(self._path, "ab")
         return self._fh
+
+    def _encode_rows(self, rows: Sequence[Dict[str, float]]) -> bytes:
+        """One durable journal record batch in the configured layout."""
+        if self._fmt == "binary":
+            payload = codec.encode(codec.pack_rows(rows))
+            return _JLEN.pack(len(payload)) + payload
+        return b"".join(
+            # lint: ignore[hot-path-json] jsonl — the sanctioned human-inspectable journal layout
+            (json.dumps(row) + "\n").encode("utf-8") for row in rows)
 
     def _rewrite_locked(self) -> None:
         """Compact the journal file to exactly the pending rows (tmp +
@@ -130,16 +206,15 @@ class BufferedWarehouse:
             self._fh.close()
             self._fh = None
         tmp = f"{self._path}.tmp"
-        with open(tmp, "w") as fh:
-            for row in self._pending:
-                fh.write(json.dumps(row) + "\n")
+        with open(tmp, "wb") as fh:
+            if self._pending:
+                fh.write(self._encode_rows(self._pending))
         os.replace(tmp, self._path)
 
     def _spill_locked(self, rows: Sequence[Dict[str, float]],
                       reason: str) -> int:
         fh = self._handle_locked()
-        for row in rows:
-            fh.write(json.dumps(row) + "\n")
+        fh.write(self._encode_rows(rows))
         fh.flush()
         self._pending.extend(dict(r) for r in rows)
         self._pending_ts.update(r.get("Timestamp") for r in rows)

@@ -10,11 +10,11 @@ environments never pay for the import.
 
 from __future__ import annotations
 
-import json
 import logging
 from typing import Iterable, List, Optional, Sequence
 
 from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
+from fmda_tpu.stream import codec
 from fmda_tpu.stream.bus import Consumer, Record
 
 log = logging.getLogger("fmda_tpu.stream")
@@ -45,9 +45,13 @@ class KafkaBus:
         self._KafkaConsumer = KafkaConsumer
         self._topics = tuple(topics)
         self._servers = list(servers)
+        # Kafka stays JSON on the wire (the broker ecosystem's tooling
+        # expects text); raw arrays in bus values lower to the codec's
+        # tagged-base64 form and decode back to arrays on read, so the
+        # value model matches the other backends
         self._producer = KafkaProducer(
             bootstrap_servers=self._servers,
-            value_serializer=lambda v: json.dumps(v).encode("utf-8"),
+            value_serializer=codec.dumps,
         )
         # one metadata consumer reused for offset queries
         self._meta = KafkaConsumer(
@@ -97,7 +101,7 @@ class KafkaBus:
         consumer = self._KafkaConsumer(
             bootstrap_servers=self._servers, group_id=None,
             enable_auto_commit=False,
-            value_deserializer=lambda b: json.loads(b.decode("utf-8")),
+            value_deserializer=codec.loads,
         )
         try:
             consumer.assign([tp])

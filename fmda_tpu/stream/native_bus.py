@@ -11,11 +11,11 @@ Python bus.
 from __future__ import annotations
 
 import ctypes
-import json
 import logging
 from typing import Iterable, List, Optional, Sequence
 
 from fmda_tpu.obs.trace import default_tracer, stamp_message, stamp_messages
+from fmda_tpu.stream import codec
 from fmda_tpu.stream._native import build_and_load
 from fmda_tpu.stream.bus import Consumer, Record
 
@@ -157,7 +157,13 @@ class NativeBus:
         """Serialize + size-guard + rb_publish for one record (shared by
         :meth:`publish` and :meth:`publish_many`; counter bumps stay with
         the callers so a batch increments once)."""
-        payload = json.dumps(value).encode()
+        # the C++ log stores opaque length-prefixed blobs, so the value
+        # layout is free: binary codec frames when the value carries an
+        # array (packed columns — no base64, no text floats), JSON text
+        # otherwise (inspectable in a debugger); readers auto-detect per
+        # record off the codec magic byte
+        payload = codec.encode_payload(
+            value, binary=codec.contains_array(value))
         if len(payload) > self.READ_BUF_BYTES:
             # a record the read buffer can never return would wedge its
             # consumers forever — reject at the door
@@ -232,7 +238,8 @@ class NativeBus:
             for i in range(n):
                 raw = bytes(buf[pos : pos + lengths[i]])
                 pos += lengths[i]
-                out.append(Record(topic, int(offsets[i]), json.loads(raw)))
+                out.append(Record(
+                    topic, int(offsets[i]), codec.decode_payload(raw)[0]))
             cursor = int(offsets[n - 1]) + 1
             if remaining is not None:
                 remaining -= n

@@ -477,6 +477,47 @@ def test_topics_clean_paths():
 # ---------------------------------------------------------------------------
 
 
+def test_hot_path_json_rule_fixture_pair():
+    from fmda_tpu.analysis import HotPathJsonRule
+
+    bad = ("import json\n"
+           "def f(v):\n"
+           "    return json.dumps(v)\n")
+    findings, _, _ = run_on(HotPathJsonRule(), {"fleet/x.py": bad})
+    assert len(findings) == 1 and "json.dumps" in findings[0].message
+    # alias-aware both ways
+    aliased = ("import json as j\n"
+               "from json import loads as parse\n"
+               "def f(b):\n"
+               "    return j.dumps(parse(b))\n")
+    findings, _, _ = run_on(HotPathJsonRule(), {"runtime/x.py": aliased})
+    assert len(findings) == 2
+    # the codec module is the sanctioned home
+    findings, _, _ = run_on(HotPathJsonRule(), {"stream/codec.py": bad})
+    assert not findings
+    # out of scope: the control plane may speak json freely
+    findings, _, _ = run_on(HotPathJsonRule(), {"obs/events.py": bad})
+    assert not findings
+    # the in-place hatch sanctions a named control-plane site
+    hatched = ("import json\n"
+               "def f(v):\n"
+               "    # lint: ignore[hot-path-json] checkpoint metadata, not per-tick\n"
+               "    return json.dumps(v)\n")
+    findings, suppressed, _ = run_on(
+        HotPathJsonRule(), {"fleet/x.py": hatched})
+    assert not findings and suppressed == 1
+
+
+def test_hot_path_json_scope_lists_police_staleness(tmp_path):
+    from fmda_tpu.analysis import HotPathJsonRule
+
+    findings, _, _ = run_on(
+        HotPathJsonRule(), {"fleet/x.py": "x = 1\n"},
+        package_dir=tmp_path)  # none of the scope modules exist here
+    assert findings and all("stale scope entry" in f.message
+                            for f in findings)
+
+
 def test_logging_rule_fixture_pair():
     bad = 'print("hi")\n'
     findings, _, _ = run_on(LoggingHygieneRule(), {"stream/x.py": bad})

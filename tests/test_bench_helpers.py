@@ -74,6 +74,7 @@ class TestPhaseRegistry:
             "obs_overhead",
             "trace_overhead",
             "analysis_lint",
+            "wire_codec_bench",
         }
         assert expected == set(bench._PHASES)
 

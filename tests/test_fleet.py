@@ -180,18 +180,47 @@ def test_heartbeater_cadence_and_announce():
 # ---------------------------------------------------------------------------
 
 
-def test_array_and_row_codec_bit_exact():
+def _wire_round_trip(value, fmt):
+    """value -> frame bytes -> value, in the given wire format — the
+    exact transformation a SocketBus link applies (fmda_tpu.stream
+    .codec)."""
+    from fmda_tpu.stream import codec
+
+    payload = codec.encode_payload(value, binary=(fmt == "binary"))
+    out, was_binary = codec.decode_payload(payload)
+    assert was_binary == (fmt == "binary")
+    return out
+
+
+@pytest.mark.parametrize("fmt", ["binary", "json"])
+def test_array_and_row_codec_bit_exact(fmt):
     rng = np.random.default_rng(0)
     a = rng.normal(size=(3, 5)).astype(np.float32)
-    b = decode_array(encode_array(a))
+    b = decode_array(_wire_round_trip(encode_array(a), fmt))
     assert b.dtype == a.dtype and np.array_equal(a, b)
     row = rng.normal(size=108).astype(np.float32)
-    assert np.array_equal(decode_row(encode_row(row), 108), row)
+    assert np.array_equal(
+        decode_row(_wire_round_trip(encode_row(row), fmt), 108), row)
     with pytest.raises(ValueError, match="shape"):
-        decode_row(encode_row(row), 64)
+        decode_row(_wire_round_trip(encode_row(row), fmt), 64)
 
 
-def test_session_state_round_trips_through_gateway_bit_exact():
+def test_row_codec_accepts_legacy_base64_wire_form():
+    # state exported by a pre-v2 peer still decodes (mixed-version fleet)
+    import base64
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(3, 5)).astype(np.float32)
+    legacy = {"d": a.dtype.str, "sh": list(a.shape),
+              "b": base64.b64encode(a.tobytes()).decode("ascii")}
+    assert np.array_equal(decode_array(legacy), a)
+    row = rng.normal(size=8).astype(np.float32)
+    legacy_row = base64.b64encode(row.tobytes()).decode("ascii")
+    assert np.array_equal(decode_row(legacy_row, 8), row)
+
+
+@pytest.mark.parametrize("fmt", ["binary", "json"])
+def test_session_state_round_trips_through_gateway_bit_exact(fmt):
     cfg, params = _setup()
     pool = SessionPool(cfg, params, capacity=4, window=4)
     gw = FleetGateway(
@@ -207,10 +236,8 @@ def test_session_state_round_trips_through_gateway_bit_exact():
         gw.drain()
     state = gw.export_session("S")
     wire = encode_session_state(state)
-    # survives the bus's own JSON round trip
-    import json
-
-    restored = decode_session_state(json.loads(json.dumps(wire)))
+    # survives the transport's own frame round trip in BOTH formats
+    restored = decode_session_state(_wire_round_trip(wire, fmt))
     assert restored["seq"] == state["seq"] == 5
     assert restored["pos"] == state["pos"]
     np.testing.assert_array_equal(restored["ring"], state["ring"])
@@ -239,12 +266,35 @@ def test_session_state_round_trips_through_gateway_bit_exact():
 # ---------------------------------------------------------------------------
 
 
+class CodecRoundTripBus:
+    """An InProcessBus front that pushes every published value through
+    the wire codec in a fixed format, so the in-process topology tests
+    exercise exactly the value transformation a SocketBus link applies
+    (binary frames or the JSON fallback)."""
+
+    def __init__(self, inner, fmt):
+        self._inner = inner
+        self._fmt = fmt
+
+    def publish(self, topic, value):
+        return self._inner.publish(topic, _wire_round_trip(value, self._fmt))
+
+    def publish_many(self, topic, values):
+        return self._inner.publish_many(
+            topic, [_wire_round_trip(v, self._fmt) for v in values])
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def _topology(worker_ids, *, feats=6, window=4, capacity=8,
-              bucket_sizes=(1,), start=True, all_ids=None):
+              bucket_sizes=(1,), start=True, all_ids=None, wire=None):
     cfg, params = _setup(feats=feats, window=window)
     clock = FakeClock()
     bus = InProcessBus(
         tuple(DEFAULT_TOPICS) + fleet_topics(all_ids or worker_ids))
+    if wire is not None:
+        bus = CodecRoundTripBus(bus, wire)
     fleet_cfg = FleetTopologyConfig(
         heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0)
     rc = RuntimeConfig(capacity=capacity, window=window,
@@ -332,7 +382,8 @@ def test_router_backpressure_saturates_on_inflight_bound():
 # ---------------------------------------------------------------------------
 
 
-def test_live_migration_output_bit_identical_to_unmigrated_run():
+@pytest.mark.parametrize("wire", ["binary", "json"])
+def test_live_migration_output_bit_identical_to_unmigrated_run(wire):
     """Kill/drain a worker's ownership mid-stream (here: a second worker
     joins, so half the sessions drain off w0 and resume on w1 with
     carried state + buffered-tick replay) and assert every migrated
@@ -340,7 +391,10 @@ def test_live_migration_output_bit_identical_to_unmigrated_run():
     single-process run over the same tick sequence — no dropped,
     duplicated, or reordered ticks.  Bucket size 1 on both sides keeps
     the comparison free of XLA's B>1 reduction-order noise (the same
-    discipline the solo-vs-multiplexed identity tests use)."""
+    discipline the solo-vs-multiplexed identity tests use).
+    Parametrized over BOTH wire formats: every routed tick, exported
+    state blob, and result crosses the codec (ISSUE 12 bit-identity
+    acceptance — binary framing must not perturb a single ulp)."""
     feats, window, n_rounds = 6, 4, 12
     cfg, params = _setup(feats=feats, window=window)
     rng = np.random.default_rng(1)
@@ -370,7 +424,7 @@ def test_live_migration_output_bit_identical_to_unmigrated_run():
     # topology: w0 alone; w1 joins mid-stream -> live migration with
     # ticks submitted DURING the handoff (exercises the router buffer)
     router, workers, bus, clock, (mcfg, mparams, rc) = _topology(
-        ["w0"], all_ids=["w0", "w1"])
+        ["w0"], all_ids=["w0", "w1"], wire=wire)
     for sid in sids:
         router.open_session(sid, norms[sid])
     got = {}
@@ -618,3 +672,181 @@ def test_reconnect_storm_through_the_router():
     dropped = (c.get("inflight_dropped_on_close", 0)
                + c.get("results_missing", 0))
     assert total_answered + dropped >= 9 * 6  # every tick accounted for
+
+
+# ---------------------------------------------------------------------------
+# wire format v2: mixed-version topology (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_wire_format_topology_negotiates_down_and_serves():
+    """A binary-capable (wire_format=auto) worker joined to a JSON-
+    pinned bus server negotiates down to JSON frames and serves
+    correctly end to end — opens, columnar tick blocks (arrays lowered
+    to tagged base64 on the JSON link), results — the mixed-version
+    fleet acceptance shape.  Real socket, real worker, shared-bus
+    topology."""
+    from fmda_tpu.fleet.wire import BusServer, SocketBus
+
+    cfg, params = _setup()
+    clock = FakeClock()
+    inner = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    server = BusServer(inner, wire_format="json").start()
+    try:
+        wbus = SocketBus.connect(server.address, wire_format="auto")
+        assert wbus.negotiated_format == "json"  # negotiated DOWN
+        fleet_cfg = FleetTopologyConfig(
+            heartbeat_interval_s=0.0, heartbeat_timeout_s=50.0)
+        rc = RuntimeConfig(capacity=8, window=4, bucket_sizes=(1,),
+                           max_linger_ms=0.0, pipeline_depth=0)
+        worker = FleetWorker(
+            "w0", wbus, cfg, params, config=fleet_cfg, runtime=rc,
+            clock=clock, precompile=False)
+        router = FleetRouter(inner, fleet_cfg, n_features=6, clock=clock)
+        worker.start()
+        router.pump()
+        assert router.membership.live() == ["w0"]
+        rng = np.random.default_rng(0)
+        router.open_session("S")
+        got = []
+        for _ in range(5):
+            router.submit("S", rng.normal(size=6).astype(np.float32))
+            router.pump()
+            worker.step()
+            got.extend(router.pump())
+        for _ in range(4):
+            worker.step()
+            got.extend(router.pump())
+        assert [r.seq for r in got] == list(range(5))
+        assert all(r.probabilities.shape == (4,) for r in got)
+        # the JSON link really carried the traffic (no binary frames)
+        stats = wbus.frame_stats()
+        assert stats["binary"] == 0 and stats["json"] > 0
+        assert stats["malformed"] == 0
+        wbus.close()
+    finally:
+        server.stop()
+
+
+def test_json_link_lowers_payloads_to_pre_v2_shapes():
+    """A data link that negotiated down to JSON carries the full pre-v2
+    payload dialect — bare-base64 tick rows, no columnar blocks,
+    enveloped arrays in opens — so a genuinely old worker parses every
+    message (the docs' rolling-upgrade claim, made literal)."""
+    from fmda_tpu.fleet.state import decode_array
+
+    class JsonCaptureBus:
+        negotiated_format = "json"  # what a pre-v2 peer's link reports
+
+        def __init__(self):
+            self.published = []
+
+        def publish_many(self, topic, values):
+            self.published.extend(values)
+
+        def read(self, topic, offset):
+            return []
+
+        def close(self):
+            pass
+
+    clock = FakeClock()
+    bus = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    link = JsonCaptureBus()
+    router = FleetRouter(
+        bus, FleetTopologyConfig(heartbeat_timeout_s=50.0),
+        n_features=4, clock=clock, connect_fn=lambda addr: link)
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w0",
+                                  "address": "addr:1"})
+    router.pump()
+    rng = np.random.default_rng(0)
+    mn = rng.normal(size=4).astype(np.float32)
+    router.open_session("S", NormParams(mn, mn + 1.0))
+    rows = rng.normal(size=(3, 4)).astype(np.float32)
+    for r in rows:
+        router.submit("S", r)
+    router.pump()
+    kinds = [m["kind"] for m in link.published]
+    assert kinds == ["open", "tick", "tick", "tick"]  # no tick_block
+    open_msg = link.published[0]
+    x_min = open_msg["norm"]["x_min"]
+    assert isinstance(x_min, dict) and set(x_min) == {"d", "sh", "b"}
+    np.testing.assert_array_equal(decode_array(x_min), mn)  # bit-exact
+    for i, m in enumerate(link.published[1:]):
+        assert isinstance(m["row"], str)  # bare base64, old decode_row
+        np.testing.assert_array_equal(decode_row(m["row"], 4), rows[i])
+
+
+def test_binary_link_keeps_columnar_blocks():
+    # the lowering is per-link: a binary (or in-process) bus still gets
+    # tick blocks
+    class BinaryCaptureBus:
+        negotiated_format = "binary"
+
+        def __init__(self):
+            self.published = []
+
+        def publish_many(self, topic, values):
+            self.published.extend(values)
+
+        def read(self, topic, offset):
+            return []
+
+        def close(self):
+            pass
+
+    clock = FakeClock()
+    bus = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    link = BinaryCaptureBus()
+    router = FleetRouter(
+        bus, FleetTopologyConfig(heartbeat_timeout_s=50.0),
+        n_features=4, clock=clock, connect_fn=lambda addr: link)
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w0",
+                                  "address": "addr:1"})
+    router.pump()
+    router.open_session("S")
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        router.submit("S", rng.normal(size=4).astype(np.float32))
+    router.pump()
+    kinds = [m["kind"] for m in link.published]
+    assert kinds == ["open", "tick_block"]
+
+
+def test_shared_bus_pre_v2_peer_gets_legacy_dialect():
+    """Broker-mediated mixed-version fleet: the router's own broker
+    link may be binary, but a worker whose liveness messages never
+    declared v2 capability (no ``wire`` field — a pre-v2 process) must
+    receive the pre-v2 payload dialect on the shared bus; a worker
+    that declared ``wire: 2`` gets columnar blocks."""
+    clock = FakeClock()
+    bus = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0", "w1"]))
+    router = FleetRouter(
+        bus, FleetTopologyConfig(heartbeat_timeout_s=50.0),
+        n_features=4, clock=clock)
+    # w0: pre-v2 hello (no wire field); w1: v2 hello
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w0"})
+    bus.publish("fleet_control", {"kind": "hello", "worker": "w1",
+                                  "wire": 2})
+    router.pump()
+    rng = np.random.default_rng(0)
+    opened = {"w0": None, "w1": None}
+    i = 0
+    while not all(opened.values()):  # one session owned by each worker
+        sid = f"S{i}"
+        i += 1
+        owner = router.table.owner_of(sid)
+        if opened[owner] is None:
+            router.open_session(sid)
+            opened[owner] = sid
+    for _ in range(3):
+        for sid in opened.values():
+            router.submit(sid, rng.normal(size=4).astype(np.float32))
+    router.pump()
+    from fmda_tpu.config import fleet_worker_topic
+
+    w0_msgs = [r.value for r in bus.read(fleet_worker_topic("w0"), 0)]
+    w1_msgs = [r.value for r in bus.read(fleet_worker_topic("w1"), 0)]
+    assert [m["kind"] for m in w0_msgs] == ["open"] + ["tick"] * 3
+    assert all(isinstance(m["row"], str) for m in w0_msgs[1:])  # pre-v2
+    assert "tick_block" in [m["kind"] for m in w1_msgs]  # v2 blocks

@@ -1,25 +1,34 @@
 """fmda_tpu.fleet.wire — the cross-process bus transport.
 
-The router↔worker transport contract (ISSUE 6 satellite): a BusServer
-serves any MessageBus over framed sockets; SocketBus clients keep the
-full bus contract (topics, monotonic offsets, independent consumers);
-two processes publishing concurrently may interleave *records* but
-never corrupt *frames* — each publisher's order is preserved and every
-payload round-trips intact.  No jax anywhere in this module's tests —
-the transport is router-role code.
+The router↔worker transport contract (ISSUE 6 satellite; wire format v2
+since ISSUE 12): a BusServer serves any MessageBus over framed sockets;
+SocketBus clients keep the full bus contract (topics, monotonic
+offsets, independent consumers) on BOTH frame encodings — the
+negotiated binary codec and the JSON fallback (the contract tests below
+are parametrized over the two); two processes publishing concurrently
+may interleave *records* but never corrupt *frames* — each publisher's
+order is preserved and every payload round-trips intact.  A malformed
+frame from a confused peer costs one message, counted, never the link.
+No jax anywhere in this module's tests — the transport is router-role
+code.
 """
 
 import json
+import socket
+import struct
 import subprocess
 import sys
 import textwrap
 
+import numpy as np
 import pytest
 
 from fmda_tpu.stream.bus import InProcessBus
+from fmda_tpu.fleet import wire as wire_mod
 from fmda_tpu.fleet.wire import (
     BufferedPublisher,
     BusServer,
+    FrameDecodeError,
     SocketBus,
     parse_address,
 )
@@ -27,19 +36,31 @@ from fmda_tpu.fleet.wire import (
 TOPICS = ("alpha", "beta")
 
 
-@pytest.fixture()
-def served_bus():
+@pytest.fixture(params=["binary", "json"])
+def served_bus(request):
+    """One BusServer per contract test, exercised on BOTH wire formats:
+    the fixture param is the CLIENT's wire_format, so every contract
+    assertion below holds over binary codec frames and the JSON
+    fallback alike (ISSUE 12 acceptance)."""
     bus = InProcessBus(TOPICS)
     server = BusServer(bus).start()
+    server.client_wire_format = request.param
     try:
         yield bus, server
     finally:
         server.stop()
 
 
+def _connect(server, **kwargs):
+    kwargs.setdefault(
+        "wire_format", getattr(server, "client_wire_format", "auto"))
+    return SocketBus.connect(server.address, **kwargs)
+
+
 def test_socketbus_round_trip_and_consumers(served_bus):
     bus, server = served_bus
-    cli = SocketBus.connect(server.address)
+    cli = _connect(server)
+    assert cli.negotiated_format == server.client_wire_format
     assert cli.ping()
     assert tuple(cli.topics()) == TOPICS
     assert cli.publish("alpha", {"x": 1}) == 0
@@ -48,7 +69,7 @@ def test_socketbus_round_trip_and_consumers(served_bus):
     assert [r.value["x"] for r in c.poll()] == [1, 2, 3]
     assert c.poll() == []
     # a second client sees the same log with its own position
-    cli2 = SocketBus.connect(server.address)
+    cli2 = _connect(server)
     c2 = cli2.consumer("alpha", from_end=True)
     assert c2.poll() == []
     cli.publish("alpha", {"x": 4})
@@ -61,7 +82,7 @@ def test_socketbus_round_trip_and_consumers(served_bus):
 
 def test_socketbus_errors_cross_the_wire(served_bus):
     _bus, server = served_bus
-    cli = SocketBus.connect(server.address)
+    cli = _connect(server)
     with pytest.raises(KeyError):
         cli.publish("nope", {"x": 1})
     # the connection survives an op error
@@ -71,7 +92,7 @@ def test_socketbus_errors_cross_the_wire(served_bus):
 
 def test_socketbus_batch_runs_ops_in_order_and_isolates_errors(served_bus):
     _bus, server = served_bus
-    cli = SocketBus.connect(server.address)
+    cli = _connect(server)
     ops = [
         {"op": "publish_many", "topic": "alpha",
          "values": [{"i": 0}, {"i": 1}]},
@@ -89,7 +110,7 @@ def test_socketbus_batch_runs_ops_in_order_and_isolates_errors(served_bus):
 
 def test_buffered_publisher_preserves_order_and_coalesces(served_bus):
     bus, server = served_bus
-    cli = SocketBus.connect(server.address)
+    cli = _connect(server)
     pub = BufferedPublisher(cli)
     assert tuple(pub.topics()) == TOPICS
     pub.publish("alpha", {"i": 0})
@@ -146,32 +167,40 @@ def _spawn_ok():
         return False
 
 
-def test_concurrent_publish_many_from_two_processes(served_bus, tmp_path):
+def test_concurrent_publish_many_from_two_processes(tmp_path):
     """The router↔worker transport contract: two real processes hammer
     publish_many at one BusServer concurrently.  Offsets stay
     monotonic+dense, every record's payload is intact (no interleaved
     frames), and each publisher's own sequence arrives in order
     (publish_many batches are atomic per call, so records of one call
-    are contiguous)."""
+    are contiguous).  Runs once, on the negotiated-binary default (the
+    torn-frame risk lives in the new frames; interpreter spawns are too
+    expensive on this host to pay twice — the per-format contract is
+    covered by the parametrized tests above)."""
     if not _spawn_ok():
         pytest.skip("subprocess spawn unavailable")
     import os
 
-    bus, server = served_bus
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    src = _PUBLISHER_PROC.format(repo=repo)
-    n_batches, batch = 40, 25
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", src, server.address, tag,
-             str(n_batches), str(batch)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
-        for tag in ("A", "B")
-    ]
-    for p in procs:
-        out, err = p.communicate(timeout=120)
-        assert p.returncode == 0, err.decode()[-2000:]
-        assert json.loads(out)["published"] == n_batches * batch
+    bus = InProcessBus(TOPICS)
+    server = BusServer(bus).start()
+    del tmp_path
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        src = _PUBLISHER_PROC.format(repo=repo)
+        n_batches, batch = 40, 25
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", src, server.address, tag,
+                 str(n_batches), str(batch)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            for tag in ("A", "B")
+        ]
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()[-2000:]
+            assert json.loads(out)["published"] == n_batches * batch
+    finally:
+        server.stop()
 
     records = bus.read("alpha", 0)
     assert len(records) == 2 * n_batches * batch
@@ -196,3 +225,193 @@ def test_concurrent_publish_many_from_two_processes(served_bus, tmp_path):
         assert run % batch == 0, (
             f"batch of {src} torn at offset {i} (run {run})")
         i += run
+
+
+# ---------------------------------------------------------------------------
+# wire format v2: negotiation, array payloads, error taxonomy (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+def test_negotiation_matrix():
+    """Client × server wire_format settings settle exactly as
+    documented (docs/multihost.md): binary only when BOTH ends speak
+    it, JSON otherwise — and every combination serves correctly."""
+    for server_fmt, client_fmt, expect in [
+        ("auto", "auto", "binary"),
+        ("auto", "binary", "binary"),
+        ("auto", "json", "json"),
+        ("json", "auto", "json"),
+        ("json", "binary", "json"),   # loud fallback, still serves
+        ("binary", "auto", "binary"),
+    ]:
+        bus = InProcessBus(TOPICS)
+        server = BusServer(bus, wire_format=server_fmt).start()
+        try:
+            cli = SocketBus.connect(server.address, wire_format=client_fmt)
+            assert cli.negotiated_format == expect, (
+                server_fmt, client_fmt)
+            assert cli.publish("alpha", {"x": 1}) == 0
+            assert cli.read("alpha", 0)[0].value == {"x": 1}
+            cli.close()
+        finally:
+            server.stop()
+
+
+def test_json_peer_and_binary_peer_interoperate_with_arrays():
+    """A JSON-pinned peer and a binary peer share one served bus: the
+    binary peer's raw-array payloads land intact and decode back to
+    arrays on the JSON peer (tagged base64 on its link), and vice
+    versa — the mixed-version fleet shape."""
+    bus = InProcessBus(TOPICS)
+    server = BusServer(bus).start()
+    try:
+        bin_cli = SocketBus.connect(server.address, wire_format="auto")
+        json_cli = SocketBus.connect(server.address, wire_format="json")
+        assert bin_cli.negotiated_format == "binary"
+        assert json_cli.negotiated_format == "json"
+        row = np.arange(8, dtype=np.float32) / 3.0
+        bin_cli.publish("alpha", {"kind": "tick", "row": row})
+        json_cli.publish("alpha", {"kind": "tick", "row": row * 2})
+        got_json = json_cli.read("alpha", 0)
+        got_bin = bin_cli.read("alpha", 0)
+        for got in (got_json, got_bin):
+            assert np.array_equal(got[0].value["row"], row)
+            assert got[0].value["row"].dtype == np.float32
+            assert np.array_equal(got[1].value["row"], row * 2)
+        bin_cli.close()
+        json_cli.close()
+    finally:
+        server.stop()
+
+
+def test_pre_v2_server_negotiates_down_silently(monkeypatch):
+    """A server that predates the hello op (simulated: unknown-op error)
+    leaves the client on JSON frames — old and new peers interoperate."""
+    bus = InProcessBus(TOPICS)
+    server = BusServer(bus).start()
+    orig = BusServer._dispatch
+
+    def no_hello(self, req):
+        if req.get("op") == "hello":
+            raise RuntimeError("unknown bus op 'hello'")
+        return orig(self, req)
+
+    monkeypatch.setattr(BusServer, "_dispatch", no_hello)
+    try:
+        cli = SocketBus.connect(server.address, wire_format="auto")
+        assert cli.negotiated_format == "json"
+        assert cli.publish("alpha", {"x": 1}) == 0
+        cli.close()
+    finally:
+        server.stop()
+
+
+def _raw_frame(payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload)) + payload
+
+
+def test_malformed_frame_is_counted_and_answered_not_fatal():
+    """The ISSUE 12 bugfix: one malformed frame from a confused peer
+    used to kill the whole connection (decode errors were caught with
+    the transport errors).  Now it is answered with an error frame,
+    counted (frames_malformed_total), and the SAME connection keeps
+    serving — for broken JSON and broken binary alike (symmetric
+    taxonomy)."""
+    bus = InProcessBus(TOPICS)
+    server = BusServer(bus).start()
+    try:
+        sock = socket.create_connection(
+            tuple(parse_address(server.address)), timeout=30)
+        io = wire_mod._FrameIO(sock)
+        # 1: not JSON, not binary
+        sock.sendall(_raw_frame(b"this is not a frame"))
+        resp = io.recv_frame()
+        assert resp["kind"] == "FrameDecodeError"
+        # 2: binary magic but truncated body
+        from fmda_tpu.stream import codec as _codec
+
+        broken = _codec.encode({"op": "ping"})[:-3]
+        sock.sendall(_raw_frame(broken))
+        resp = io.recv_frame()
+        assert resp["kind"] == "FrameDecodeError"
+        # 3: the connection STILL serves real requests
+        io.send_frame({"op": "ping"})
+        assert io.recv_frame() == {"ok": "pong"}
+        stats = server.frame_stats()
+        assert stats["malformed"] == 2
+        sock.close()
+    finally:
+        server.stop()
+
+
+def test_client_surfaces_malformed_response_without_killing_link():
+    """Client side of the symmetric taxonomy: a garbage response frame
+    raises FrameDecodeError (a lost message), and the connection (whose
+    framing alignment is intact) keeps working."""
+    bus = InProcessBus(TOPICS)
+    server = BusServer(bus).start()
+    cli = SocketBus.connect(server.address, wire_format="json")
+    try:
+        # splice a garbage frame into the client's receive buffer as if
+        # the server had sent it
+        cli._io._buf += _raw_frame(b"\xfb\x63garbage")
+        with pytest.raises(FrameDecodeError):
+            cli.ping()
+        assert cli.frame_stats()["malformed"] == 1
+        assert cli.ping()  # the link survives
+    finally:
+        cli.close()
+        server.stop()
+
+
+def test_frame_size_limit_at_and_one_over(monkeypatch):
+    """MAX_FRAME_BYTES boundary through _FrameIO, both directions: a
+    frame exactly at the limit passes; one byte over is rejected on
+    send (RuntimeError) and kills the connection on receive (the
+    length prefix itself is untrustworthy — a transport error, not a
+    decode error)."""
+    monkeypatch.setattr(wire_mod, "MAX_FRAME_BYTES", 1 << 12)
+    limit = wire_mod.MAX_FRAME_BYTES
+    a, b = socket.socketpair()
+    try:
+        io_a, io_b = wire_mod._FrameIO(a), wire_mod._FrameIO(b)
+        # JSON text of a string payload: 2 quote bytes of envelope
+        at_limit = "x" * (limit - 2)
+        io_a.send_frame(at_limit)
+        assert io_b.recv_frame() == at_limit
+        with pytest.raises(RuntimeError, match="exceeds"):
+            io_a.send_frame("x" * (limit - 1))
+        # receive side: an announced over-limit length is fatal
+        a.sendall(struct.pack(">I", limit + 1))
+        with pytest.raises(ConnectionError, match="limit"):
+            io_b.recv_frame()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_stats_and_wire_metrics_collector():
+    """frames_binary_total / frames_json_total / frames_malformed_total
+    and the negotiated-format gauge flow through bind_metrics into the
+    registry snapshot (the obs satellite)."""
+    from fmda_tpu.obs.registry import MetricsRegistry
+
+    bus = InProcessBus(TOPICS)
+    server = BusServer(bus).start()
+    cli = SocketBus.connect(server.address, wire_format="auto")
+    try:
+        reg = MetricsRegistry()
+        cli.bind_metrics(reg)
+        cli.publish("alpha", {"x": 1})
+        snap = reg.snapshot()
+        counters = {c["name"]: c["value"] for c in snap["counters"]
+                    if c["name"].startswith("frames_")}
+        gauges = {g["name"]: g["value"] for g in snap["gauges"]}
+        assert counters["frames_binary_total"] > 0
+        assert counters["frames_malformed_total"] == 0
+        assert gauges["wire_format_binary"] == 1.0
+        # server-side aggregate sees the same traffic
+        assert server.frame_stats()["binary"] > 0
+    finally:
+        cli.close()
+        server.stop()

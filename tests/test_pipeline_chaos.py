@@ -297,9 +297,10 @@ def _row(i):
     return {"Timestamp": f"2020-02-07 09:{30 + i:02d}:00", "v": float(i)}
 
 
-def test_journal_spills_and_backfills_in_order(tmp_path):
+@pytest.mark.parametrize("fmt", ["jsonl", "binary"])
+def test_journal_spills_and_backfills_in_order(tmp_path, fmt):
     store = _FlakyStore()
-    wh = BufferedWarehouse(store, str(tmp_path / "j.jsonl"))
+    wh = BufferedWarehouse(store, str(tmp_path / "j.jsonl"), fmt=fmt)
     assert wh.insert_rows([_row(0)]) == 1
     store.down = True
     assert wh.insert_rows([_row(1)]) == 1     # spilled, not raised
@@ -320,20 +321,23 @@ def test_journal_spills_and_backfills_in_order(tmp_path):
     assert stats["drain_failures"] >= 1
 
 
-def test_journal_is_durable_and_idempotent_across_restart(tmp_path):
+@pytest.mark.parametrize("fmt", ["jsonl", "binary"])
+def test_journal_is_durable_and_idempotent_across_restart(tmp_path, fmt):
     """A process restart recovers the journal from disk; a row that
     already landed (crash between store commit and journal compaction)
-    is deduped via has_timestamp, never double-landed."""
+    is deduped via has_timestamp, never double-landed.  Parametrized
+    over both record layouts: the packed-column format's crash-replay
+    dedupe must stay exactly as exact as JSONL's (ISSUE 12)."""
     path = str(tmp_path / "j.jsonl")
     store = _FlakyStore()
-    wh = BufferedWarehouse(store, path)
+    wh = BufferedWarehouse(store, path, fmt=fmt)
     store.down = True
     wh.insert_rows([_row(1), _row(2)])
     # crash-replay shape: row 1 secretly made it into the store before
     # the journal could compact
     store.rows.append(_row(1))
     store.down = False
-    wh2 = BufferedWarehouse(store, path)      # "restarted process"
+    wh2 = BufferedWarehouse(store, path, fmt=fmt)  # "restarted process"
     assert wh2.journal_stats()["recovered_rows"] == 2
     assert wh2.drain_journal() == 1           # row 2 only
     assert [r["Timestamp"] for r in store.rows] == [
@@ -374,6 +378,58 @@ def test_journal_survives_torn_trailing_line(tmp_path):
     assert stats["corrupt_lines"] == 1
     wh.drain_journal()
     assert [r["Timestamp"] for r in store.rows] == [_row(0)["Timestamp"]]
+
+
+def test_journal_binary_torn_trailing_frame_dropped_counted(tmp_path):
+    """The binary layout's mid-write-kill shape: a length prefix whose
+    payload never finished is dropped and counted, like a torn JSONL
+    line — and the rows before it still recover."""
+    import struct as _struct
+
+    from fmda_tpu.stream import codec as _codec
+
+    path = str(tmp_path / "j.bin")
+    store = _FlakyStore()
+    wh = BufferedWarehouse(store, path, fmt="binary")
+    store.down = True
+    wh.insert_rows([_row(0), _row(1)])
+    with open(path, "ab") as fh:              # torn frame: body cut short
+        payload = _codec.encode(_codec.pack_rows([_row(2)]))
+        fh.write(_struct.pack(">I", len(payload)) + payload[:-5])
+    wh2 = BufferedWarehouse(store, path, fmt="binary")
+    stats = wh2.journal_stats()
+    assert stats["recovered_rows"] == 2
+    assert stats["corrupt_lines"] == 1
+    store.down = False
+    wh2.drain_journal()
+    assert [r["Timestamp"] for r in store.rows] == [
+        _row(0)["Timestamp"], _row(1)["Timestamp"]]
+    # values survived the packed columns bit-exact
+    assert [r["v"] for r in store.rows] == [0.0, 1.0]
+
+
+def test_journal_mixed_format_recovery_after_config_flip(tmp_path):
+    """A journal written as JSONL, then appended in binary after a
+    journal_format flip (or vice versa), recovers every row: the reader
+    auto-detects per record."""
+    path = str(tmp_path / "j.mixed")
+    store = _FlakyStore()
+    store.down = True
+    wh = BufferedWarehouse(store, path, fmt="jsonl")
+    wh.insert_rows([_row(0)])
+    wh.close()
+    store2 = _FlakyStore()
+    store2.down = True
+    wh2 = BufferedWarehouse(store2, path, fmt="binary")
+    assert wh2.journal_stats()["recovered_rows"] == 1
+    wh2.insert_rows([_row(1)])
+    wh2.close()
+    store3 = _FlakyStore()
+    wh3 = BufferedWarehouse(store3, path, fmt="jsonl")
+    assert wh3.journal_stats()["recovered_rows"] == 2
+    wh3.drain_journal()
+    assert [r["Timestamp"] for r in store3.rows] == [
+        _row(0)["Timestamp"], _row(1)["Timestamp"]]
 
 
 def test_journal_poison_row_is_dropped_not_wedged(tmp_path):
