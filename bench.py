@@ -1770,6 +1770,94 @@ def phase_trace_overhead() -> dict:
     }
 
 
+def phase_obs_aggregate_overhead() -> dict:
+    """Fleet-telemetry cost on the serving hot loop (ISSUE 13): the same
+    synthetic fleet load run (a) bare and (b) with the full aggregation
+    + SLO-evaluation path folding on a tight cadence — histogram
+    snapshots into the time-series store, counter rates, burn-rate
+    evaluation over both windows — interleaved, min-of-reps, overhead as
+    a percentage.  The aggregation path's contract is pull-based
+    scrape-time work only (<2% of the loop, docs/observability.md);
+    ``ok`` asserts it on a quiet host (noise floor otherwise).  The
+    cadence here (20 ms) is ~250x denser than the shipped 5 s default —
+    a deliberate worst case."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.config import DEFAULT_TOPICS, ModelConfig, SLOConfig
+    from fmda_tpu.models import build_model
+    from fmda_tpu.obs.aggregate import FleetTelemetry
+    from fmda_tpu.runtime import (
+        BatcherConfig, FleetGateway, FleetLoadConfig, SessionPool,
+        run_fleet_load)
+    from fmda_tpu.stream import InProcessBus
+
+    sessions, rounds, reps = 32, 150, 5
+    bucket = 32
+    fold_every_s = 0.02
+    cfg = ModelConfig(hidden_size=16, n_features=FEATURES,
+                      output_size=CLASSES, dropout=0.0,
+                      bidirectional=False, use_pallas=False)
+    model = build_model(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, WINDOW, FEATURES)))["params"]
+
+    def run_once(instrumented: bool) -> float:
+        pool = SessionPool(cfg, params, capacity=sessions, window=WINDOW)
+        bus = InProcessBus(DEFAULT_TOPICS)
+        gateway = FleetGateway(
+            pool, bus,
+            batcher_config=BatcherConfig(bucket_sizes=(bucket,),
+                                         max_linger_s=0.002))
+        pool.step(np.full(bucket, pool.padding_slot, np.int32),
+                  np.zeros((bucket, FEATURES), np.float32))
+        on_round = None
+        if instrumented:
+            telemetry = FleetTelemetry(SLOConfig(
+                interval_s=fold_every_s, retention_s=60.0,
+                fast_window_s=0.5, slow_window_s=2.0))
+            state = {"last": 0.0}
+
+            def on_round(r):
+                now = _time.monotonic()
+                if now - state["last"] >= fold_every_s:
+                    state["last"] = now
+                    telemetry.collect_gateway(gateway)
+
+        t0 = _time.monotonic()
+        run_fleet_load(gateway, FleetLoadConfig(
+            n_sessions=sessions, n_ticks=rounds, duty=1.0, seed=0),
+            on_round=on_round)
+        return _time.monotonic() - t0
+
+    run_once(False)  # warm caches
+    bare, wired = [], []
+    for _ in range(reps):
+        bare.append(run_once(False))
+        wired.append(run_once(True))
+    base, inst = min(bare), min(wired)
+    overhead_pct = (inst - base) / base * 100.0
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = None
+    quiet = load1 is not None and load1 < 0.5 * (os.cpu_count() or 1)
+    return {
+        "sessions": sessions,
+        "rounds": rounds,
+        "reps": reps,
+        "fold_every_s": fold_every_s,
+        "bare_wall_s": round(base, 3),
+        "aggregated_wall_s": round(inst, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "budget_pct": 2.0,
+        "quiet_host": quiet,
+        "ok": overhead_pct < 2.0 or not quiet,
+    }
+
+
 def phase_analysis_lint() -> dict:
     """Cost guard for the static-analysis gate (ISSUE 8): the whole rule
     suite — drift resolver included — over the parsed-module cache must
@@ -1924,6 +2012,7 @@ _PHASES = {
     "runtime_chaos_soak": phase_runtime_chaos_soak,
     "pipeline_chaos_soak": phase_pipeline_chaos_soak,
     "obs_overhead": phase_obs_overhead,
+    "obs_aggregate_overhead": phase_obs_aggregate_overhead,
     "trace_overhead": phase_trace_overhead,
     "analysis_lint": phase_analysis_lint,
     "wire_codec_bench": phase_wire_codec,

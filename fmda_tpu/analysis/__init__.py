@@ -21,6 +21,9 @@ rule id                   severity    contract
                                       the kernel surface
 ``bus-topics``            error       published topic literals are declared
                                       or consumed somewhere
+``metric-names``          error       registered series names are
+                                      exposition-safe, unprefixed,
+                                      kind-unique, label-key consistent
 ``hot-path-json``         error       data-plane modules (fleet/, runtime/,
                                       stream transport) call json only in
                                       the codec module or at annotated
@@ -63,6 +66,7 @@ from fmda_tpu.analysis.hygiene import (
     SpanClockRule,
 )
 from fmda_tpu.analysis.locks import LockDisciplineRule
+from fmda_tpu.analysis.metric_names import MetricNamesRule
 from fmda_tpu.analysis.purity import JitPurityRule
 from fmda_tpu.analysis.topics import BusTopicRule
 
@@ -90,6 +94,7 @@ __all__ = [
     "JitPurityRule",
     "LockDisciplineRule",
     "LoggingHygieneRule",
+    "MetricNamesRule",
     "RouterJaxImportRule",
     "SpanClockRule",
 ]
@@ -107,6 +112,7 @@ def default_rules(*, drift: bool = True):
         LockDisciplineRule(),
         JitPurityRule(),
         BusTopicRule(),
+        MetricNamesRule(),
         CompatRequiredRule(),
         HotPathJsonRule(),
     ]

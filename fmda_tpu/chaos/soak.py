@@ -116,6 +116,7 @@ def run_chaos_soak(
     if plan is None:
         plan = FaultPlan(n_steps=30)
     config = _soak_config(config)
+    telemetry = _soak_telemetry(config)
     kwargs = dict(
         config=config, n_workers=n_workers, n_sessions=n_sessions,
         hidden=hidden, seed=seed, window=window,
@@ -125,15 +126,59 @@ def run_chaos_soak(
         recover_timeout_s=recover_timeout_s,
         wait_timeout_s=wait_timeout_s,
         sleep_fn=sleep_fn)
-    faulted = _run_topology(plan, **kwargs)
+    try:
+        faulted = _run_topology(plan, telemetry=telemetry, **kwargs)
+    finally:
+        if telemetry is not None:
+            # detach from the chaos singleton NOW: the reference run
+            # below (and any later soak in this process) must not fire
+            # this run's recorder
+            telemetry.close()
     report = _gate_report(plan, faulted)
+    if telemetry is not None:
+        fired = sum(1 for e in telemetry.events.tail()
+                    if e.get("kind") == "slo.alert_fired")
+        report["telemetry"] = {
+            "alerts_firing": telemetry.slo.firing(),
+            "alerts_fired_total": fired,
+            "tsdb_series": len(telemetry.store.series()),
+            "postmortems": (telemetry.recorder.bundles()
+                            if telemetry.recorder is not None else []),
+        }
     if compare_unfaulted and plan.events:
+        # no telemetry on the reference run: its store/alerts would
+        # overwrite the faulted run's evidence, and the identity gate
+        # compares probabilities, not telemetry
         reference = _run_topology(FaultPlan(n_steps=plan.n_steps),
-                                  **kwargs)
+                                  telemetry=None, **kwargs)
         report["identity"] = _identity_verdict(faulted, reference)
         report["gates"]["identity_ok"] = report["identity"]["ok"]
     report["gates_ok"] = all(report["gates"].values())
     return report
+
+
+def _soak_telemetry(config: FrameworkConfig):
+    """Fleet telemetry for the soak (ISSUE 13): the time-series store,
+    SLO burn-rate evaluation, and — when the ``[slo]`` section names a
+    ``postmortem_dir`` — the flight recorder, all riding the soak's
+    absorb loop (cadence-gated, off the submit path).  The soak's
+    virtual steps are ~50 ms of wall clock, so the windows shrink to
+    match: a fleet-scale 5 m/1 h posture would never see a soak-length
+    breach."""
+    if not config.slo.enabled:
+        return None
+    from fmda_tpu.obs.aggregate import FleetTelemetry
+
+    slo_cfg = dataclasses.replace(
+        config.slo,
+        interval_s=min(config.slo.interval_s, 0.25),
+        scrape_interval_s=min(config.slo.scrape_interval_s, 1.0),
+        fast_window_s=min(config.slo.fast_window_s, 3.0),
+        slow_window_s=min(config.slo.slow_window_s, 12.0),
+        postmortem_min_interval_s=min(
+            config.slo.postmortem_min_interval_s, 5.0),
+    )
+    return FleetTelemetry(slo_cfg)
 
 
 def _soak_config(config: Optional[FrameworkConfig]) -> FrameworkConfig:
@@ -166,6 +211,7 @@ def _soak_config(config: Optional[FrameworkConfig]) -> FrameworkConfig:
 def _run_topology(
     plan: FaultPlan,
     *,
+    telemetry=None,
     config: FrameworkConfig,
     n_workers: int,
     n_sessions: int,
@@ -247,6 +293,11 @@ def _run_topology(
 
         def absorb(step: int) -> None:
             absorb_results(router.pump(), step)
+            if telemetry is not None:
+                # cadence-gated fold into the tsdb + SLO evaluation —
+                # one clock read when not due; follows router takeovers
+                # because the closure reads the loop's live binding
+                telemetry.maybe_collect(router)
 
         def submit_tick(i: int, step: int) -> None:
             sid = sids[i]

@@ -485,6 +485,10 @@ def _cmd_fleet_worker(args) -> int:
     bus.bind_metrics(obs.registry)
     if args.metrics_port is not None:
         server = obs.start_server(port=args.metrics_port)
+        # announce the scrape endpoint in every liveness message: the
+        # router's fleet aggregator (fmda_tpu.obs.aggregate) scrapes
+        # exactly the addresses heartbeats carry
+        worker.heartbeater.announce["metrics"] = server.url
         print(f"worker {args.worker_id} metrics: {server.url}/metrics",
               file=sys.stderr)
     try:
@@ -541,6 +545,25 @@ def _cmd_fleet_broker(args) -> int:
     return 0
 
 
+def _fleet_telemetry(args, cfg):
+    """Router-side fleet telemetry (store + aggregator + SLO engine +
+    flight recorder — fmda_tpu.obs.aggregate) for --role router/local,
+    or None when the ``[slo]`` section disables it.  ``--postmortem-dir``
+    overrides the config so the flight recorder works from the command
+    line alone."""
+    if not cfg.slo.enabled:
+        return None
+    import dataclasses
+
+    from fmda_tpu.obs.aggregate import FleetTelemetry
+
+    slo_cfg = cfg.slo
+    postmortem = getattr(args, "postmortem_dir", None)
+    if postmortem:
+        slo_cfg = dataclasses.replace(slo_cfg, postmortem_dir=postmortem)
+    return FleetTelemetry(slo_cfg)
+
+
 def _cmd_fleet_router(args) -> int:
     """serve-fleet --role router: the routing/membership/migration
     control loop on a bus-only host (no jax on this code path).  With
@@ -588,11 +611,24 @@ def _cmd_fleet_router(args) -> int:
               f"--connect {server.address} --worker-id w<N>",
               file=sys.stderr)
     router = FleetRouter(bus, fleet_cfg, n_features=cfg.features.n_features)
+    telemetry = _fleet_telemetry(args, cfg)
+    tele_server = None
+    if telemetry is not None and args.metrics_port is not None:
+        # the router's OWN scrape surface: fleet-level series
+        # (/query?series=&window=), the SLO alert document (/alerts),
+        # and an SLO-aware /healthz `status --endpoint` exits 1 on
+        tele_server = telemetry.start_server(port=args.metrics_port)
+        print(f"router telemetry: {tele_server.url}/metrics "
+              f"(query, alerts, healthz)", file=sys.stderr)
     deadline = (time.monotonic() + args.duration_s
                 if args.duration_s else None)
     try:
         while deadline is None or time.monotonic() < deadline:
             router.pump()
+            if telemetry is not None:
+                # cadence-gated fold (one clock read when not due) —
+                # aggregation stays off the routing hot path
+                telemetry.maybe_collect(router)
             time.sleep(0.005)
     except KeyboardInterrupt:
         pass
@@ -609,10 +645,16 @@ def _cmd_fleet_router(args) -> int:
                 time.sleep(0.02)
         except (ConnectionError, OSError):
             pass
+        if telemetry is not None:
+            telemetry.close()
+        if tele_server is not None:
+            tele_server.stop()
         if server is not None:
             server.stop()
     out = router.summary()
     out["n_features"] = router.n_features
+    if telemetry is not None:
+        out["alerts"] = telemetry.alerts()["firing"]
     _maybe_write_trace(args, out)
     print(json.dumps(out, indent=2, default=str))
     return 0
@@ -720,6 +762,14 @@ def _cmd_fleet_local(args) -> int:
         window=args.window,
         trace_dir=args.trace_dir,
     )
+    telemetry = _fleet_telemetry(args, cfg)
+    tele_server = None
+    if telemetry is not None and args.metrics_port is not None:
+        tele_server = telemetry.start_server(port=args.metrics_port)
+        print(f"fleet telemetry: {tele_server.url}/metrics "
+              f"(query, alerts, healthz)", file=sys.stderr)
+    on_round = (None if telemetry is None
+                else (lambda r: telemetry.maybe_collect(topo.router)))
     try:
         out = run_fleet_load(topo.router, FleetLoadConfig(
             n_sessions=args.sessions, n_ticks=args.ticks,
@@ -729,12 +779,25 @@ def _cmd_fleet_local(args) -> int:
             burst_every=args.burst_every,
             burst_rounds=args.burst_rounds,
             slow_fraction=args.slow_fraction,
-            slow_duty=args.slow_duty))
+            slow_duty=args.slow_duty),
+            on_round=on_round)
+        if telemetry is not None:
+            telemetry.collect(topo.router)  # final fold before teardown
     finally:
         worker_stats = topo.shutdown()
+        if telemetry is not None:
+            telemetry.close()
+        if tele_server is not None and args.metrics_hold_s <= 0:
+            # with --metrics-hold-s the endpoint outlives the load (the
+            # curl/promtool demo workflow) and stops after the hold below
+            tele_server.stop()
     out["workers"] = n
     out["worker_stats"] = worker_stats
     out["table_version"] = topo.router.table.version
+    if telemetry is not None:
+        out["alerts"] = telemetry.alerts()["firing"]
+        out["fleet"] = {
+            g["name"]: g["value"] for g in telemetry.fleet_gauges()}
     if args.trace_dir:
         from fmda_tpu.obs.trace import default_tracer
 
@@ -747,6 +810,16 @@ def _cmd_fleet_local(args) -> int:
               file=sys.stderr)
     _maybe_write_trace(args, out)
     print(json.dumps(out, indent=2, default=str))
+    if tele_server is not None and args.metrics_hold_s > 0:
+        # the endpoint outlives the load so an operator can curl
+        # /alerts + /query against the run's final state (same contract
+        # as the solo role's --metrics-hold-s)
+        import time
+
+        print(f"holding fleet telemetry endpoint for "
+              f"{args.metrics_hold_s:.0f}s", file=sys.stderr)
+        time.sleep(args.metrics_hold_s)
+        tele_server.stop()
     return 0
 
 
@@ -958,8 +1031,10 @@ def cmd_serve_fleet(args) -> int:
     return 0
 
 
-def _print_status(snapshot: dict, health: dict) -> None:
-    """Human-readable registry snapshot + health verdict."""
+def _print_status(snapshot: dict, health: dict,
+                  alerts: dict = None) -> None:
+    """Human-readable registry snapshot + health verdict (+ the SLO
+    alert table when the endpoint serves ``/alerts``)."""
 
     def key(s):
         labels = ",".join(f"{k}={v}" for k, v in
@@ -970,6 +1045,15 @@ def _print_status(snapshot: dict, health: dict) -> None:
     for name, check in sorted(health.get("checks", {}).items()):
         mark = "ok  " if check["ok"] else "FAIL"
         print(f"  {mark} {name:<14} {check['detail']}")
+    if alerts and alerts.get("alerts"):
+        print(f"slo alerts (burn threshold "
+              f"{alerts.get('burn_threshold')}x):")
+        for name, a in sorted(alerts["alerts"].items()):
+            mark = "FIRE" if a.get("state") == "firing" else "ok  "
+            print(f"  {mark} {name:<16} "
+                  f"fast {a.get('burn_fast', 0):>8.2f}x  "
+                  f"slow {a.get('burn_slow', 0):>8.2f}x  "
+                  f"{a.get('detail', '')}")
     for kind in ("counters", "gauges"):
         samples = sorted(snapshot.get(kind, []), key=key)
         if samples:
@@ -991,8 +1075,9 @@ def _print_status(snapshot: dict, health: dict) -> None:
 
 
 def _scrape_endpoint(endpoint: str):
-    """GET /snapshot + /healthz off one endpoint; raises on transport
-    failure (callers decide whether one dead worker fails the probe)."""
+    """GET /snapshot + /healthz (+ /alerts, absent on pre-ISSUE-13
+    endpoints) off one endpoint; raises on transport failure (callers
+    decide whether one dead worker fails the probe)."""
     import urllib.error
     import urllib.request
 
@@ -1006,7 +1091,15 @@ def _scrape_endpoint(endpoint: str):
     except urllib.error.HTTPError as e:
         # 503 = degraded; the body still carries the check detail
         health = json.loads(e.read())
-    return snapshot, health
+    alerts = None
+    try:
+        with urllib.request.urlopen(base + "/alerts", timeout=10) as r:
+            alerts = json.loads(r.read())
+    except (urllib.error.URLError, OSError, json.JSONDecodeError):
+        # a worker endpoint (no telemetry) 404s here — the snapshot and
+        # health verdict still stand alone
+        alerts = None
+    return snapshot, health, alerts
 
 
 def _status_multi(endpoints) -> int:
@@ -1026,9 +1119,9 @@ def _status_multi(endpoints) -> int:
                 "status": "unreachable",
                 "checks": {},
                 "error": str(e),
-            })
+            }, None)
     n_ok = 0
-    for ep, (snapshot, health) in per.items():
+    for ep, (snapshot, health, alerts) in per.items():
         status = health.get("status")
         print(f"===== {ep}: {status} =====")
         if status == "unreachable":
@@ -1036,7 +1129,7 @@ def _status_multi(endpoints) -> int:
             continue
         if status == "ok":
             n_ok += 1
-        _print_status(snapshot, health)
+        _print_status(snapshot, health, alerts)
     aggregate = "ok" if n_ok == len(endpoints) else "degraded"
     print(f"aggregate: {aggregate} ({n_ok}/{len(endpoints)} endpoints ok)")
     return 0 if aggregate == "ok" else 1
@@ -1044,16 +1137,43 @@ def _status_multi(endpoints) -> int:
 
 def cmd_status(args) -> int:
     """Observability snapshot: local (build the app, sample its registry)
-    or remote (GET /snapshot + /healthz off running endpoints).  Several
-    ``--endpoint`` values — one per fleet worker — report per-worker
-    health plus the aggregate verdict."""
+    or remote (GET /snapshot + /healthz + /alerts off running
+    endpoints).  Several ``--endpoint`` values — one per fleet worker —
+    report per-worker health plus the aggregate verdict.  ``--watch N``
+    re-scrapes every N seconds, redrawing in place, until Ctrl-C (clean
+    exit 0) — watching a soak without a shell loop."""
+    if args.watch:
+        return _status_watch(args)
+    return _status_once(args)
+
+
+def _status_watch(args) -> int:
+    import time
+
+    try:
+        while True:
+            if sys.stdout.isatty():
+                # clear + home: redraw in place like `watch(1)`
+                print("\x1b[2J\x1b[H", end="")
+            _status_once(args)
+            print(f"-- every {args.watch:g}s (Ctrl-C to exit) --",
+                  flush=True)
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        # the operator closed the watch — a clean exit, not an error
+        # (the per-refresh verdicts were already printed)
+        return 0
+
+
+def _status_once(args) -> int:
+    alerts = None
     if args.endpoint:
         import urllib.error
 
         if len(args.endpoint) > 1:
             return _status_multi(args.endpoint)
         try:
-            snapshot, health = _scrape_endpoint(args.endpoint[0])
+            snapshot, health, alerts = _scrape_endpoint(args.endpoint[0])
         except (urllib.error.URLError, OSError,
                 json.JSONDecodeError) as e:
             # a down daemon is the most common reason to run this probe
@@ -1084,8 +1204,9 @@ def cmd_status(args) -> int:
         app = Application(cfg)
         snapshot = app.observability.snapshot()
         health = app.observability.health()
-    _print_status(snapshot, health)
-    return 0 if health.get("status") == "ok" else 1
+    _print_status(snapshot, health, alerts)
+    firing = bool(alerts and alerts.get("firing"))
+    return 0 if health.get("status") == "ok" and not firing else 1
 
 
 def cmd_trace(args) -> int:
@@ -1478,7 +1599,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "fail the run (loaded-host escape hatch)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve /metrics + /healthz + /snapshot on this "
-                        "port during the run (0 = ephemeral)")
+                        "port during the run (0 = ephemeral); for "
+                        "--role router/local this is the fleet "
+                        "telemetry endpoint (+ /query + /alerts)")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="--role router/local: flight-recorder bundle "
+                        "directory (overrides [slo] postmortem_dir) — "
+                        "an SLO alert firing or an injected chaos fault "
+                        "dumps a rotated postmortem bundle there")
     p.add_argument("--metrics-hold-s", type=float, default=0.0,
                    help="keep the metrics endpoint up this long after "
                         "the load finishes (curl/promtool demos)")
@@ -1512,6 +1640,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warehouse", default=None,
                    help="warehouse file for the local snapshot (default: "
                         "config's path)")
+    p.add_argument("--watch", type=float, default=None, metavar="N",
+                   help="live-refresh mode: re-scrape and redraw every "
+                        "N seconds until Ctrl-C (clean exit 0) — watch "
+                        "a soak without a shell loop")
     p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser(

@@ -653,6 +653,58 @@ class ObservabilityConfig:
 
 
 @dataclass(frozen=True)
+class SLOConfig:
+    """Fleet service-level objectives + telemetry knobs (fmda_tpu.obs:
+    tsdb/aggregate/slo/recorder; docs/observability.md "Fleet
+    aggregation, SLOs, and the flight recorder").
+
+    Declarative objectives evaluated as **multi-window burn rates**: an
+    alert fires when both the fast (~5 m) and slow (~1 h) windows burn
+    error budget faster than ``burn_threshold``, and clears as soon as
+    the fast window recovers.  Evaluation is pull-based — one fold of
+    heartbeat stats + scrape snapshots per ``interval_s``, never on the
+    tick hot path.
+    """
+
+    #: Master switch for router-side fleet telemetry (the store, the
+    #: aggregator, SLO evaluation, and the flight recorder).
+    enabled: bool = True
+    #: Time-series sample grid + SLO evaluation cadence (seconds).
+    interval_s: float = 5.0
+    #: History the store retains per series (ring capacity =
+    #: retention_s / interval_s bins).
+    retention_s: float = 7200.0
+    #: Cadence for scraping worker ``/snapshot`` endpoints (announced
+    #: in heartbeats); heartbeat stats fold in every ``interval_s``.
+    scrape_interval_s: float = 10.0
+    #: Burn-rate windows (seconds): fast trips quickly on a cliff,
+    #: slow keeps a brief blip from paging.
+    fast_window_s: float = 300.0
+    slow_window_s: float = 3600.0
+    #: Burn rate (budget consumption multiple) at which an alert fires.
+    burn_threshold: float = 2.0
+    #: Latency objective: at most ``latency_budget`` of served ticks may
+    #: exceed ``latency_p99_ms`` end to end.  None disables.
+    latency_p99_ms: Optional[float] = 250.0
+    latency_budget: float = 0.05
+    #: Loss objective: counted losses / (served + lost) stays under this.
+    loss_budget: float = 0.001
+    #: Journal objective: warehouse journal backlog above this depth is
+    #: budget burn (``journal_budget`` of samples may exceed it).
+    journal_depth: int = 1024
+    journal_budget: float = 0.1
+    #: Degraded-feed objective: minutes per slow window any side feed
+    #: may serve ghost rows before the alert fires.
+    degraded_feed_budget_minutes: float = 5.0
+    #: Flight-recorder bundle directory; None disables postmortems.
+    postmortem_dir: Optional[str] = None
+    #: Rotated bundle count (oldest deleted past this).
+    postmortem_keep: int = 4
+    #: Debounce between bundles for one trigger reason (seconds).
+    postmortem_min_interval_s: float = 60.0
+
+
+@dataclass(frozen=True)
 class TracingConfig:
     """End-to-end tick tracing knobs (fmda_tpu.obs.trace;
     docs/observability.md "Tracing a tick").
@@ -761,6 +813,7 @@ class FrameworkConfig:
     fleet: FleetTopologyConfig = field(default_factory=FleetTopologyConfig)
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
@@ -794,6 +847,7 @@ _SECTIONS = {
     "runtime": RuntimeConfig,
     "fleet": FleetTopologyConfig,
     "observability": ObservabilityConfig,
+    "slo": SLOConfig,
     "tracing": TracingConfig,
     "chaos": ChaosConfig,
 }

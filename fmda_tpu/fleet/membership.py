@@ -46,6 +46,10 @@ class WorkerInfo:
     capacity: int = 0
     #: the newest stats dict its heartbeat carried
     stats: Dict[str, object] = field(default_factory=dict)
+    #: the worker's announced metrics endpoint (``host:port`` of its
+    #: /snapshot scrape surface), when it runs one — the fleet
+    #: aggregator (fmda_tpu.obs.aggregate) scrapes exactly these
+    metrics: Optional[str] = None
 
 
 class MembershipView:
@@ -113,6 +117,15 @@ class MembershipView:
             info.capacity = int(msg["capacity"])
         if isinstance(msg.get("stats"), dict):
             info.stats = msg["stats"]
+        if kind == HELLO:
+            # a (re)hello defines the incarnation's announce outright: a
+            # replacement started WITHOUT a metrics endpoint must clear
+            # the dead incarnation's URL, or the aggregator scrapes a
+            # dead address forever
+            info.metrics = (str(msg["metrics"])
+                            if msg.get("metrics") else None)
+        elif msg.get("metrics"):
+            info.metrics = str(msg["metrics"])
         return "join" if joined or rejoined else None
 
     def reap(self, now: Optional[float] = None) -> List[str]:

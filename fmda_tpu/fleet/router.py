@@ -305,6 +305,10 @@ class FleetRouter:
             "session": sess.session_id,
             "norm": sess.norm_wire,
             "seq": int(state["seq"]) if state is not None else sess.next_seq,
+            # v2 requester: the worker may answer with columnar result
+            # blocks (and raw-array state) — absent (a pre-v2 router),
+            # it keeps the per-tick result dicts
+            "wire": 2,
         }
         if state is not None:
             msg["state"] = state
@@ -797,7 +801,20 @@ class FleetRouter:
 
     def _fold_results(self, rows) -> List[FleetResult]:
         results: List[FleetResult] = []
+        flat: List[dict] = []
         for _offset, v in rows:
+            if v.get("kind") == "result_block":
+                # a columnar run (fmda_tpu.stream.codec.pack_results):
+                # one (B, C) probability array + dictionary-encoded ids
+                # expands back to per-result messages, bit-identical to
+                # the per-tick dialect
+                try:
+                    flat.extend(codec.iter_results(v))
+                except (KeyError, ValueError, TypeError):
+                    self.metrics.count("results_undecodable")
+                continue
+            flat.append(v)
+        for v in flat:
             sid, seq = v.get("session"), v.get("seq")
             if sid is None or seq is None:
                 # not a result at all (a corrupted/foreign record on
@@ -1168,6 +1185,7 @@ class FleetRouter:
             "session": sess.session_id,
             "norm": sess.norm_wire,
             "seq": resume_seq,
+            "wire": 2,
         })
         while sess.buffer:
             self._enqueue(new_owner, sess.buffer.popleft())

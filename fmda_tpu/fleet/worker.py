@@ -469,6 +469,23 @@ class FleetWorker:
 
     def _apply(self, msg: dict) -> None:
         kind = msg.get("kind")
+        wire_v = int(msg.get("wire", 0))
+        if kind == "tick_block" or wire_v >= 2:
+            # v2 evidence: only a v2 router sends columnar tick blocks
+            # or stamps ``wire: 2`` into its control messages — results
+            # may flow back as columnar blocks from here on (a pre-v2
+            # router, which could not parse them, never shows either
+            # signal, so it keeps getting per-tick dicts)
+            self.gateway.result_blocks = True
+        elif wire_v < 2 and kind in (
+                "open", "drain_session", "report_sessions"):
+            # DOWNGRADE evidence: these are exactly the kinds a v2
+            # router always stamps, so their absence means the live
+            # router is pre-v2 — a takeover by an older binary while
+            # this worker kept serving (docs/chaos.md) must roll the
+            # result dialect back or every multi-tick flush would be
+            # dropped as foreign records on the other end
+            self.gateway.result_blocks = False
         if kind == "tick":
             self._on_tick(msg)
         elif kind == "tick_block":

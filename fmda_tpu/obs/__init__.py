@@ -21,6 +21,7 @@ scrape).  The pieces:
 Architecture and metric vocabulary: docs/observability.md.
 """
 
+from fmda_tpu.obs.aggregate import FleetAggregator, FleetTelemetry
 from fmda_tpu.obs.events import EventLog
 from fmda_tpu.obs.observability import (
     Observability,
@@ -37,7 +38,9 @@ from fmda_tpu.obs.registry import (
     MetricsRegistry,
     default_registry,
 )
+from fmda_tpu.obs.recorder import FlightRecorder
 from fmda_tpu.obs.server import MetricsServer
+from fmda_tpu.obs.slo import SLOEngine
 from fmda_tpu.obs.trace import (
     Span,
     TraceRef,
@@ -46,16 +49,22 @@ from fmda_tpu.obs.trace import (
     default_tracer,
     tracer_families,
 )
+from fmda_tpu.obs.tsdb import TimeSeriesStore
 
 __all__ = [
     "Counter",
     "EventLog",
+    "FleetAggregator",
+    "FleetTelemetry",
+    "FlightRecorder",
     "Gauge",
     "LatencyHistogram",
     "MetricsRegistry",
     "MetricsServer",
     "Observability",
+    "SLOEngine",
     "Span",
+    "TimeSeriesStore",
     "TraceRef",
     "Tracer",
     "configure_tracing",

@@ -1,0 +1,184 @@
+"""The flight recorder: bounded, rotated postmortem bundles.
+
+When an SLO alert fires (or chaos injects a fault), the evidence an
+operator needs is *volatile*: the span ring evicts, the event ring
+wraps, the time-series window slides, and by the time a human looks the
+breach has scrolled away.  :class:`FlightRecorder` freezes all of it the
+moment the trigger fires:
+
+``postmortem_<seq>_<reason>/``
+    - ``meta.json``     — reason, trigger detail, stamps, alert state;
+    - ``trace.json``    — the tracer's span ring as Chrome/Perfetto
+      ``trace_event`` JSON (load at https://ui.perfetto.dev or feed
+      ``python -m fmda_tpu trace --input``);
+    - ``snapshot.json`` — the full registry snapshot (every counter/
+      gauge/histogram at trigger time);
+    - ``tsdb.json``     — the time-series window (rates + per-interval
+      latency summaries) covering the run-up to the trigger;
+    - ``events.jsonl``  — the event-log tail;
+    - ``workers.json``  — per-worker stats (heartbeat-carried serving
+      counters, wire frame stats) when a fleet context supplies them.
+
+Bundles are **bounded and rotated**: at most ``keep`` on disk (oldest
+deleted), with a per-reason debounce so a flapping alert cannot write
+the disk full.  Every write is best-effort — a full disk degrades the
+postmortem, never the serving loop that triggered it.
+
+jax-free (router-role code); reads pass through the injected callables
+so the recorder never imports the subsystems it dumps.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import time
+from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("fmda_tpu.obs")
+
+
+def _safe(reason: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_" else "-" for c in reason)
+
+
+class FlightRecorder:
+    """Dumps the observability plane's volatile state on demand."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        keep: int = 4,
+        min_interval_s: float = 60.0,
+        window_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        store=None,
+        events=None,
+        tracer=None,
+        snapshot_fn: Optional[Callable[[], dict]] = None,
+        workers_fn: Optional[Callable[[], dict]] = None,
+    ) -> None:
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = directory
+        self.keep = keep
+        self.min_interval_s = min_interval_s
+        self.window_s = window_s
+        self.clock = clock
+        self.store = store
+        self.events = events
+        self.tracer = tracer
+        self.snapshot_fn = snapshot_fn
+        self.workers_fn = workers_fn
+        #: reason -> clock stamp of its last bundle (the debounce)
+        self._last: Dict[str, float] = {}
+        self._seq = 0
+        self.triggered_total = 0
+        self.debounced_total = 0
+
+    # -- trigger ------------------------------------------------------------
+
+    def trigger(
+        self,
+        reason: str,
+        detail: Optional[dict] = None,
+        now: Optional[float] = None,
+    ) -> Optional[str]:
+        """Write one bundle; returns its path, or None when debounced
+        (or the write failed — counted + logged, never raised: the
+        recorder must not crash the loop that fired it)."""
+        now = self.clock() if now is None else now
+        last = self._last.get(reason)
+        if last is not None and now - last < self.min_interval_s:
+            self.debounced_total += 1
+            return None
+        self._last[reason] = now
+        self._seq += 1
+        name = f"postmortem_{self._seq:04d}_{_safe(reason)}"
+        path = os.path.join(self.directory, name)
+        try:
+            os.makedirs(path, exist_ok=True)
+            self._write(path, reason, detail, now)
+            self._rotate()
+        except OSError as e:
+            log.error("flight recorder: bundle %s failed: %s", name, e)
+            return None
+        self.triggered_total += 1
+        log.warning("flight recorder: postmortem bundle %s (%s)",
+                    path, reason)
+        return path
+
+    def _write(self, path: str, reason: str, detail: Optional[dict],
+               now: float) -> None:
+        meta = {
+            "reason": reason,
+            "detail": detail or {},
+            "monotonic": now,
+            "unix_ts": time.time(),
+            "window_s": self.window_s,
+        }
+        self._dump_json(path, "meta.json", meta)
+        if self.tracer is not None:
+            self._dump_json(path, "trace.json", self.tracer.chrome())
+        if self.snapshot_fn is not None:
+            self._guarded(path, "snapshot.json",
+                          lambda: self._dump_json(
+                              path, "snapshot.json", self.snapshot_fn()))
+        if self.store is not None:
+            self._guarded(path, "tsdb.json",
+                          lambda: self._dump_json(
+                              path, "tsdb.json",
+                              self.store.dump(window_s=self.window_s,
+                                              now=now)))
+        if self.events is not None:
+            self._guarded(path, "events.jsonl",
+                          lambda: self._dump_text(
+                              path, "events.jsonl", self.events.to_jsonl()))
+        if self.workers_fn is not None:
+            self._guarded(path, "workers.json",
+                          lambda: self._dump_json(
+                              path, "workers.json", self.workers_fn()))
+
+    def _guarded(self, path: str, name: str, fn) -> None:
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 — one dead source (a
+            # closed warehouse, an unserialisable stat) degrades that
+            # file, never the rest of the bundle
+            log.warning("flight recorder: %s/%s skipped: %s",
+                        os.path.basename(path), name, e)
+
+    @staticmethod
+    def _dump_json(path: str, name: str, doc) -> None:
+        with open(os.path.join(path, name), "w") as fh:
+            json.dump(doc, fh, indent=2, default=str)
+            fh.write("\n")
+
+    @staticmethod
+    def _dump_text(path: str, name: str, text: str) -> None:
+        with open(os.path.join(path, name), "w") as fh:
+            fh.write(text)
+
+    # -- rotation -----------------------------------------------------------
+
+    def bundles(self) -> List[str]:
+        """Bundle paths on disk, oldest first (by sequence in the name)."""
+        try:
+            names = sorted(
+                n for n in os.listdir(self.directory)
+                if n.startswith("postmortem_"))
+        except OSError:
+            return []
+        return [os.path.join(self.directory, n) for n in names]
+
+    def _rotate(self) -> None:
+        bundles = self.bundles()
+        for path in bundles[:max(0, len(bundles) - self.keep)]:
+            try:
+                shutil.rmtree(path)
+            except OSError as e:
+                log.warning("flight recorder: rotate %s failed: %s",
+                            path, e)

@@ -226,6 +226,12 @@ class LatencyHistogram:
                 "max_s": self.max_s,
                 "p50_s": self._percentile_locked(50),
                 "p99_s": self._percentile_locked(99),
+                # raw bin counts ride the sample so a scraped /snapshot
+                # stays MERGEABLE: the fleet aggregator diffs cumulative
+                # snapshots into window distributions and folds them
+                # across workers (fmda_tpu.obs.tsdb/aggregate) — the
+                # summary quantiles above cannot be merged after the fact
+                "counts": list(self.counts),
             }
 
 

@@ -13,7 +13,10 @@ No third-party web framework — five fixed routes on a daemonised
   ``?trace_id=...`` narrows it to one trace's events;
 - ``/trace``    — the span ring as Chrome/Perfetto ``trace_event`` JSON
   (load at https://ui.perfetto.dev, or feed
-  ``python -m fmda_tpu trace --endpoint``).
+  ``python -m fmda_tpu trace --endpoint``);
+- ``/query``    — time-series range queries (``?series=&window=``) when
+  a fleet telemetry handle is attached (fmda_tpu.obs.aggregate);
+- ``/alerts``   — the SLO engine's alert document (fmda_tpu.obs.slo).
 
 A handler exception yields an HTTP 500 with a JSON ``{"error": ...}``
 body — never a half-written response — and the serving thread survives.
@@ -52,11 +55,15 @@ class MetricsServer:
         health_fn: Optional[Callable[[], dict]] = None,
         events: Optional[EventLog] = None,
         tracer: Optional[Tracer] = None,
+        query_fn: Optional[Callable[..., dict]] = None,
+        alerts_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.registry = registry
         self.health_fn = health_fn
         self.events = events
         self.tracer = tracer
+        self.query_fn = query_fn
+        self.alerts_fn = alerts_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -118,6 +125,30 @@ class MetricsServer:
                             server.events.to_jsonl(
                                 trace_id=trace_id).encode(),
                             "application/x-ndjson")
+                    elif path == "/query" and server.query_fn is not None:
+                        params = parse_qs(query)
+                        series = params.get("series", [None])[0]
+                        if not series:
+                            self._send(
+                                400,
+                                json.dumps({
+                                    "error": "missing ?series=",
+                                    "path": self.path}).encode(),
+                                "application/json")
+                            return
+                        window = params.get("window", [None])[0]
+                        doc = server.query_fn(
+                            series,
+                            float(window) if window else None)
+                        self._send(
+                            200, json.dumps(doc).encode(),
+                            "application/json")
+                    elif path == "/alerts" and server.alerts_fn is not None:
+                        self._send(
+                            200,
+                            json.dumps(server.alerts_fn(),
+                                       indent=2).encode(),
+                            "application/json")
                     elif path == "/trace":
                         doc = (
                             server.tracer.chrome()

@@ -73,6 +73,7 @@ from fmda_tpu.runtime.session_pool import (
     SessionPool,
 )
 from fmda_tpu.serve.predictor import labels_over_threshold
+from fmda_tpu.stream import codec
 
 log = logging.getLogger("fmda_tpu.runtime")
 
@@ -170,6 +171,13 @@ class FleetGateway:
         #: dispatch, so device-side work lands in a --jax-profile capture
         #: as numbered pool_flush steps (serve-fleet --jax-profile DIR)
         self.annotate_device_steps = False
+        #: publish whole flushes as columnar ``result_block`` messages
+        #: (fmda_tpu.stream.codec.pack_results) instead of per-tick
+        #: dicts.  Off by default: only a consumer that understands
+        #: blocks may turn this on — the fleet worker does, once the
+        #: router has proven itself v2 (ISSUE 13; in-process consumers
+        #: of the prediction topic keep the per-tick shape).
+        self.result_blocks = False
         self._flush_idx = 0
 
     # -- admission ----------------------------------------------------------
@@ -525,12 +533,31 @@ class FleetGateway:
             if messages:
                 # one batched publish per flush: one lock acquisition /
                 # native call sequence instead of per-tick bus overhead
+                wire_msgs = messages
+                if self.result_blocks and len(messages) > 1:
+                    # the whole flush as ONE columnar block: a (B, C)
+                    # f32 probability array + dictionary-encoded ids
+                    # instead of B dicts boxing a few floats each —
+                    # bit-identical on decode (wire tests assert it).
+                    # An unpackable flush (a >63-label vocabulary, a
+                    # mixed threshold) degrades to the always-correct
+                    # per-tick dialect, counted — the state advance
+                    # behind these results is irreversible, so packing
+                    # must never be the reason they are lost
+                    try:
+                        wire_msgs = [
+                            codec.pack_results(messages, self.y_fields)]
+                    except codec.CodecError as e:
+                        self.metrics.count("result_pack_errors")
+                        log.warning(
+                            "result-block packing failed (%s) — "
+                            "publishing the per-tick dialect", e)
                 t_pub0_ns = now_ns() if tracing else 0
                 try:
                     if self._publish_many is not None:
-                        self._publish_many(self.prediction_topic, messages)
+                        self._publish_many(self.prediction_topic, wire_msgs)
                     else:
-                        for msg in messages:
+                        for msg in wire_msgs:
                             self.bus.publish(self.prediction_topic, msg)
                 except Exception:
                     # the transport failed AFTER the state advance —

@@ -54,7 +54,12 @@ class FleetLoadConfig:
     slow_duty: float = 0.05
 
 
-def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
+def run_fleet_load(
+    gateway,
+    load: Optional[FleetLoadConfig] = None,
+    *,
+    on_round=None,
+) -> Dict:
     """Run the synthetic fleet to completion; returns a result dict with
     throughput, per-stage latency summaries, and the loss counters.
 
@@ -63,6 +68,10 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
     :class:`~fmda_tpu.fleet.router.FleetRouter` fronting a multi-host
     topology (same open/submit/pump/drain surface; results then arrive
     asynchronously and ``drain`` blocks until the fleet answers).
+
+    ``on_round`` (optional) is called with the round index after each
+    round's pump — the fleet-telemetry fold rides here (cadence-gated
+    inside, so the cost when not due is one clock read).
     """
     load = load or FleetLoadConfig()
     pool = getattr(gateway, "pool", None)
@@ -131,6 +140,8 @@ def run_fleet_load(gateway, load: Optional[FleetLoadConfig] = None) -> Dict:
             gateway.submit(session_ids[i], walk[i])
             submitted += 1
         served += len(gateway.pump())
+        if on_round is not None:
+            on_round(r)
     served += len(gateway.drain())
     wall_s = time.perf_counter() - t0
 

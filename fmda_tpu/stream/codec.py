@@ -522,6 +522,98 @@ def coalesce_ticks(msgs: List[dict]) -> List[dict]:
 
 
 # ---------------------------------------------------------------------------
+# columnar result blocks (the return path's mirror of tick blocks)
+# ---------------------------------------------------------------------------
+
+
+def pack_results(msgs: Sequence[dict], label_vocab: Sequence[str]) -> dict:
+    """A run of per-tick result messages as ONE columnar block.
+
+    ``msgs`` are the gateway's published results
+    (``{"session", "seq", "probabilities", "pred_labels",
+    "prob_threshold"[, "trace"]}``).  The block stacks probabilities
+    into one contiguous ``(B, C)`` float32 array (bit-exact: the
+    per-tick path's float64 boxing of float32 values round-trips
+    exactly, so both dialects hand back identical bits), seqs into one
+    int64 column, dictionary-encodes session ids, and packs each
+    result's label set as a bitmask over ``label_vocab`` — the
+    gateway's ``y_fields``, whose order IS the per-tick label order, so
+    decode reproduces the exact label lists.  The threshold is uniform
+    per flush and stored once."""
+    probs = np.asarray(
+        [m["probabilities"] for m in msgs], np.float32)
+    vid = {lab: j for j, lab in enumerate(label_vocab)}
+    if len(vid) > 63:
+        raise CodecError(
+            f"label vocabulary of {len(vid)} does not fit an i64 mask")
+    uniq: Dict[str, int] = {}
+    ids: List[str] = []
+    idx: List[int] = []
+    seqs: List[int] = []
+    masks: List[int] = []
+    threshold = float(msgs[0]["prob_threshold"])
+    for m in msgs:
+        s = m["session"]
+        j = uniq.get(s)
+        if j is None:
+            j = uniq[s] = len(ids)
+            ids.append(s)
+        idx.append(j)
+        seqs.append(m["seq"])
+        if float(m["prob_threshold"]) != threshold:
+            raise CodecError(
+                "result run mixes prob_threshold values — not packable")
+        mask = 0
+        for lab in m["pred_labels"]:
+            bit = vid.get(lab)
+            if bit is None:
+                raise CodecError(
+                    f"label {lab!r} is not in the block vocabulary")
+            mask |= 1 << bit
+        masks.append(mask)
+    block = {
+        "kind": "result_block",
+        "ids": ids,
+        "idx": np.asarray(idx, np.int32),
+        "seqs": np.asarray(seqs, np.int64),
+        "probs": probs,
+        "labels": list(label_vocab),
+        "masks": np.asarray(masks, np.int64),
+        "prob_threshold": threshold,
+    }
+    traces = [m.get("trace") for m in msgs]
+    if any(t is not None for t in traces):
+        block["traces"] = traces
+    return block
+
+
+def iter_results(block: dict) -> Iterator[dict]:
+    """Per-result messages (the per-tick wire shape) out of a block.
+    Probability rows are views into the block's contiguous array —
+    zero copy on a binary link, same bits on either dialect."""
+    ids = block["ids"]
+    idx = np.asarray(block["idx"]).tolist()
+    probs = np.asarray(block["probs"], np.float32)
+    seqs = np.asarray(block["seqs"]).tolist()
+    masks = np.asarray(block["masks"]).tolist()
+    vocab = list(block["labels"])
+    threshold = block["prob_threshold"]
+    traces = block.get("traces")
+    for i, j in enumerate(idx):
+        msg = {
+            "session": ids[j],
+            "seq": seqs[i],
+            "probabilities": probs[i],
+            "pred_labels": [
+                lab for b, lab in enumerate(vocab) if masks[i] >> b & 1],
+            "prob_threshold": threshold,
+        }
+        if traces is not None and traces[i] is not None:
+            msg["trace"] = traces[i]
+        yield msg
+
+
+# ---------------------------------------------------------------------------
 # packed row columns (the warehouse journal's binary record layout)
 # ---------------------------------------------------------------------------
 

@@ -755,3 +755,80 @@ def test_committed_drift_artifact_matches_live_scan(repo_lint_result):
         "committed drift artifact out of sync with a live scanner run — "
         "regenerate it:\n  python -m fmda_tpu lint --drift-report "
         "artifacts/jax_api_drift.json")
+
+
+# ---------------------------------------------------------------------------
+# metric-names (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+METRICS_TP = """\
+def wire(registry):
+    registry.counter("fmda_double_prefixed_total")
+    registry.gauge("bad-name")
+    registry.counter("two_kinds")
+    registry.gauge("two_kinds")
+    registry.counter("split_series_total", topic="x")
+    registry.counter("split_series_total", stream="x")
+"""
+
+METRICS_TN = """\
+def wire(registry, metrics):
+    registry.counter("requests_total")
+    registry.counter("requests_total")  # same site shape: no conflict
+    registry.gauge("queue_depth", process="w0")
+    registry.gauge("queue_depth", process="w1")  # same key set
+    registry.histogram("request_seconds")
+    # RuntimeMetrics-style value setters (two positionals) are a
+    # different vocabulary — not a registry registration
+    metrics.gauge("active_sessions", 3)
+    name = "dynamic"
+    registry.counter(name)  # dynamic names are skipped
+
+def collector():
+    return {"counters": [
+        {"name": "emitted_total", "labels": {}, "value": 1},
+        {"name": "emitted_total", "labels": {}, "value": 2},
+        {"name": f"{'x'}_total", "labels": {}, "value": 3},  # dynamic
+    ]}
+"""
+
+
+def test_metric_names_flags_bad_registrations():
+    from fmda_tpu.analysis import MetricNamesRule
+
+    findings, _, _ = run_on(MetricNamesRule(), {"mod.py": METRICS_TP})
+    msgs = [f.message for f in findings]
+    assert any("fmda_double_prefixed_total" in m and "prefix" in m
+               for m in msgs)
+    assert any("bad-name" in m and "grammar" in m for m in msgs)
+    assert any("two_kinds" in m and "instrument kinds" in m for m in msgs)
+    assert any("split_series_total" in m and "label-key" in m
+               for m in msgs)
+    assert len(findings) == 4
+
+
+def test_metric_names_clean_paths_and_report():
+    from fmda_tpu.analysis import MetricNamesRule
+
+    findings, _, ctx = run_on(MetricNamesRule(), {"mod.py": METRICS_TN})
+    assert findings == []
+    report = ctx.reports["metric_names"]
+    assert "requests_total" in report["names"]
+    assert "emitted_total" in report["names"]
+    assert "active_sessions" not in report["names"]  # value setter
+
+
+def test_metric_names_sample_vs_call_label_mismatch_flags():
+    from fmda_tpu.analysis import MetricNamesRule
+
+    src = (
+        "def a(registry):\n"
+        "    registry.counter('served_total', topic='x')\n"
+        "def b():\n"
+        "    return {'counters': [\n"
+        "        {'name': 'served_total', 'labels': {'stream': 'y'},\n"
+        "         'value': 1}]}\n"
+    )
+    findings, _, _ = run_on(MetricNamesRule(), {"mod.py": src})
+    assert len(findings) == 1
+    assert "served_total" in findings[0].message

@@ -72,6 +72,7 @@ class TestPhaseRegistry:
             "runtime_chaos_soak",
             "pipeline_chaos_soak",
             "obs_overhead",
+            "obs_aggregate_overhead",
             "trace_overhead",
             "analysis_lint",
             "wire_codec_bench",

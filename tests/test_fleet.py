@@ -22,6 +22,7 @@ from fmda_tpu.config import (
     FleetTopologyConfig,
     ModelConfig,
     RuntimeConfig,
+    TOPIC_FLEET_PREDICTION,
     fleet_topics,
 )
 from fmda_tpu.data.normalize import NormParams
@@ -850,3 +851,77 @@ def test_shared_bus_pre_v2_peer_gets_legacy_dialect():
     assert [m["kind"] for m in w0_msgs] == ["open"] + ["tick"] * 3
     assert all(isinstance(m["row"], str) for m in w0_msgs[1:])  # pre-v2
     assert "tick_block" in [m["kind"] for m in w1_msgs]  # v2 blocks
+
+
+# ---------------------------------------------------------------------------
+# columnar result blocks (ISSUE 13 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_v2_router_enables_result_blocks_and_matches_every_tick():
+    """The open's ``wire: 2`` stamp flips the worker's gateway into
+    columnar result publishing; the router expands the blocks and
+    matches every routed tick — nothing unmatched, nothing undecodable."""
+    router, workers, bus, _clock, _ = _topology(
+        ["w0"], bucket_sizes=(4,), capacity=8)
+    w = workers["w0"]
+    assert w.gateway.result_blocks is False  # until v2 evidence arrives
+    rng = np.random.default_rng(5)
+    sids = [f"T{i}" for i in range(4)]
+    for sid in sids:
+        mn = rng.normal(size=6).astype(np.float32)
+        router.open_session(sid, NormParams(mn, mn + 1.0))
+    got = {}
+    for _ in range(3):
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    for _ in range(3):
+        _cycle(router, workers.values(), got)
+    assert w.gateway.result_blocks is True
+    assert sorted(got) == sids
+    assert all(len(v) == 3 for v in got.values())
+    # the wire actually carried columnar blocks, not per-tick dicts
+    records = bus.consumer(TOPIC_FLEET_PREDICTION).poll()
+    kinds = [r.value.get("kind") for r in records]
+    assert "result_block" in kinds
+    assert router.metrics.counters.get("results_unmatched", 0) == 0
+    assert router.metrics.counters.get("results_undecodable", 0) == 0
+
+
+def test_pre_v2_router_takeover_downgrades_result_blocks():
+    """A worker that enabled columnar result blocks under a v2 router
+    rolls the dialect back the moment a pre-v2 router (no ``wire``
+    stamp on its control messages) takes over — an old router cannot
+    parse blocks, and its every open/drain proves its age."""
+    router, workers, _bus, _clock, _ = _topology(["w0"])
+    w = workers["w0"]
+    w._apply({"kind": "open", "session": "S0", "norm": None, "seq": 0,
+              "wire": 2})
+    assert w.gateway.result_blocks is True
+    # a pre-v2 router's open carries no wire field
+    w._apply({"kind": "open", "session": "S1", "norm": None, "seq": 0})
+    assert w.gateway.result_blocks is False
+    # plain per-tick messages (which v2 routers also send for short
+    # runs) are NOT downgrade evidence
+    w._apply({"kind": "tick_block", "ids": ["S0"],
+              "idx": np.zeros(2, np.int32), "seqs": np.arange(2),
+              "rows": np.zeros((2, 6), np.float32)})
+    assert w.gateway.result_blocks is True
+    w._apply({"kind": "tick", "session": "S0",
+              "row": np.zeros(6, np.float32), "seq": 2})
+    assert w.gateway.result_blocks is True
+
+
+def test_membership_rehello_without_metrics_clears_stale_url():
+    view = MembershipView(10.0, clock=lambda: 0.0)
+    view.observe({"kind": "hello", "worker": "w0",
+                  "metrics": "http://127.0.0.1:9"})
+    assert view.workers["w0"].metrics == "http://127.0.0.1:9"
+    # heartbeats without the field keep the announced URL
+    view.observe({"kind": "heartbeat", "worker": "w0"})
+    assert view.workers["w0"].metrics == "http://127.0.0.1:9"
+    # a replacement incarnation without --metrics-port clears it —
+    # the aggregator must not scrape a dead endpoint forever
+    view.observe({"kind": "hello", "worker": "w0"})
+    assert view.workers["w0"].metrics is None

@@ -181,3 +181,63 @@ def test_trace_merge_accepts_a_directory(tmp_path, capsys):
     empty.mkdir()
     assert main(["trace", "--merge", str(empty)]) == 2
     assert "no *.json trace files" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# status --watch + SLO alert integration (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def test_status_watch_refreshes_until_sigint_then_exits_zero(
+        capsys, monkeypatch):
+    import time
+
+    from fmda_tpu.cli import main
+
+    obs, srv = _serve_worker_obs("w0")
+    calls = {"n": 0}
+
+    def fake_sleep(dt):
+        # three refreshes, then the operator's Ctrl-C — no wall clock
+        calls["n"] += 1
+        if calls["n"] >= 3:
+            raise KeyboardInterrupt
+
+    monkeypatch.setattr(time, "sleep", fake_sleep)
+    try:
+        rc = main(["status", "--endpoint", f"127.0.0.1:{srv.port}",
+                   "--watch", "5"])
+        out = capsys.readouterr().out
+        assert rc == 0  # SIGINT is a clean exit, not an error
+        assert out.count("status: ok") == 3
+        assert "every 5s" in out
+    finally:
+        obs.close()
+
+
+def test_status_against_telemetry_endpoint_shows_alerts_and_exit_code(
+        capsys):
+    from fmda_tpu.cli import main
+    from fmda_tpu.config import SLOConfig
+    from fmda_tpu.obs import FleetTelemetry
+
+    telemetry = FleetTelemetry(SLOConfig())
+    server = telemetry.start_server(port=0)
+    try:
+        rc = main(["status", "--endpoint", f"127.0.0.1:{server.port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "slo alerts" not in out  # nothing evaluated yet: no table
+        # a firing alert degrades /healthz AND prints in the table
+        telemetry.slo._alerts["latency_p99"] = {
+            "objective": "latency_p99", "state": "firing",
+            "burn_fast": 9.0, "burn_slow": 4.0, "burn_threshold": 2.0,
+            "budget": 0.05, "detail": "ticks over 250ms e2e",
+            "since": 0.0}
+        rc = main(["status", "--endpoint", f"127.0.0.1:{server.port}"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "FIRE latency_p99" in out
+        assert "slo_alerts" in out  # the health check names the breach
+    finally:
+        server.stop()

@@ -415,3 +415,131 @@ def test_frame_stats_and_wire_metrics_collector():
     finally:
         cli.close()
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# columnar result blocks (ISSUE 13 satellite: the return path's mirror
+# of tick blocks — bit-identity asserted over BOTH wire dialects)
+# ---------------------------------------------------------------------------
+
+Y_FIELDS = ("up1", "up2", "down1", "down2")
+
+
+def _result_msgs(n=7, pool=3, seed=3):
+    rng = np.random.default_rng(seed)
+    msgs = []
+    for i in range(n):
+        p = rng.random(len(Y_FIELDS)).astype(np.float32)
+        labs = [lab for lab, v in zip(Y_FIELDS, p) if v >= 0.5]
+        msg = {
+            "session": f"T{i % pool}",
+            "seq": i,
+            # the per-tick dialect boxes float32 values as python
+            # floats — the f32->f64->f32 round trip is exact, which is
+            # what makes the block's raw-f32 column bit-identical
+            "probabilities": [float(v) for v in p],
+            "pred_labels": labs,
+            "prob_threshold": 0.5,
+        }
+        if i % 2:
+            msg["trace"] = f"{i:016x}:{i:016x}"
+        msgs.append(msg)
+    return msgs
+
+
+def _assert_results_equal(expanded, msgs):
+    assert len(expanded) == len(msgs)
+    for got, want in zip(expanded, msgs):
+        assert got["session"] == want["session"]
+        assert got["seq"] == want["seq"]
+        assert got["pred_labels"] == want["pred_labels"]
+        assert got["prob_threshold"] == want["prob_threshold"]
+        assert got.get("trace") == want.get("trace")
+        assert np.array_equal(
+            np.asarray(got["probabilities"], np.float32),
+            np.asarray(want["probabilities"], np.float32))
+
+
+def test_result_block_round_trip_bit_identical_both_dialects():
+    from fmda_tpu.stream import codec
+
+    msgs = _result_msgs()
+    block = codec.pack_results(msgs, Y_FIELDS)
+    assert block["kind"] == "result_block"
+    # dictionary encoding: 3 unique ids for 7 results
+    assert len(block["ids"]) == 3 and len(block["idx"]) == 7
+    for payload in (codec.encode(block), codec.dumps(block)):
+        decoded, _ = codec.decode_payload(payload)
+        _assert_results_equal(list(codec.iter_results(decoded)), msgs)
+
+
+def test_result_block_label_order_follows_vocab_not_first_seen():
+    from fmda_tpu.stream import codec
+
+    # tick 0 predicts only up2, tick 1 predicts up1+up2: a
+    # first-appearance vocabulary would decode tick 1 as
+    # ["up2", "up1"] — the y_fields vocabulary keeps the wire order
+    msgs = _result_msgs(2)
+    msgs[0]["pred_labels"] = ["up2"]
+    msgs[1]["pred_labels"] = ["up1", "up2"]
+    block = codec.pack_results(msgs, Y_FIELDS)
+    out = list(codec.iter_results(block))
+    assert out[1]["pred_labels"] == ["up1", "up2"]
+
+
+def test_result_block_rejects_unpackable_runs():
+    from fmda_tpu.stream import codec
+
+    msgs = _result_msgs(3)
+    msgs[1]["prob_threshold"] = 0.7
+    with pytest.raises(codec.CodecError, match="prob_threshold"):
+        codec.pack_results(msgs, Y_FIELDS)
+    msgs = _result_msgs(3)
+    msgs[2]["pred_labels"] = ["not_a_field"]
+    with pytest.raises(codec.CodecError, match="vocabulary"):
+        codec.pack_results(msgs, Y_FIELDS)
+
+
+def test_result_block_crosses_served_bus_intact(served_bus):
+    from fmda_tpu.stream import codec
+
+    bus, server = served_bus
+    msgs = _result_msgs()
+    block = codec.pack_results(msgs, Y_FIELDS)
+    cli = _connect(server)
+    try:
+        cli.publish("alpha", block)
+        [rec] = cli.read("alpha", 0)
+        _assert_results_equal(list(codec.iter_results(rec.value)), msgs)
+    finally:
+        cli.close()
+
+
+def test_router_fold_results_expands_blocks():
+    """The router decodes a ``result_block`` into per-tick FleetResults
+    (bit-identical probabilities); a malformed block is counted
+    ``results_undecodable``, never a crash."""
+    from fmda_tpu.config import DEFAULT_TOPICS, fleet_topics
+    from fmda_tpu.fleet.router import FleetRouter
+    from fmda_tpu.stream import codec
+
+    bus = InProcessBus(tuple(DEFAULT_TOPICS) + fleet_topics(["w0"]))
+    router = FleetRouter(bus, n_features=4)
+    msgs = _result_msgs()
+    block = codec.pack_results(msgs, Y_FIELDS)
+    results = router._fold_results([(0, block)])
+    assert len(results) == len(msgs)
+    for res, want in zip(results, msgs):
+        assert res.session_id == want["session"]
+        assert res.seq == want["seq"]
+        assert tuple(res.labels) == tuple(want["pred_labels"])
+        assert np.array_equal(
+            res.probabilities,
+            np.asarray(want["probabilities"], np.float32))
+    # results this router never routed are unmatched, not fatal
+    assert router.metrics.counters["results_unmatched"] == len(msgs)
+    bad = dict(block)
+    del bad["probs"]
+    out = router.metrics.counters.get("results_undecodable", 0)
+    assert router._fold_results([(1, bad)]) == []
+    assert router.metrics.counters["results_undecodable"] == out + 1

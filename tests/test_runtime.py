@@ -862,3 +862,79 @@ def test_serve_fleet_cli_serial_matches_default(capsys):
     assert outs[0]["ticks_served"] == outs[1]["ticks_served"] == 18
     assert outs[0]["counters"].get("overlapped_flushes", 0) > 0
     assert outs[1]["counters"].get("overlapped_flushes", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# columnar result blocks (ISSUE 13 satellite): A/B bit identity
+# ---------------------------------------------------------------------------
+
+
+def test_result_block_dialect_bit_identical_to_per_tick():
+    """The same load served twice — per-tick result dicts vs columnar
+    result blocks — must put byte-identical information on the bus:
+    same sessions/seqs/labels/threshold, probability bits equal."""
+    from fmda_tpu.stream import codec
+
+    def run(result_blocks):
+        cfg, params = _setup(feats=6, hidden=5, window=4, seed=0)
+        pool = SessionPool(cfg, params, capacity=4, window=4)
+        bus = InProcessBus(DEFAULT_TOPICS)
+        gateway = FleetGateway(
+            pool, bus,
+            batcher_config=BatcherConfig(bucket_sizes=(4,),
+                                         max_linger_s=0.0))
+        gateway.result_blocks = result_blocks
+        rng = np.random.default_rng(7)
+        sids = [f"T{i}" for i in range(4)]
+        for i, sid in enumerate(sids):
+            mn = rng.normal(size=6).astype(np.float32)
+            gateway.open_session(sid, NormParams(mn, mn + 1.0))
+        for _ in range(5):
+            for sid in sids:
+                gateway.submit(sid, rng.normal(size=6).astype(np.float32))
+            gateway.pump(force=True)
+        gateway.drain()
+        flat = []
+        for rec in bus.consumer(TOPIC_FLEET_PREDICTION).poll():
+            v = rec.value
+            if v.get("kind") == "result_block":
+                flat.extend(codec.iter_results(v))
+            else:
+                flat.append(v)
+        return flat
+
+    per_tick = run(False)
+    blocked = run(True)
+    assert len(per_tick) == len(blocked) == 20
+    for a, b in zip(per_tick, blocked):
+        assert a["session"] == b["session"] and a["seq"] == b["seq"]
+        assert a["pred_labels"] == list(b["pred_labels"])
+        assert a["prob_threshold"] == b["prob_threshold"]
+        assert np.array_equal(
+            np.asarray(a["probabilities"], np.float32),
+            np.asarray(b["probabilities"], np.float32))
+
+
+def test_unpackable_result_run_degrades_to_per_tick_counted():
+    """A flush the block codec cannot carry (>63-label vocabulary)
+    publishes the per-tick dialect instead — counted, never lost (the
+    state advance behind the results is irreversible)."""
+    cfg, params = _setup(feats=6, hidden=5, window=4)
+    pool = SessionPool(cfg, params, capacity=4, window=4)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    gateway = FleetGateway(
+        pool, bus,
+        batcher_config=BatcherConfig(bucket_sizes=(4,), max_linger_s=0.0),
+        y_fields=tuple(f"lab{i}" for i in range(70)))
+    gateway.result_blocks = True
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        gateway.open_session(f"T{i}")
+    for i in range(3):
+        gateway.submit(f"T{i}", rng.normal(size=6).astype(np.float32))
+    results = gateway.pump(force=True)
+    assert len(results) == 3
+    assert gateway.metrics.counters["result_pack_errors"] == 1
+    records = bus.consumer(TOPIC_FLEET_PREDICTION).poll()
+    assert len(records) == 3  # per-tick dicts, not a block
+    assert all(r.value.get("kind") is None for r in records)

@@ -1,0 +1,476 @@
+"""fmda_tpu.obs fleet telemetry (ISSUE 13): aggregation, SLO burn-rate
+alerts, the flight recorder, and the range endpoints.
+
+The acceptance test at the bottom is the ISSUE's contract: a chaos run
+with an injected latency fault fires the latency SLO burn-rate alert,
+produces a flight-recorder bundle whose Perfetto dump loads and whose
+tsdb window shows the breach, and the alert clears after recovery —
+fully deterministic (seeded fault plan + data, every clock injected,
+the chaos delay advances a FAKE clock: zero wall-clock sleeps).
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu.chaos.inject import configure_chaos, default_chaos
+from fmda_tpu.chaos.plan import FaultEvent, FaultPlan
+from fmda_tpu.config import DEFAULT_TOPICS, ModelConfig, SLOConfig
+from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.obs import (
+    EventLog,
+    FleetAggregator,
+    FleetTelemetry,
+    FlightRecorder,
+    LatencyHistogram,
+    SLOEngine,
+    TimeSeriesStore,
+    configure_tracing,
+)
+from fmda_tpu.obs.slo import (
+    SERIES_E2E,
+    SERIES_LOSS,
+    SERIES_TICKS,
+    bad_fraction_above,
+)
+from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+from fmda_tpu.runtime.metrics import RuntimeMetrics
+from fmda_tpu.stream import InProcessBus
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeMembership:
+    def __init__(self):
+        self.workers = {}
+
+    def __len__(self):
+        return len(self.workers)
+
+    def live(self):
+        return sorted(self.workers)
+
+
+class FakeRouter:
+    """Duck-typed FleetRouter surface the aggregator reads."""
+
+    def __init__(self):
+        self.metrics = RuntimeMetrics()
+        self.membership = FakeMembership()
+        self.stats = {}
+
+    def worker_stats(self):
+        return self.stats
+
+
+def _slo_cfg(**over):
+    base = dict(
+        interval_s=1.0, retention_s=600.0, scrape_interval_s=1.0,
+        fast_window_s=8.0, slow_window_s=24.0, burn_threshold=2.0,
+        latency_p99_ms=100.0, latency_budget=0.05, loss_budget=0.01,
+        journal_depth=100, journal_budget=0.1,
+        degraded_feed_budget_minutes=0.05)
+    base.update(over)
+    return SLOConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_observe_router_folds_stats_and_histograms():
+    clock = FakeClock()
+    store = TimeSeriesStore(interval_s=1.0, capacity=64, clock=clock)
+    agg = FleetAggregator(store, clock=clock)
+    router = FakeRouter()
+    router.stats = {"w0": {"ticks_served": 0, "queue_depth": 2,
+                           "active_sessions": 3, "inbox_records_lost": 0}}
+    router.membership.workers["w0"] = SimpleNamespace(metrics=None)
+    for step in range(5):
+        clock.t = float(step)
+        router.metrics.count("results_received", 10)
+        router.metrics.observe("total", 0.01)
+        router.metrics.gauge("inflight_ticks", step)
+        router.stats["w0"]["ticks_served"] += 10
+        agg.observe_router(router)
+    assert store.points(SERIES_TICKS)[-1][1] == 10.0  # rate/s
+    assert store.points("worker_ticks_served_total",
+                        labels={"process": "w0"})[-1][1] == 10.0
+    assert store.points("fleet_workers_live")[-1][1] == 1.0
+    assert store.window_histogram(SERIES_E2E, window_s=10.0, now=4.5).n == 5
+
+
+def test_observe_snapshot_labels_by_process_and_keeps_hist_mergeable():
+    clock = FakeClock()
+    store = TimeSeriesStore(interval_s=1.0, capacity=16, clock=clock)
+    agg = FleetAggregator(store, clock=clock)
+    h0, h1 = LatencyHistogram("lat"), LatencyHistogram("lat")
+    for _ in range(10):
+        h0.observe(0.001)
+        h1.observe(0.9)
+    for proc, h in (("w0", h0), ("w1", h1)):
+        agg.observe_snapshot(proc, {
+            "counters": [{"name": "served_total", "labels": {},
+                          "value": 10}],
+            "gauges": [{"name": "depth", "labels": {}, "value": 1}],
+            "histograms": [h.sample()],
+        }, now=1.0)
+    # the registry sample carries raw bin counts (ISSUE 13), so the
+    # scraped distributions merge exactly across workers
+    merged = store.window_histogram("lat", window_s=10.0, now=1.5)
+    assert merged.n == 20
+    assert merged.percentile(99) >= 0.9
+    assert store.points("depth", labels={"process": "w0"}) == [(1.0, 1.0)]
+
+
+def test_maybe_collect_is_cadence_gated_and_scrapes_on_its_own_cadence():
+    clock = FakeClock()
+    scraped = []
+    telemetry = FleetTelemetry(
+        _slo_cfg(interval_s=1.0, scrape_interval_s=3.0), clock=clock,
+        scrape_fn=lambda wid, url: scraped.append((wid, url)))
+    router = FakeRouter()
+    router.membership.workers["w0"] = SimpleNamespace(
+        metrics="http://127.0.0.1:1")
+    assert telemetry.maybe_collect(router) is True
+    assert telemetry.maybe_collect(router) is False  # same interval
+    clock.advance(0.5)
+    assert telemetry.maybe_collect(router) is False
+    clock.advance(0.6)
+    assert telemetry.maybe_collect(router) is True
+    # scrape cadence is slower than the fold cadence
+    assert scraped == [("w0", "http://127.0.0.1:1")]
+    clock.advance(3.1)
+    telemetry.maybe_collect(router)
+    assert len(scraped) == 2
+
+
+def test_scrape_failure_is_counted_never_raised():
+    clock = FakeClock()
+    store = TimeSeriesStore(interval_s=1.0, capacity=8, clock=clock)
+    agg = FleetAggregator(store, clock=clock)
+    assert agg.scrape("w0", "127.0.0.1:1", timeout_s=0.05) is False
+    assert agg.scrape_errors == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO objectives beyond latency
+# ---------------------------------------------------------------------------
+
+
+def test_loss_ratio_objective_fires_and_clears():
+    clock = FakeClock()
+    cfg = _slo_cfg(loss_budget=0.01)
+    store = TimeSeriesStore(interval_s=1.0, capacity=64, clock=clock)
+    ev = EventLog()
+    slo = SLOEngine(cfg, store, events=ev, clock=clock)
+    ticks = losses = 0
+    saw_fire = saw_clear = False
+    for step in range(50):
+        clock.t = float(step)
+        ticks += 100
+        if 10 <= step < 20:
+            losses += 10  # 9% loss vs 1% budget
+        store.record_counter(SERIES_TICKS, float(ticks))
+        store.record_counter(SERIES_LOSS, float(losses))
+        slo.evaluate()
+        state = slo.alerts()["alerts"]["loss_ratio"]["state"]
+        saw_fire = saw_fire or state == "firing"
+        saw_clear = saw_clear or (saw_fire and state == "ok")
+    assert saw_fire and saw_clear
+    kinds = [e["kind"] for e in ev.tail()]
+    assert "slo.alert_fired" in kinds and "slo.alert_resolved" in kinds
+
+
+def test_journal_depth_objective_reads_worker_gauges():
+    clock = FakeClock()
+    cfg = _slo_cfg(journal_depth=100, journal_budget=0.1)
+    store = TimeSeriesStore(interval_s=1.0, capacity=64, clock=clock)
+    slo = SLOEngine(cfg, store, clock=clock)
+    for step in range(30):
+        clock.t = float(step)
+        depth = 5000 if step >= 10 else 0
+        store.record_gauge("warehouse_journal_pending", depth,
+                           process="w0")
+        slo.evaluate()
+    assert slo.alerts()["alerts"]["journal_depth"]["state"] == "firing"
+    assert "journal_depth" in slo.firing()
+
+
+def test_no_data_means_no_alert():
+    clock = FakeClock()
+    slo = SLOEngine(_slo_cfg(), TimeSeriesStore(
+        interval_s=1.0, capacity=8, clock=clock), clock=clock)
+    alerts = slo.evaluate()
+    assert all(a["state"] == "ok" for a in alerts.values())
+    ok, _ = slo.health_check()
+    assert ok
+
+
+def test_bad_fraction_above_is_bin_deterministic():
+    h = LatencyHistogram()
+    for _ in range(90):
+        h.observe(0.001)
+    for _ in range(10):
+        h.observe(0.9)
+    assert bad_fraction_above(h, 0.1) == pytest.approx(0.1)
+    assert bad_fraction_above(h, 10.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_bundle_contents_rotation_and_debounce(tmp_path):
+    clock = FakeClock()
+    store = TimeSeriesStore(interval_s=1.0, capacity=8, clock=clock)
+    store.record_gauge("g", 1.0, t=0.0)
+    ev = EventLog()
+    ev.emit("unit.test", x=1)
+    rec = FlightRecorder(
+        str(tmp_path), keep=2, min_interval_s=5.0, clock=clock,
+        store=store, events=ev,
+        snapshot_fn=lambda: {"counters": [], "gauges": [],
+                             "histograms": []},
+        workers_fn=lambda: {"worker_stats": {"w0": {"ticks_served": 1}}})
+    path = rec.trigger("slo-latency_p99", {"alert": {"state": "firing"}})
+    assert path is not None
+    files = set(os.listdir(path))
+    assert {"meta.json", "snapshot.json", "tsdb.json", "events.jsonl",
+            "workers.json"} <= files
+    meta = json.load(open(os.path.join(path, "meta.json")))
+    assert meta["reason"] == "slo-latency_p99"
+    assert "unit.test" in open(os.path.join(path, "events.jsonl")).read()
+    # debounce: same reason inside min_interval writes nothing
+    assert rec.trigger("slo-latency_p99") is None
+    assert rec.debounced_total == 1
+    # a different reason is not debounced
+    assert rec.trigger("chaos-delay") is not None
+    clock.advance(10.0)
+    assert rec.trigger("slo-latency_p99") is not None
+    # rotation: keep=2 newest
+    assert len(rec.bundles()) == 2
+    assert rec.triggered_total == 3
+
+
+def test_recorder_survives_a_broken_source(tmp_path):
+    def boom():
+        raise RuntimeError("dead warehouse")
+
+    rec = FlightRecorder(str(tmp_path), keep=2, min_interval_s=0.0,
+                         snapshot_fn=boom)
+    path = rec.trigger("r")
+    assert path is not None  # the bundle exists, minus the dead file
+    assert "snapshot.json" not in os.listdir(path)
+
+
+# ---------------------------------------------------------------------------
+# range endpoints + health integration
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_query_and_alerts_endpoints():
+    clock = FakeClock()
+    telemetry = FleetTelemetry(_slo_cfg(), clock=clock)
+    router = FakeRouter()
+    for step in range(6):
+        clock.t = float(step)
+        router.metrics.count("results_received", 7)
+        router.metrics.observe("total", 0.02)
+        telemetry.collect(router)
+    server = telemetry.start_server(port=0)
+    try:
+        doc = _get(f"{server.url}/query?series=fleet_ticks_per_s&window=60")
+        assert doc["series"] == "fleet_ticks_per_s"
+        assert doc["points"][0]["values"][-1][1] == pytest.approx(7.0)
+        doc = _get(f"{server.url}/query?series=fleet_e2e_p99_ms&window=60")
+        assert doc["points"][0]["values"]  # p99 timeline non-empty
+        doc = _get(f"{server.url}/query?series=fleet_e2e_seconds")
+        assert doc["kind"] == "histogram"
+        alerts = _get(f"{server.url}/alerts")
+        assert alerts["firing"] == []
+        assert "latency_p99" in alerts["alerts"]
+        # /metrics exposition renders the fleet + SLO gauges
+        with urllib.request.urlopen(server.url + "/metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert "fmda_fleet_ticks_per_s" in text
+        assert "fmda_slo_alerts_active" in text
+        # missing ?series= is a 400, not a 500
+        try:
+            urllib.request.urlopen(server.url + "/query", timeout=10)
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.stop()
+
+
+def test_health_degrades_while_alert_fires():
+    clock = FakeClock()
+    telemetry = FleetTelemetry(_slo_cfg(), clock=clock)
+    telemetry.slo._alerts["latency_p99"] = {
+        "objective": "latency_p99", "state": "firing", "burn_fast": 9.0,
+        "burn_slow": 9.0, "burn_threshold": 2.0, "budget": 0.05,
+        "detail": "x", "since": 0.0}
+    health = telemetry.health()
+    assert health["status"] == "degraded"
+    assert not health["checks"]["slo_alerts"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance test: injected latency fault -> alert fires ->
+# postmortem bundle -> alert clears after recovery (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def _setup_gateway(clock, feats=6, hidden=4, window=4, sessions=4):
+    cfg = ModelConfig(hidden_size=hidden, n_features=feats, output_size=4,
+                      dropout=0.0, bidirectional=False, use_pallas=False)
+    from fmda_tpu.models import build_model
+
+    model = build_model(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, window, feats)))["params"]
+    pool = SessionPool(cfg, params, capacity=sessions, window=window)
+    bus = InProcessBus(DEFAULT_TOPICS)
+    gateway = FleetGateway(
+        pool, bus, clock=clock,
+        batcher_config=BatcherConfig(bucket_sizes=(sessions,),
+                                     max_linger_s=0.0))
+    rng = np.random.default_rng(0)
+    mins = rng.normal(size=(sessions, feats)).astype(np.float32)
+    maxs = mins + rng.uniform(1.0, 5.0, (sessions, feats)).astype(
+        np.float32)
+    sids = [f"T{i}" for i in range(sessions)]
+    for i, sid in enumerate(sids):
+        gateway.open_session(sid, NormParams(mins[i], maxs[i]))
+    return gateway, sids, rng
+
+
+def test_chaos_latency_fault_fires_and_clears_slo_alert(tmp_path):
+    clock = FakeClock()
+    gateway, sids, rng = _setup_gateway(clock)
+    feats = gateway.pool.cfg.n_features
+    telemetry = FleetTelemetry(
+        _slo_cfg(postmortem_dir=str(tmp_path / "pm"), postmortem_keep=4,
+                 postmortem_min_interval_s=0.0),
+        clock=clock)
+    # a seeded fault plan injecting a latency fault: every worker step
+    # in [20, 32) stalls 0.4s — the stall advances the FAKE clock (the
+    # chaos runtime's sleep_fn), so the e2e histogram sees the breach
+    # without a single wall-clock sleep
+    plan = FaultPlan(n_steps=60, events=(
+        FaultEvent(step=20, kind="delay", target="worker.step",
+                   duration=12, delay_s=0.4),), seed=13)
+    chaos = default_chaos()
+    configure_tracing(enabled=True)
+    configure_chaos(enabled=True, plan=plan, sleep_fn=clock.advance)
+    fired_at = cleared_at = None
+    walk = rng.normal(size=(len(sids), feats)).astype(np.float32)
+    try:
+        for step in range(plan.n_steps):
+            chaos.advance(step)
+            walk += rng.normal(
+                scale=0.1, size=walk.shape).astype(np.float32)
+            for i, sid in enumerate(sids):
+                gateway.submit(sid, walk[i])
+            if chaos.enabled:
+                chaos.check("worker.step")  # the injected stall
+            gateway.pump(force=True)
+            clock.advance(0.05)
+            telemetry.collect_gateway(gateway, now=float(step))
+            state = telemetry.slo.alerts()["alerts"][
+                "latency_p99"]["state"]
+            if state == "firing" and fired_at is None:
+                fired_at = step
+            elif (fired_at is not None and cleared_at is None
+                    and state == "ok"):
+                cleared_at = step
+    finally:
+        configure_chaos(enabled=False, sleep_fn=time.sleep)
+        configure_tracing(enabled=False)
+        chaos.on_fault = None
+
+    # the latency burn-rate alert fired inside the fault window and
+    # cleared after recovery
+    assert fired_at is not None and fired_at >= 20
+    assert cleared_at is not None and cleared_at > 31
+    kinds = [e["kind"] for e in telemetry.events.tail()]
+    assert "slo.alert_fired" in kinds and "slo.alert_resolved" in kinds
+    assert "chaos_fault" in kinds  # injection itself is a counted event
+
+    # the flight recorder produced bundles for BOTH triggers: the chaos
+    # fault window opening and the SLO alert firing
+    bundles = telemetry.recorder.bundles()
+    reasons = [os.path.basename(b) for b in bundles]
+    assert any("chaos-delay" in r for r in reasons), reasons
+    slo_bundles = [b for b in bundles
+                   if "slo-latency_p99" in os.path.basename(b)]
+    assert slo_bundles, reasons
+    bundle = slo_bundles[0]
+
+    # the Perfetto dump loads: valid trace_event JSON with spans
+    trace = json.load(open(os.path.join(bundle, "trace.json")))
+    events = trace["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert spans and all("ts" in e and "dur" in e for e in spans)
+    assert any(e.get("name") == "tick" for e in spans)
+
+    # the tsdb window shows the breach: the e2e p99 timeline crosses
+    # the 100ms objective inside the fault window
+    tsdb = json.load(open(os.path.join(bundle, "tsdb.json")))
+    by_name = {s["series"]: s for s in tsdb["series"]}
+    e2e = by_name["fleet_e2e_seconds"]["points"][0]["values"]
+    p99s = [summ["p99_ms"] for _, summ in e2e]
+    assert max(p99s) > 100.0
+    assert min(p99s) < 100.0  # and the healthy baseline is visible too
+
+    # events tail + meta ride the bundle
+    assert "slo.alert_fired" in open(
+        os.path.join(bundle, "events.jsonl")).read()
+    meta = json.load(open(os.path.join(bundle, "meta.json")))
+    assert meta["detail"]["alert"]["objective"] == "latency_p99"
+
+    # status exit-code integration: degraded while firing, ok after
+    assert telemetry.health()["status"] == "ok"
+
+
+def test_close_detaches_the_chaos_hook(tmp_path):
+    telemetry = FleetTelemetry(
+        _slo_cfg(postmortem_dir=str(tmp_path)), clock=FakeClock())
+    chaos = default_chaos()
+    assert chaos.on_fault == telemetry._on_chaos_fault
+    telemetry.close()
+    assert chaos.on_fault is None
+    # closing someone else's hook is a no-op
+    other = FleetTelemetry(
+        _slo_cfg(postmortem_dir=str(tmp_path)), clock=FakeClock())
+    telemetry.close()
+    assert chaos.on_fault == other._on_chaos_fault
+    other.close()

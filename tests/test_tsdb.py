@@ -1,0 +1,222 @@
+"""fmda_tpu.obs.tsdb: the bounded in-memory time-series store (ISSUE 13).
+
+Edge cases the ISSUE names explicitly: ring wraparound, counter reset
+(process restart → rate clamps at 0, never negative), histogram merge
+across workers with disjoint fill patterns, and empty-window queries.
+Everything runs on an injected fake clock — zero wall-clock sleeps.
+"""
+
+import pytest
+
+from fmda_tpu.obs.registry import LatencyHistogram
+from fmda_tpu.obs.tsdb import TimeSeriesStore, diff_snaps, snap_to_histogram
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def clock():
+    return Clock()
+
+
+@pytest.fixture
+def store(clock):
+    return TimeSeriesStore(interval_s=1.0, capacity=8, clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# gauges + the ring
+# ---------------------------------------------------------------------------
+
+
+def test_gauge_points_and_newest_write_wins(store, clock):
+    for i in range(5):
+        clock.t = float(i)
+        store.record_gauge("g", i * 10.0)
+    clock.t = 4.4  # same interval as t=4: the newer write replaces
+    store.record_gauge("g", 99.0)
+    pts = store.points("g")
+    assert pts == [(0.0, 0.0), (1.0, 10.0), (2.0, 20.0), (3.0, 30.0),
+                   (4.0, 99.0)]
+
+
+def test_ring_wraparound_keeps_newest_capacity_bins(store, clock):
+    for i in range(30):
+        clock.t = float(i)
+        store.record_gauge("g", float(i))
+    pts = store.points("g")
+    assert len(pts) == 8  # capacity
+    assert pts[0] == (22.0, 22.0) and pts[-1] == (29.0, 29.0)
+
+
+def test_out_of_order_stamp_folds_into_newest_bin(store, clock):
+    store.record_gauge("g", 1.0, t=5.0)
+    store.record_gauge("g", 2.0, t=3.0)  # clock skew: no time travel
+    assert store.points("g") == [(5.0, 2.0)]
+
+
+def test_max_series_bound_counts_drops():
+    s = TimeSeriesStore(interval_s=1.0, capacity=4, max_series=2)
+    s.record_gauge("a", 1.0, t=0.0)
+    s.record_gauge("b", 1.0, t=0.0)
+    s.record_gauge("c", 1.0, t=0.0)  # over the bound: dropped, counted
+    assert len(s.series()) == 2
+    assert s.dropped_series == 1
+    assert s.points("c") == []
+
+
+# ---------------------------------------------------------------------------
+# counters: rates + the reset clamp
+# ---------------------------------------------------------------------------
+
+
+def test_counter_rates_differentiate_at_read_time(store, clock):
+    for i in range(4):
+        clock.t = float(i)
+        store.record_counter("c", i * 5.0)
+    pts = store.points("c")
+    assert pts == [(1.0, 5.0), (2.0, 5.0), (3.0, 5.0)]
+
+
+def test_counter_reset_clamps_rate_at_zero(store):
+    store.record_counter("c", 100.0, t=0.0)
+    store.record_counter("c", 200.0, t=1.0)
+    store.record_counter("c", 7.0, t=2.0)  # process restart
+    store.record_counter("c", 17.0, t=3.0)
+    rates = [v for _, v in store.points("c")]
+    assert rates == [100.0, 0.0, 10.0]  # never negative
+    # window_total sums only the positive deltas across the reset
+    assert store.window_total("c", window_s=10.0, now=3.0) == 110.0
+
+
+def test_rate_timeline_sums_across_processes(store):
+    for i in range(4):
+        store.record_counter("c", i * 10.0, t=float(i), process="w0")
+        store.record_counter("c", i * 2.0, t=float(i), process="w1")
+    timeline = store.rate_timeline("c")
+    assert timeline == [(1.0, 12.0), (2.0, 12.0), (3.0, 12.0)]
+
+
+def test_gap_in_samples_spreads_the_delta(store):
+    store.record_counter("c", 0.0, t=0.0)
+    store.record_counter("c", 40.0, t=4.0)  # 3 intervals missed
+    assert store.points("c") == [(4.0, 10.0)]
+
+
+# ---------------------------------------------------------------------------
+# histograms: stored whole, merged across workers
+# ---------------------------------------------------------------------------
+
+
+def _hist(values):
+    h = LatencyHistogram()
+    for v in values:
+        h.observe(v)
+    return h
+
+
+def test_window_histogram_is_cumulative_delta(store):
+    h = _hist([0.001] * 10)
+    store.record_histogram("h", h.snapshot(), t=0.0)
+    for _ in range(5):
+        h.observe(0.5)
+    store.record_histogram("h", h.snapshot(), t=5.0)
+    # window [3, 5]: only the 5 slow observations landed inside it
+    win = store.window_histogram("h", window_s=2.5, now=5.0)
+    assert win.n == 5
+    assert win.percentile(50) > 0.1
+
+
+def test_histogram_merge_across_workers_disjoint_fills(store):
+    # w0 only ever observes fast ticks, w1 only slow ones — the merged
+    # window must hold BOTH distributions exactly
+    fast = _hist([0.001] * 90)
+    slow = _hist([0.8] * 10)
+    store.record_histogram("h", fast.snapshot(), t=1.0, process="w0")
+    store.record_histogram("h", slow.snapshot(), t=1.0, process="w1")
+    win = store.window_histogram("h", window_s=10.0, now=1.5)
+    assert win.n == 100
+    # p50 lands in the fast mass, p99 in the slow tail
+    assert win.percentile(50) < 0.01
+    assert win.percentile(99) >= 0.5
+    ref = _hist([0.001] * 90 + [0.8] * 10)
+    assert win.snapshot()["counts"] == ref.snapshot()["counts"]
+
+
+def test_histogram_reset_uses_post_restart_snapshot(store):
+    h = _hist([0.001] * 50)
+    store.record_histogram("h", h.snapshot(), t=0.0)
+    fresh = _hist([0.5] * 3)  # process restarted: counts went DOWN
+    store.record_histogram("h", fresh.snapshot(), t=1.0)
+    win = store.window_histogram("h", window_s=10.0, now=1.5)
+    assert win.n == 3  # the restart's own observations, never negative
+
+
+def test_histogram_timeline_summarises_per_interval(store):
+    h = LatencyHistogram()
+    for t in range(4):
+        lat = 0.5 if t == 2 else 0.001
+        for _ in range(10):
+            h.observe(lat)
+        store.record_histogram("h", h.snapshot(), t=float(t))
+    timeline = store.histogram_timeline("h")
+    assert [t for t, _ in timeline] == [1.0, 2.0, 3.0]
+    p99s = [summ["p99_ms"] for _, summ in timeline]
+    assert p99s[1] > 100 and p99s[0] < 10 and p99s[2] < 10
+
+
+def test_diff_snaps_identity_and_reset():
+    h = _hist([0.01] * 5)
+    snap = h.snapshot()
+    assert diff_snaps(snap, None)["n"] == 5
+    assert diff_snaps(snap, snap)["n"] == 0
+    assert snap_to_histogram(diff_snaps(snap, None)).n == 5
+
+
+# ---------------------------------------------------------------------------
+# empty windows + query document
+# ---------------------------------------------------------------------------
+
+
+def test_empty_window_queries_are_empty_not_errors(store):
+    assert store.points("nothing") == []
+    assert store.rate_timeline("nothing") == []
+    assert store.window_total("nothing", window_s=5.0, now=100.0) == 0.0
+    assert store.window_histogram("nothing", window_s=5.0, now=100.0).n == 0
+    assert store.histogram_timeline("nothing") == []
+    doc = store.query("nothing", window_s=5.0)
+    assert doc["points"] == [] and doc["kind"] is None
+    # a series with data but an empty window is just as quiet
+    store.record_gauge("g", 1.0, t=0.0)
+    assert store.points("g", window_s=1.0, now=500.0) == []
+    h = _hist([0.01])
+    store.record_histogram("h", h.snapshot(), t=0.0)
+    assert store.window_histogram("h", window_s=1.0, now=500.0).n == 0
+
+
+def test_query_and_dump_are_json_safe(store):
+    import json
+
+    store.record_gauge("g", 1.0, t=0.0, process="w0")
+    store.record_counter("c", 5.0, t=0.0)
+    store.record_counter("c", 9.0, t=1.0)
+    h = _hist([0.01] * 4)
+    store.record_histogram("h", h.snapshot(), t=0.0)
+    for _ in range(4):
+        h.observe(0.02)
+    store.record_histogram("h", h.snapshot(), t=1.0)
+    doc = store.dump(window_s=100.0, now=2.0)
+    text = json.dumps(doc)  # must not raise
+    assert "dropped_series" in doc
+    by_name = {s["series"]: s for s in doc["series"]}
+    assert by_name["c"]["points"][0]["values"] == [[1.0, 4.0]]
+    assert by_name["g"]["points"][0]["labels"] == {"process": "w0"}
+    hist_vals = by_name["h"]["points"][0]["values"]
+    assert hist_vals[0][1]["count"] == 4
+    assert text
