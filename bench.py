@@ -560,7 +560,13 @@ def phase_kernel_sweep() -> dict:
     the kernel in INTERPRET mode over a reduced shape set — no timing
     headline (the interpreter is orders slower by construction), but the
     whole fused fwd+bwd path executes end-to-end on every backend, the
-    coverage the compat port bought back (PR 9)."""
+    coverage the compat port bought back (PR 9).
+
+    Since ISSUE 14 the sweep covers ``KERNEL_SWEEP_FAMILIES``: the GRU
+    scan kernel above plus the SSM family's fused O(1) serve-step
+    kernel (``ssm_step`` — jnp step vs fmda_tpu.ops.pallas_ssm over
+    (B, H) tick shapes; interpret-mode smoke on CPU, real timings on
+    hardware)."""
     import jax
     import jax.numpy as jnp
 
@@ -648,6 +654,61 @@ def phase_kernel_sweep() -> dict:
         except Exception as e:  # noqa: BLE001 - record, keep sweeping
             entry["pallas_error"] = str(e)[:300]
         out["shapes"][key] = entry
+
+    # --- the SSM family's O(1) serve-step kernel (ISSUE 14) ------------
+    # serve-step shapes are (B, H) — one tick, no time axis: B spans the
+    # fleet bucket sizes, H the family ladder.  Off-TPU the kernel runs
+    # in interpret mode (parity smoke, no timing headline), exactly like
+    # the scan kernels above.
+    from fmda_tpu.ops.pallas_ssm import (
+        kernel_supported as ssm_kernel_supported)
+    from fmda_tpu.ops.pallas_ssm import ssm_cell_step_pallas
+    from fmda_tpu.ops.ssm import SSMWeights, ssm_cell_step
+
+    out["families"] = list(KERNEL_SWEEP_FAMILIES)
+    step_shapes = ([(8, 32)] if interpret
+                   else [(16, 32), (64, 32), (256, 32),
+                         (64, 128), (256, 128), (256, 256)])
+    out["ssm_step"] = {}
+    for batch, hidden in step_shapes:
+        r = np.random.default_rng(1)
+        w = SSMWeights(
+            w_ih=jnp.zeros((3 * hidden, 1)),  # projection outside, unused
+            b_ih=jnp.zeros((3 * hidden,)),
+            a_base=jnp.asarray(
+                r.uniform(1.0, 3.0, hidden).astype(np.float32)),
+            d=jnp.asarray(r.normal(size=hidden).astype(np.float32) * 0.1),
+            rho_f=jnp.zeros((hidden,)),
+            rho_s=jnp.full((hidden,), 3.0),
+        )
+        xp = jnp.asarray(
+            r.normal(size=(batch, 3 * hidden)).astype(np.float32))
+        carry = tuple(jnp.zeros((batch, hidden)) for _ in range(3))
+
+        def jnp_step(xp_, s, ef, es):
+            return ssm_cell_step(xp_, (s, ef, es), w)
+
+        def pal_step(xp_, s, ef, es):
+            return ssm_cell_step_pallas(
+                xp_, (s, ef, es), w, interpret=interpret)
+
+        key = f"B{batch}_H{hidden}"
+        entry = {
+            "kernel_supported": ssm_kernel_supported(batch, hidden, 4),
+        }
+        try:
+            t_ref = timed(jax.jit(jnp_step), (xp,) + carry)
+            entry["step_ms"] = round(t_ref * 1e3, 4)
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            entry["step_error"] = str(e)[:300]
+        try:
+            t_pal = timed(jax.jit(pal_step), (xp,) + carry)
+            entry["pallas_ms"] = round(t_pal * 1e3, 4)
+            if "step_ms" in entry and not interpret:
+                entry["speedup"] = round(t_ref / t_pal, 3)
+        except Exception as e:  # noqa: BLE001 - record, keep sweeping
+            entry["pallas_error"] = str(e)[:300]
+        out["ssm_step"][key] = entry
     return out
 
 
@@ -1170,22 +1231,18 @@ def phase_replay() -> dict:
     return out
 
 
-def phase_runtime_fleet() -> dict:
-    """Fleet-serving smoke + latency-SLO gate: the dynamic micro-batching
-    runtime (fmda_tpu.runtime, docs/runtime.md) vs a synthetic 64-session
-    multi-ticker load on the flagship feature width — p50/p99 tick
-    latency + throughput, the serving-trajectory baseline later PRs
-    regress against.  CPU-friendly by design (one small batched GRU step
-    per flush).
+#: Carried-state cell families the fleet smoke races (equal H, same
+#: load) and the kernel sweep covers — pinned by test_bench_helpers.
+FLEET_AB_CELLS = ("gru", "ssm")
+KERNEL_SWEEP_FAMILIES = ("gru", "ssm")
 
-    The SLO gate (ROADMAP open item): total (submit→publish) p99 must
-    stay under ``FMDA_FLEET_SLO_P99_MS`` (default 50 — ~6x quiet-host
-    headroom over the measured ~7.5ms, tight enough to catch an
-    order-of-magnitude serving regression).  Violations on a quiet host
-    put an ``error`` in the phase result (→ ``phases_error``, the CI
-    signal); a loaded host (1-min loadavg over half the cores) or
-    ``--slo-soft`` / ``FMDA_FLEET_SLO_SOFT=1`` downgrades the verdict to
-    a reported-but-non-failing ``slo_ok: false``."""
+
+def _fleet_cell_run(cell: str, sessions: int, rounds: int,
+                    buckets: tuple) -> dict:
+    """One fleet-smoke measurement for one carried-state cell family:
+    build pool + gateway at the flagship width, precompile every
+    bucket, drive the synthetic load.  Shared by the per-cell A/B of
+    ``phase_runtime_fleet``."""
     import jax
     import jax.numpy as jnp
 
@@ -1195,11 +1252,9 @@ def phase_runtime_fleet() -> dict:
         BatcherConfig, FleetGateway, FleetLoadConfig, SessionPool,
         run_fleet_load)
 
-    sessions, rounds = 64, 50
-    buckets = (16, 64)
     cfg = ModelConfig(hidden_size=HIDDEN, n_features=FEATURES,
                       output_size=CLASSES, dropout=0.0,
-                      bidirectional=False, use_pallas=False)
+                      bidirectional=False, use_pallas=False, cell=cell)
     model = build_model(cfg)
     params = model.init({"params": jax.random.PRNGKey(0)},
                         jnp.zeros((1, WINDOW, FEATURES)))["params"]
@@ -1216,6 +1271,52 @@ def phase_runtime_fleet() -> dict:
     assert pool.compile_count == len(buckets)
     out = run_fleet_load(gateway, FleetLoadConfig(
         n_sessions=sessions, n_ticks=rounds, duty=0.9, seed=0))
+    out["cell"] = cell
+    # per-session migration payload size at this H/window — the state
+    # the fleet moves on every drain/export (ssm's O(1) cache vs the
+    # ring-carrying families); the loadgen leaves its sessions open
+    state = pool.export_slot(pool.handle_for("T0000"))
+    out["export_bytes"] = int(
+        sum(a.nbytes for layer in state["carry"] for a in layer)
+        + state["ring"].nbytes)
+    return out
+
+
+def phase_runtime_fleet() -> dict:
+    """Fleet-serving smoke + latency-SLO gate + cell-family A/B: the
+    dynamic micro-batching runtime (fmda_tpu.runtime, docs/runtime.md)
+    vs a synthetic 64-session multi-ticker load on the flagship feature
+    width — p50/p99 tick latency + throughput, the serving-trajectory
+    baseline later PRs regress against.  CPU-friendly by design (one
+    small batched step per flush).
+
+    ``FMDA_FLEET_CELL`` picks the family the headline numbers measure
+    (default gru — the historical baseline series); the phase ALWAYS
+    additionally races gru vs ssm at equal H under ``cells`` and gates
+    the O(1)-cache family's claim (ISSUE 14): on a quiet host the SSM
+    cell must sustain **strictly higher ticks/s than the GRU core**
+    (its per-tick step is matmul-free and ring-free), with
+    compile_count still 1/bucket for both; on a loaded host the
+    comparison is reported ``gate_inert`` — the same quietness rule
+    every perf gate here uses.
+
+    The SLO gate (ROADMAP open item): total (submit→publish) p99 must
+    stay under ``FMDA_FLEET_SLO_P99_MS`` (default 50 — ~6x quiet-host
+    headroom over the measured ~7.5ms, tight enough to catch an
+    order-of-magnitude serving regression).  Violations on a quiet host
+    put an ``error`` in the phase result (→ ``phases_error``, the CI
+    signal); a loaded host (1-min loadavg over half the cores) or
+    ``--slo-soft`` / ``FMDA_FLEET_SLO_SOFT=1`` downgrades the verdict to
+    a reported-but-non-failing ``slo_ok: false``."""
+    import jax
+
+    sessions, rounds = 64, 50
+    buckets = (16, 64)
+    primary = os.environ.get("FMDA_FLEET_CELL", "gru")
+    cells = {}
+    for cell in dict.fromkeys((primary,) + FLEET_AB_CELLS):
+        cells[cell] = _fleet_cell_run(cell, sessions, rounds, buckets)
+    out = cells[primary]
     lat = out["latency"]
     p99_ms = lat["total"]["p99_ms"]
     slo_ms = float(os.environ.get("FMDA_FLEET_SLO_P99_MS", "50"))
@@ -1226,6 +1327,7 @@ def phase_runtime_fleet() -> dict:
         load1 = None
     quiet = load1 is not None and load1 < 0.5 * (os.cpu_count() or 1)
     result = {
+        "cell": primary,
         "sessions": sessions,
         "rounds": rounds,
         "ticks_served": out["ticks_served"],
@@ -1243,17 +1345,47 @@ def phase_runtime_fleet() -> dict:
         "slo_p99_ms": slo_ms,
         "slo_ok": p99_ms <= slo_ms,
         "slo_quiet_host": quiet,
+        "cells": {
+            c: {
+                "ticks_per_s": r["ticks_per_s"],
+                "tick_p50_ms": r["latency"]["total"]["p50_ms"],
+                "tick_p99_ms": r["latency"]["total"]["p99_ms"],
+                "compile_count": r["compile_count"],
+                "export_bytes": r["export_bytes"],
+            }
+            for c, r in cells.items()
+        },
         "timing_note": "total = submit->published per tick (incl. "
                        "micro-batch linger); dispatch = assembly + async "
                        "step enqueue; device = host-transfer block in "
                        "completion (overlapped work hides elsewhere); "
                        "buckets precompiled, so steady-state",
     }
+    gru_tps = cells["gru"]["ticks_per_s"]
+    ssm_tps = cells["ssm"]["ticks_per_s"]
+    result["ssm_speedup_vs_gru"] = (
+        round(ssm_tps / gru_tps, 3) if gru_tps else None)
+    result["ssm_export_shrink"] = (
+        round(cells["gru"]["export_bytes"]
+              / max(cells["ssm"]["export_bytes"], 1), 2))
+    errors = []
+    if quiet:
+        if ssm_tps <= gru_tps:
+            errors.append(
+                f"SSM cell did not beat the GRU core on a quiet host: "
+                f"{ssm_tps:.0f} <= {gru_tps:.0f} ticks/s at equal "
+                f"H={HIDDEN} (the O(1)-cache family's headline claim)")
+    else:
+        result["ssm_gate"] = "gate_inert: loaded host"
     if p99_ms > slo_ms and quiet and not soft:
-        result["error"] = (
+        errors.append(
             f"latency SLO violated: total p99 {p99_ms}ms > {slo_ms}ms "
             "bound on a quiet host (FMDA_FLEET_SLO_P99_MS to retune, "
             "--slo-soft / FMDA_FLEET_SLO_SOFT=1 to report-only)")
+    if errors:
+        # both gates can fail in one run; neither message may eat the
+        # other (phases_error shows exactly what regressed)
+        result["error"] = "; ".join(errors)
     return result
 
 

@@ -32,13 +32,20 @@ EPOCHS = 25
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--cells", default="gru,lstm,attn")
+    parser.add_argument("--cells", default="gru,lstm,attn,ssm")
     parser.add_argument("--epochs", type=int, default=EPOCHS)
     parser.add_argument("--attn-dropout", type=float, default=0.1,
                         help="residual dropout for the attn core "
                              "(ModelConfig.attn_dropout; the input "
                              "spatial dropout stays at the protocol's "
                              "0.5 for every family)")
+    parser.add_argument("--ssm-decay-range", default=None,
+                        metavar="LO,HI",
+                        help="initial zero-input state-decay range for "
+                             "the ssm core (ModelConfig.ssm_decay_range)")
+    parser.add_argument("--ssm-ema-init", default=None, metavar="F,S",
+                        help="initial fast,slow head-EMA decays for the "
+                             "ssm core (ModelConfig.ssm_ema_init)")
     parser.add_argument("--out", default=None,
                         help="output markdown path (default "
                              "RESULTS_FAMILIES.md; sweeps point elsewhere "
@@ -68,10 +75,17 @@ def main() -> None:
 
     results = {}
     for cell in cells:
+        ssm_kw = {}
+        if args.ssm_decay_range:
+            ssm_kw["ssm_decay_range"] = tuple(
+                float(v) for v in args.ssm_decay_range.split(","))
+        if args.ssm_ema_init:
+            ssm_kw["ssm_ema_init"] = tuple(
+                float(v) for v in args.ssm_ema_init.split(","))
         model_cfg = ModelConfig(
             hidden_size=32, n_features=len(wh.x_fields), output_size=4,
             dropout=0.5, spatial_dropout=True, cell=cell,
-            attn_dropout=args.attn_dropout,
+            attn_dropout=args.attn_dropout, **ssm_kw,
         )
         train_cfg = TrainConfig(
             batch_size=2, window=30, chunk_size=100, learning_rate=1e-3,

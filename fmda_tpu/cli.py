@@ -377,14 +377,22 @@ def _fleet_worker_model(args, cfg):
 
 
 def _fleet_wire_override(args, cfg):
-    """Fold ``--wire-format`` into cfg.fleet (every serve-fleet role:
-    the rollback switch must work from the command line alone)."""
-    if getattr(args, "wire_format", None):
-        import dataclasses
+    """Fold the cross-role serve-fleet switches into cfg: binary-wire
+    rollback (``--wire-format`` -> [fleet]) and the carried-state cell
+    family A/B knob (``--cell``, falling back to ``FMDA_FLEET_CELL`` ->
+    [model] cell) — both must work from the command line alone, on
+    every role, so a GRU-vs-SSM ticks/s comparison at equal --hidden
+    is two invocations of the same command."""
+    import dataclasses
 
+    if getattr(args, "wire_format", None):
         cfg = dataclasses.replace(
             cfg, fleet=dataclasses.replace(
                 cfg.fleet, wire_format=args.wire_format))
+    cell = getattr(args, "cell", None) or os.environ.get("FMDA_FLEET_CELL")
+    if cell:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, cell=cell))
     return cfg
 
 
@@ -851,7 +859,7 @@ def cmd_serve_fleet(args) -> int:
     from fmda_tpu.app import Application
     from fmda_tpu.runtime import FleetLoadConfig, run_fleet_load
 
-    cfg = _config(args)
+    cfg = _fleet_wire_override(args, _config(args))
     bucket_sizes = (tuple(int(b) for b in args.bucket_sizes.split(","))
                     if args.bucket_sizes else None)
     if args.predictor:
@@ -980,6 +988,8 @@ def cmd_serve_fleet(args) -> int:
         out = run_load()
     if args.predictor:
         out["ring"] = gateway.pool.use_ring
+    else:
+        out["cell"] = model_cfg.cell
     out["backend"] = jax.default_backend()
     if args.trace or args.trace_out:
         from fmda_tpu.obs.trace import default_tracer
@@ -1553,6 +1563,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--duty", type=float, default=1.0,
                    help="fraction of sessions ticking per round")
     p.add_argument("--hidden", type=int, default=32)
+    p.add_argument("--cell", default=None, choices=["gru", "lstm", "ssm"],
+                   help="carried-state cell family for the serving "
+                        "pool (overrides [model] cell; default env "
+                        "FMDA_FLEET_CELL, else the config).  'ssm' is "
+                        "the O(1)-cache family — GRU-vs-SSM ticks/s at "
+                        "equal --hidden is two runs of this command "
+                        "(docs/runtime.md 'The SSM cell family')")
     p.add_argument("--window", type=int, default=None,
                    help="override config runtime.window (default 30)")
     p.add_argument("--bucket-sizes", default=None, metavar="N,N,...",

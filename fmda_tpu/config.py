@@ -373,8 +373,12 @@ class ModelConfig:
     bidirectional: bool = True
     #: Sequence-core family: "gru" (the reference's model), "lstm" (same
     #: head/protocol over fmda_tpu.ops.lstm — the torch user's one-line
-    #: nn.GRU -> nn.LSTM swap), or "attn" (temporal transformer encoder
-    #: over fmda_tpu.ops.attention, the ring-shardable long-context core).
+    #: nn.GRU -> nn.LSTM swap), "attn" (temporal transformer encoder
+    #: over fmda_tpu.ops.attention, the ring-shardable long-context
+    #: core), or "ssm" (gated linear recurrence over fmda_tpu.ops.ssm —
+    #: trains in the parallel associative-scan mode, serves from a
+    #: constant-size O(1) cache with no ring and no per-tick matmul;
+    #: docs/runtime.md "The SSM cell family").
     cell: str = "gru"
     #: Attention heads for cell="attn"; must divide hidden_size.
     n_heads: int = 4
@@ -393,6 +397,19 @@ class ModelConfig:
     #: halves the backtest edge) — the shootout/experiment configs set
     #: it explicitly (experiments/family_shootout.py --attn-dropout).
     attn_dropout: Optional[float] = None
+    #: cell="ssm": initial per-channel zero-input state-decay range —
+    #: each channel's learned decay offset ``a_base`` is initialised so
+    #: ``sigmoid(a_base)`` is uniform in this range (the LRU-style
+    #: long-memory ring init: channels start spread from "remember ~10
+    #: ticks" to "remember ~1000").
+    ssm_decay_range: Tuple[float, float] = (0.9, 0.999)
+    #: cell="ssm": initial (fast, slow) head-EMA decay rates — the
+    #: family's O(1) replacement for the ring head's max/mean window
+    #: pools; per-channel and learned from these starting points.  The
+    #: default is the shootout sweep's winner (RESULTS_FAMILIES.md: test
+    #: accuracy 0.226 vs 0.207 at (0.5, 0.95); the slower fast-EMA
+    #: keeps the head's short-horizon pool from tracking tick noise).
+    ssm_ema_init: Tuple[float, float] = (0.6, 0.98)
     #: Compute dtype for the GRU/head; params are kept in float32.
     dtype: str = "float32"
     #: Use the fused Pallas scan cell on TPU (falls back to lax.scan

@@ -1,6 +1,7 @@
 from fmda_tpu.models.attn import TemporalTransformer
 from fmda_tpu.models.bigru import BiGRU, BiGRUState
 from fmda_tpu.models.bilstm import BiLSTM, BiLSTMState
+from fmda_tpu.models.ssm import GatedSSM, SSMState
 
 
 def build_model(cfg):
@@ -8,7 +9,8 @@ def build_model(cfg):
     the window-re-scan Predictor, and the backtester.  (The streaming
     serving cores and the flagship entry points are GRU-specific and
     construct :class:`BiGRU` directly.)"""
-    cells = {"gru": BiGRU, "lstm": BiLSTM, "attn": TemporalTransformer}
+    cells = {"gru": BiGRU, "lstm": BiLSTM, "attn": TemporalTransformer,
+             "ssm": GatedSSM}
     if cfg.cell not in cells:
         raise ValueError(
             f"unknown ModelConfig.cell {cfg.cell!r}; expected one of "
@@ -19,5 +21,5 @@ def build_model(cfg):
 
 __all__ = [
     "BiGRU", "BiGRUState", "BiLSTM", "BiLSTMState",
-    "TemporalTransformer", "build_model",
+    "GatedSSM", "SSMState", "TemporalTransformer", "build_model",
 ]

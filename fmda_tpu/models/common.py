@@ -71,6 +71,31 @@ def pool_concat_logits(
     return logits.astype(jnp.float32)
 
 
+def ema_concat_logits(
+    cfg: ModelConfig,
+    last_hidden: jax.Array,
+    ema_fast: jax.Array,
+    ema_slow: jax.Array,
+) -> jax.Array:
+    """The SSM family's head: the protocol's ``Dense(3H -> n_classes)``
+    shape with the window pools replaced by the two learned-rate EMAs —
+    the O(1)-cache twin of :func:`pool_concat_logits` (max/mean need the
+    ring the family exists to delete; the EMAs are linear recurrences,
+    so they parallel-scan in training and carry as two H-vectors in
+    serving).  Serve-side twin: ``fmda_tpu.serve.streaming
+    .ema_head_logits`` reads the same ``linear`` params — concat order
+    ``[h_last, ema_fast, ema_slow]`` is part of that contract."""
+    concat = jnp.concatenate([last_hidden, ema_fast, ema_slow], axis=-1)
+    scale = 1.0 / jnp.sqrt(3 * cfg.hidden_size)
+    logits = nn.Dense(
+        cfg.output_size,
+        name="linear",
+        kernel_init=_torch_uniform_init(scale),
+        bias_init=_torch_uniform_init(scale),
+    )(concat)
+    return logits.astype(jnp.float32)
+
+
 def _torch_uniform_init(scale: float):
     """torch's default U(-1/sqrt(fan), 1/sqrt(fan)) init (the reference
     never re-initialises, so its training recipe assumes this)."""

@@ -1,0 +1,57 @@
+"""Family-aware kernel selection with counted fallback signals.
+
+Every cell family exposes a "fused kernel where it fits, reference
+path where it doesn't" selector (``gru.select_scan_fn``,
+``lstm.select_lstm_scan_fn``, ``ssm.select_ssm_step_fn``).  The
+fallbacks used to be *silent* by design — fine while there were exactly
+two families whose selectors were called from family-specific code, but
+a third family made the failure mode real: a caller routing a new cell
+through a sibling family's selector (or a selector quietly refusing the
+kernel) would serve the reference path forever with nothing to notice.
+
+The fix has two halves:
+
+- :func:`count_kernel_fallback` (here) — every selector records each
+  ``use_pallas=True`` request it resolves to the reference path, keyed
+  ``"<cell>:<reason>"`` (``backend`` / ``masked`` / ``vmem``).  The
+  counters tick at *trace* time, so steady-state serving pays nothing
+  (one count per compiled program, which is exactly the granularity the
+  signal needs).  Read with :func:`kernel_fallbacks`; tests assert on
+  it.
+- loud dispatch at the cell seams (at the owning call sites) — the
+  places that branch on ``ModelConfig.cell`` now raise on families they
+  don't implement instead of falling through to the GRU path:
+  ``serve.streaming._recurrent_cell_ops`` (always did) and
+  ``parallel.sp_train.make_sp_train_step`` (previously routed ANY
+  non-attn cell into the GRU carry-handoff scan).
+
+Importing this module never imports jax (selector modules import it at
+module scope on jax-free analysis hosts).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_FALLBACK_LOCK = threading.Lock()
+_fallbacks: Dict[str, int] = {}
+
+
+def count_kernel_fallback(cell: str, reason: str) -> None:
+    """Record one kernel-requested-but-reference-selected event."""
+    key = f"{cell}:{reason}"
+    with _FALLBACK_LOCK:
+        _fallbacks[key] = _fallbacks.get(key, 0) + 1
+
+
+def kernel_fallbacks() -> Dict[str, int]:
+    """Snapshot of the fallback counters (``"<cell>:<reason>" -> n``)."""
+    with _FALLBACK_LOCK:
+        return dict(_fallbacks)
+
+
+def reset_kernel_fallbacks() -> None:
+    """Zero the counters (test isolation)."""
+    with _FALLBACK_LOCK:
+        _fallbacks.clear()

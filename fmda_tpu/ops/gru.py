@@ -121,7 +121,10 @@ def select_scan_fn(
     (single-device :func:`gru_layer` and the sequence-parallel path) so
     the kernel's support envelope is gated in exactly one place: the
     fused kernel runs when requested, unmasked, and on a TPU backend;
-    anything else silently falls back to :func:`gru_scan`.
+    anything else falls back to :func:`gru_scan` — with the fallback
+    **counted** per reason in :mod:`fmda_tpu.ops.dispatch` (a config
+    that asked for the kernel and silently serves the reference scan
+    was invisible before a third cell family made it a real bug class).
 
     ``shape=(batch, seq_len, hidden)`` additionally gates on the
     kernel's per-shape VMEM feasibility
@@ -131,15 +134,24 @@ def select_scan_fn(
     ``lax.scan`` is the right path — so ``use_pallas=True`` means "fused
     kernel where it fits, scan where it doesn't", selected automatically
     per shape at trace time (shapes are static under jit)."""
-    if use_pallas and mask is None and pallas_scan_available():
-        from fmda_tpu.ops import pallas_gru
+    if not use_pallas:
+        return gru_scan
+    from fmda_tpu.ops.dispatch import count_kernel_fallback
 
-        if shape is not None and not pallas_gru.kernel_supported(
-            shape[0], shape[1], shape[2], itemsize
-        ):
-            return gru_scan
-        return pallas_gru.gru_scan_pallas
-    return gru_scan
+    if mask is not None:
+        count_kernel_fallback("gru", "masked")
+        return gru_scan
+    if not pallas_scan_available():
+        count_kernel_fallback("gru", "backend")
+        return gru_scan
+    from fmda_tpu.ops import pallas_gru
+
+    if shape is not None and not pallas_gru.kernel_supported(
+        shape[0], shape[1], shape[2], itemsize
+    ):
+        count_kernel_fallback("gru", "vmem")
+        return gru_scan
+    return pallas_gru.gru_scan_pallas
 
 
 def gru_layer(

@@ -129,17 +129,27 @@ def select_lstm_scan_fn(
     :func:`fmda_tpu.ops.gru.select_scan_fn`: the fused kernel runs when
     requested, unmasked, on a TPU backend, and — when
     ``shape=(batch, seq_len, hidden)`` is given — inside the kernel's
-    VMEM feasibility envelope; anything else silently falls back to
-    :func:`lstm_scan`."""
-    if use_pallas and mask is None and lstm_pallas_available():
-        from fmda_tpu.ops import pallas_lstm
+    VMEM feasibility envelope; anything else falls back to
+    :func:`lstm_scan`, counted per reason in
+    :mod:`fmda_tpu.ops.dispatch` (never silent)."""
+    if not use_pallas:
+        return lstm_scan
+    from fmda_tpu.ops.dispatch import count_kernel_fallback
 
-        if shape is not None and not pallas_lstm.kernel_supported(
-            shape[0], shape[1], shape[2], itemsize
-        ):
-            return lstm_scan
-        return pallas_lstm.lstm_scan_pallas
-    return lstm_scan
+    if mask is not None:
+        count_kernel_fallback("lstm", "masked")
+        return lstm_scan
+    if not lstm_pallas_available():
+        count_kernel_fallback("lstm", "backend")
+        return lstm_scan
+    from fmda_tpu.ops import pallas_lstm
+
+    if shape is not None and not pallas_lstm.kernel_supported(
+        shape[0], shape[1], shape[2], itemsize
+    ):
+        count_kernel_fallback("lstm", "vmem")
+        return lstm_scan
+    return pallas_lstm.lstm_scan_pallas
 
 
 def lstm_layer(

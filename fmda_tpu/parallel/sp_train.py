@@ -69,11 +69,22 @@ def make_sp_train_step(
         forward = make_attn_sp_forward(
             mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
             flash_interpret=flash_interpret)
-    else:
+    elif model_cfg.cell == "gru":
         forward = make_sp_forward(
             mesh, model_cfg, seq_len, dp_axis=dp_axis, sp_axis=sp_axis,
             n_microbatches=n_microbatches,
         )
+    else:
+        # loud dispatch (fmda_tpu.ops.dispatch): this used to be a bare
+        # `else` that routed ANY non-attn cell — lstm, ssm, a future
+        # family — into the GRU carry-handoff scan, which at best crashes
+        # on the sibling's param shapes and at worst runs wrong math
+        raise ValueError(
+            "sequence-parallel training implements cell='gru' (the "
+            "staged carry-handoff scan) and cell='attn' (the K/V ring); "
+            f"got ModelConfig.cell={model_cfg.cell!r} — train lstm on "
+            "the dp-only path and ssm in its parallel scan mode "
+            "(fmda_tpu.train.Trainer)")
     if model_cfg.remat:
         # long-context windows: recompute the forward in the backward pass
         # instead of keeping every per-step hidden alive (HBM is the

@@ -52,10 +52,18 @@ is equal-sized; the extra lanes are permanent padding nothing ever
 allocates.  A ``mesh`` spanning **one** device (or ``mesh=None``) takes
 the exact unsharded code path — bit-identical to the pre-sharding pool.
 
-Scope: the unidirectional recurrent carriers (``cell="gru"``/``"lstm"``,
-any ``n_layers`` — the pure O(1)-per-tick cores).  Bidirectional or attn
-serving re-encodes a window per tick; multiplex those through the
-window-re-scan :class:`~fmda_tpu.serve.predictor.Predictor` instead.
+Scope: the unidirectional recurrent carriers (``cell="gru"``/``"lstm"``/
+``"ssm"``, any ``n_layers`` — the pure O(1)-per-tick cores).
+Bidirectional or attn serving re-encodes a window per tick; multiplex
+those through the window-re-scan
+:class:`~fmda_tpu.serve.predictor.Predictor` instead.
+
+The ``cell="ssm"`` pool carries the family's **constant-size cache**:
+three H-vectors per layer per session, a zero-width ring (the EMA head
+needs no window state), and no per-tick matmul or gather beyond the
+slot indexing — the smallest state tree of the families, which is what
+donation, migration export (:meth:`export_slot`), and the columnar wire
+blocks then move (docs/runtime.md "The SSM cell family").
 """
 
 from __future__ import annotations
@@ -73,6 +81,7 @@ from fmda_tpu.data.normalize import NormParams
 from fmda_tpu.serve.streaming import (
     _recurrent_cell_ops,
     advance_cells,
+    ema_head_logits,
     pooled_head_logits,
 )
 
@@ -119,7 +128,9 @@ class SessionPool:
         mesh=None,
         shard_axis: str = "dp",
     ) -> None:
-        gate_step, _, self._n_carry, _ = _recurrent_cell_ops(cfg.cell)
+        cell_ops = _recurrent_cell_ops(cfg.cell, use_pallas=cfg.use_pallas)
+        gate_step, self._n_carry = cell_ops.gate_step, cell_ops.n_carry
+        self._head = cell_ops.head
         if cfg.bidirectional:
             raise ValueError(
                 "SessionPool multiplexes the unidirectional carried-state "
@@ -181,7 +192,12 @@ class SessionPool:
             tuple(place(jnp.zeros((n_slots, hidden), dtype))
                   for _ in range(self._n_carry))
             for _ in range(cfg.n_layers))
-        self._ring = place(jnp.zeros((n_slots, window, hidden), dtype))
+        # carry-head cells (ssm) keep a ZERO-WIDTH ring: the pooling
+        # state lives inside the cell carry, so nothing in the pooled
+        # tree is sized by `window` — donation, export_slot, and the
+        # wire codec all carry the same (tiny) leaf unchanged
+        ring_w = window if self._head == "ring" else 0
+        self._ring = place(jnp.zeros((n_slots, ring_w, hidden), dtype))
         self._pos = place(jnp.zeros((n_slots,), jnp.int32))
         # per-slot normalization (sessions serve different tickers with
         # different price scales), gathered alongside the state
@@ -217,12 +233,19 @@ class SessionPool:
                 tuple(c[slots] for c in layer) for layer in carry)
             h_new, carry_new = advance_cells(params, cfg, gate_step, x,
                                              carry_b)
-            ring = ring.at[slots, pos_b % w].set(h_new)
-            ring_b = ring[slots]
-            # per-session valid trailing window: n_valid is (B, 1) here,
-            # a scalar in the solo carrier — same head either way
-            n_valid = jnp.minimum(pos_b + 1, w)[:, None]
-            logits = pooled_head_logits(params, h_new, ring_b, n_valid)
+            if self._head == "carry":
+                # ssm: pooling state rides the carry; the zero-width
+                # ring passes through untouched (kept for a uniform
+                # step signature/donation layout)
+                logits = ema_head_logits(params, h_new, carry_new[-1])
+            else:
+                ring = ring.at[slots, pos_b % w].set(h_new)
+                ring_b = ring[slots]
+                # per-session valid trailing window: n_valid is (B, 1)
+                # here, a scalar in the solo carrier — same head either
+                # way
+                n_valid = jnp.minimum(pos_b + 1, w)[:, None]
+                logits = pooled_head_logits(params, h_new, ring_b, n_valid)
             carry_out = tuple(
                 tuple(c.at[slots].set(cb)
                       for c, cb in zip(carry[layer], carry_new[layer]))

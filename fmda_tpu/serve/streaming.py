@@ -30,9 +30,14 @@ sql_pytorch_dataloader windows).  Longer forward memory, O(1)/O(window)
 ticks — choose per deployment; both are exposed, and both are verified
 against explicit reference computations in tests.
 
-Both recurrent families stream through the same cores: ``cell="lstm"``
+The recurrent families stream through the same cores: ``cell="lstm"``
 carries ``(h, c)`` instead of ``(h,)`` and re-scans the backward
-direction with the LSTM recurrence — dispatch via
+direction with the LSTM recurrence; ``cell="ssm"`` (the O(1)-cache
+family, fmda_tpu.ops.ssm) carries ``(s, ema_fast, ema_slow)`` — a
+constant-size cache with **no ring at all**: its head pools with the
+two carried EMAs instead of windowed max/mean, so the per-tick step is
+matmul-free elementwise work and the exported session state is three
+H-vectors instead of a ``(window, H)`` ring — dispatch via
 :func:`_recurrent_cell_ops`.  The attn family deliberately has no
 carried-state core: its sliding-window positions re-index every tick, so
 the window re-encode IS the :class:`~fmda_tpu.serve.predictor.Predictor`.
@@ -41,7 +46,7 @@ the window re-encode IS the :class:`~fmda_tpu.serve.predictor.Predictor`.
 from __future__ import annotations
 
 import logging
-from typing import List, Tuple
+from typing import Callable, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,12 +57,19 @@ from fmda_tpu.data.normalize import NormParams
 from fmda_tpu.serve.predictor import labels_over_threshold
 from fmda_tpu.ops.gru import GRUWeights, gru_gates, gru_scan
 from fmda_tpu.ops.lstm import LSTMWeights, lstm_gates, lstm_scan
+from fmda_tpu.ops.ssm import SSMWeights, select_ssm_step_fn, ssm_cell_step
 
 log = logging.getLogger("fmda_tpu.serve")
 
 
 def _layer_weights(params, reverse: bool, cell: str = "gru", layer: int = 0):
     suffix = f"l{layer}" + ("_reverse" if reverse else "")
+    if cell == "ssm":
+        return SSMWeights(
+            params[f"weight_ih_{suffix}"], params[f"bias_ih_{suffix}"],
+            params[f"a_base_{suffix}"], params[f"d_{suffix}"],
+            params[f"rho_f_{suffix}"], params[f"rho_s_{suffix}"],
+        )
     cls = GRUWeights if cell == "gru" else LSTMWeights
     return cls(
         params[f"weight_ih_{suffix}"], params[f"weight_hh_{suffix}"],
@@ -69,17 +81,39 @@ def _layer0_weights(params, reverse: bool, cell: str = "gru"):
     return _layer_weights(params, reverse, cell, layer=0)
 
 
-def _recurrent_cell_ops(cell: str):
-    """(gate_step, bwd_scan, n_carry, n_gates) for a recurrent family.
+class CellOps(NamedTuple):
+    """One recurrent family's carried-state serving contract.
 
     ``gate_step(xp, carry, w) -> (h_new, carry_new)`` advances one tick
-    (carry is a tuple: ``(h,)`` for GRU, ``(h, c)`` for LSTM — both
-    families' torch-convention ``BiGRUState``/``BiLSTMState`` analogues);
-    ``bwd_scan(xp_nf, zeros, w) -> hs`` is the backward-direction window
-    re-scan from a zero state.  The attn family has no carried state —
-    its window re-encode IS the :class:`~fmda_tpu.serve.predictor
-    .Predictor` (sliding positions re-index every tick), so it
-    deliberately stays out of this dispatch.
+    (carry is a tuple: ``(h,)`` for GRU, ``(h, c)`` for LSTM,
+    ``(s, ema_fast, ema_slow)`` for SSM); ``bwd_scan(xp_nf, zeros, w)
+    -> hs`` is the backward-direction window re-scan from a zero state
+    (``None`` for families without one); ``head`` names the pooling
+    state the core carries — ``"ring"`` (a (window, H) ring of per-step
+    hiddens fed to :func:`pooled_head_logits`) or ``"carry"`` (the
+    pooling state lives *inside* the cell carry and the head reads it
+    via :func:`ema_head_logits`: no ring, nothing sized by ``window``).
+    """
+
+    gate_step: Callable
+    bwd_scan: Optional[Callable]
+    n_carry: int
+    n_gates: int
+    head: str
+
+
+def _recurrent_cell_ops(cell: str, use_pallas: bool = False) -> CellOps:
+    """:class:`CellOps` for a recurrent family.
+
+    The attn family has no carried state — its window re-encode IS the
+    :class:`~fmda_tpu.serve.predictor.Predictor` (sliding positions
+    re-index every tick), so it deliberately stays out of this dispatch.
+
+    ``use_pallas`` lets the SSM family request its fused serve-step
+    kernel (per-shape selection at trace time, counted fallback
+    elsewhere — :func:`fmda_tpu.ops.ssm.select_ssm_step_fn`); the
+    GRU/LSTM per-tick step is a single small matmul + gate fusion XLA
+    already compiles tightly, so they take no kernel here.
     """
     if cell == "gru":
         def gate_step(xp, carry, w):
@@ -89,7 +123,7 @@ def _recurrent_cell_ops(cell: str):
         def bwd_scan(xp_nf, zeros, w):
             return gru_scan(xp_nf, zeros, w.w_hh, w.b_hh)[1]
 
-        return gate_step, bwd_scan, 1, 3
+        return CellOps(gate_step, bwd_scan, 1, 3, "ring")
     if cell == "lstm":
         def gate_step(xp, carry, w):
             h_new, c_new = lstm_gates(xp, carry[0], carry[1], w.w_hh, w.b_hh)
@@ -99,11 +133,34 @@ def _recurrent_cell_ops(cell: str):
             return lstm_scan(xp_nf, zeros, jnp.zeros_like(zeros),
                              w.w_hh, w.b_hh)[1]
 
-        return gate_step, bwd_scan, 2, 4
+        return CellOps(gate_step, bwd_scan, 2, 4, "ring")
+    if cell == "ssm":
+        def gate_step(xp, carry, w):
+            # per-shape kernel-vs-jnp choice at trace time (shapes are
+            # static under jit; the counted fallback fires at most once
+            # per compiled program)
+            step = select_ssm_step_fn(
+                use_pallas,
+                shape=(xp.shape[0], carry[0].shape[-1]),
+                itemsize=xp.dtype.itemsize,
+            ) if use_pallas else ssm_cell_step
+            return step(xp, carry, w)
+
+        # Numerical caveat (measured, documented): the ssm tick is a
+        # pure elementwise chain with no matmul anchors after the input
+        # projection, so XLA's fusion/FMA choices can differ BETWEEN
+        # separately compiled programs by ~1 ulp at some shapes (seen
+        # at F=108 solo-core vs pool on CPU; the gru/lstm chains are
+        # pinned by their h@W_hh matmul and compile identically).
+        # Same-program contracts — migration export/import, drain/
+        # replay, chaos identity, every pool<->pool comparison — remain
+        # bit-exact; solo-vs-pool comparisons at untested shapes may
+        # sit at the last bit (the batched 1e-6 contract still holds).
+        return CellOps(gate_step, None, 3, 3, "carry")
     raise ValueError(
         "the carried-state streaming cores cover the recurrent families "
-        "(cell='gru'/'lstm'); use the window-re-scan Predictor for "
-        f"ModelConfig.cell={cell!r}"
+        "(cell='gru'/'lstm'/'ssm'); use the window-re-scan Predictor "
+        f"for ModelConfig.cell={cell!r}"
     )
 
 
@@ -149,12 +206,28 @@ def pooled_head_logits(params, h_last, ring, n_valid):
     return concat @ params["linear"]["kernel"] + params["linear"]["bias"]
 
 
+def ema_head_logits(params, h_last, carry_last):
+    """The SSM family's head over its carried pooling state: concat
+    ``[h_last, ema_fast, ema_slow]`` through the same ``linear`` params
+    the train-mode twin (``models.common.ema_concat_logits``) creates —
+    no ring, no window, O(1) state.  ``carry_last`` is the LAST layer's
+    cell carry ``(s, ema_fast, ema_slow)``."""
+    _, ema_fast, ema_slow = carry_last
+    concat = jnp.concatenate([h_last, ema_fast, ema_slow], axis=-1)
+    return concat @ params["linear"]["kernel"] + params["linear"]["bias"]
+
+
 class StreamingBiGRU:
     """Carried-state streaming inference core for unidirectional models.
 
     Holds (h, ring of last ``window`` hidden outputs); each ``step(row)``
     advances the recurrence by one row and produces logits from the pooled
     head, exactly as a full re-scan of the trailing window would.
+
+    ``cell="ssm"`` carries no ring at all (the pooling state is the two
+    EMAs inside the cell carry; the ring buffer is kept zero-width so
+    the step signature and donation layout stay uniform) — the carried
+    state is a constant three H-vectors however large ``window`` is.
     """
 
     def __init__(
@@ -166,7 +239,9 @@ class StreamingBiGRU:
         window: int,
         batch: int = 1,
     ) -> None:
-        gate_step, _, self._n_carry, _ = _recurrent_cell_ops(cfg.cell)
+        ops = _recurrent_cell_ops(cfg.cell, use_pallas=cfg.use_pallas)
+        gate_step, self._n_carry = ops.gate_step, ops.n_carry
+        self._head = ops.head
         if cfg.bidirectional:
             raise ValueError(
                 "carried-state streaming needs bidirectional=False; the "
@@ -197,10 +272,15 @@ class StreamingBiGRU:
 
             ``carry`` is a per-layer tuple of cell-carry tuples — stacked
             layers stay O(1)/tick (advance_cells; the ring pools the LAST
-            layer's outputs, models/bigru.py:148-150)."""
+            layer's outputs, models/bigru.py:148-150).  Carry-head cells
+            (ssm) skip the ring entirely and read their pooling state
+            out of the last layer's carry."""
             x = ((row - x_min) / x_range).astype(dtype)
             h_new, carry_new = advance_cells(params, cfg, gate_step, x,
                                              carry)
+            if self._head == "carry":
+                logits = ema_head_logits(params, h_new, carry_new[-1])
+                return logits, carry_new, ring, ring_pos + 1
             ring = jax.lax.dynamic_update_index_in_dim(
                 ring, h_new, ring_pos % self.window, axis=1
             )
@@ -220,12 +300,16 @@ class StreamingBiGRU:
 
     def reset(self) -> None:
         hidden = self.cfg.hidden_size
-        # per-layer tuple of cell-carry tuples ((h,) GRU / (h, c) LSTM)
+        # per-layer tuple of cell-carry tuples ((h,) GRU / (h, c) LSTM /
+        # (s, ema_fast, ema_slow) SSM)
         self._h = tuple(
             tuple(jnp.zeros((self.batch, hidden), self._dtype)
                   for _ in range(self._n_carry))
             for _ in range(self.cfg.n_layers))
-        self._ring = jnp.zeros((self.batch, self.window, hidden), self._dtype)
+        # carry-head cells keep a zero-width ring: same step signature
+        # and donation layout, no per-tick window state
+        ring_w = self.window if self._head == "ring" else 0
+        self._ring = jnp.zeros((self.batch, ring_w, hidden), self._dtype)
         self._pos = jnp.asarray(0, jnp.int32)
 
     @property
@@ -270,8 +354,18 @@ class StreamingBiGRUBidirectional:
         window: int,
         batch: int = 1,
     ) -> None:
-        gate_step, bwd_scan, self._n_carry, self._n_gates = \
-            _recurrent_cell_ops(cfg.cell)
+        ops = _recurrent_cell_ops(cfg.cell)
+        if ops.head != "ring":
+            # the bidirectional core's pooling sums per-step fwd+bwd
+            # outputs over a ring — a carry-head family (ssm) has no
+            # ring and serves unidirectionally (its whole point); the
+            # window-re-scan Predictor covers its bidirectional models
+            raise ValueError(
+                f"cell={cfg.cell!r} has no bidirectional carried-state "
+                "core; serve it with the unidirectional StreamingBiGRU "
+                "(O(1) cache) or the window-re-scan Predictor")
+        gate_step, bwd_scan = ops.gate_step, ops.bwd_scan
+        self._n_carry, self._n_gates = ops.n_carry, ops.n_gates
         if not cfg.bidirectional:
             raise ValueError(
                 "use StreamingBiGRU for unidirectional models (pure O(1))")

@@ -79,6 +79,15 @@ class TestPhaseRegistry:
         }
         assert expected == set(bench._PHASES)
 
+    def test_kernel_sweep_and_fleet_ab_cover_the_ssm_family(self):
+        """ISSUE 14 phase-change pin: the kernel sweep races the SSM
+        serve-step kernel alongside the GRU scan kernel, and the fleet
+        smoke A/Bs the same cell pair at equal H.  A family added to
+        the serving tier must be added to both measurement surfaces
+        (and to this pin) in the same PR."""
+        assert set(bench.KERNEL_SWEEP_FAMILIES) == {"gru", "ssm"}
+        assert set(bench.FLEET_AB_CELLS) == {"gru", "ssm"}
+
 
 SAMPLE = (
     "# R\n\nbody\n\n## Seed robustness (x)\n\nold table\n\n"

@@ -335,18 +335,30 @@ def _spawn_ok():
 
 
 @pytest.mark.slow
-def test_chaos_soak_never_abort_gates():
+@pytest.mark.parametrize("cell", ["gru", "ssm"])
+def test_chaos_soak_never_abort_gates(cell):
     """The end-to-end never-abort contract under a real kill/revive
     plan: spawned workers, a SIGKILLed worker revived mid-run, a router
     takeover rebuilding the registry from worker session reports, a
     control-bus outage — every gate must hold (zero uncounted losses,
     no orphaned session, post-chaos serving, clean sessions
     bit-identical to an unfaulted replay).  The bench phase
-    ``runtime_chaos_soak`` runs the larger calibrated shape."""
+    ``runtime_chaos_soak`` runs the larger calibrated shape.
+
+    Parametrized over the GRU reference AND the SSM cell family
+    (ISSUE 14): the identity gates must stay green with the O(1)-cache
+    state riding the whole drain/export/replay machinery (the soak
+    ships [model] cell to every spawned worker via the config file)."""
     if not _spawn_ok():
         pytest.skip("subprocess spawn unavailable")
-    from fmda_tpu.chaos.soak import run_chaos_soak
+    import dataclasses
 
+    from fmda_tpu.chaos.soak import run_chaos_soak
+    from fmda_tpu.config import FrameworkConfig
+
+    cfg = FrameworkConfig()
+    cfg = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, cell=cell))
     workers = ["w0", "w1"]
     plan = FaultPlan.generate(
         1, 40, workers=workers, worker_kills=1, revive_after=8,
@@ -354,7 +366,7 @@ def test_chaos_soak_never_abort_gates():
         settle_steps=8)
     out = run_chaos_soak(
         plan, n_workers=len(workers), n_sessions=8, hidden=8, seed=1,
-        round_sleep_s=0.04, compare_unfaulted=True)
+        round_sleep_s=0.04, compare_unfaulted=True, config=cfg)
     assert out["gates_ok"], json.dumps(
         {k: v for k, v in out.items() if k != "worker_stats"},
         indent=2, default=str)

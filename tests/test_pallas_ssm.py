@@ -1,0 +1,130 @@
+"""Fused Pallas SSM serve-step kernel vs the jnp reference.
+
+Same coverage ladder as the sibling kernel suites:
+- interpret-mode numerical parity (runs anywhere, including this CI);
+- Mosaic TPU *lowering* via ``jax.export(platforms=['tpu'])`` — catches
+  tiling/layout rejections without a TPU;
+- on-device parity, gated on an actual TPU backend being reachable;
+plus the per-shape selection predicate and the counted-fallback seam
+(``fmda_tpu.ops.ssm.select_ssm_step_fn``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+# jax.export is a real submodule on every supported jax, but older
+# releases only expose it as a `jax` attribute after an explicit import
+import jax.export  # noqa: F401
+import jax.numpy as jnp
+
+from fmda_tpu.ops.pallas_ssm import kernel_supported, ssm_cell_step_pallas
+from fmda_tpu.ops.ssm import SSMWeights, ssm_cell_step, ssm_input_projection
+
+
+def _setup(batch=4, feats=10, hidden=8, key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 7)
+    w = SSMWeights(
+        w_ih=jax.random.normal(ks[0], (3 * hidden, feats)) * 0.3,
+        b_ih=jax.random.normal(ks[1], (3 * hidden,)) * 0.1,
+        a_base=jax.random.uniform(ks[2], (hidden,), minval=1.0, maxval=3.0),
+        d=jax.random.normal(ks[3], (hidden,)) * 0.3,
+        rho_f=jax.random.normal(ks[4], (hidden,)) * 0.5,
+        rho_s=jax.random.normal(ks[5], (hidden,)) * 0.5 + 3.0,
+    )
+    x = jax.random.normal(ks[6], (batch, 1, feats))
+    xp = ssm_input_projection(x, w)[:, 0]
+    carry = tuple(
+        jax.random.normal(jax.random.fold_in(ks[6], i), (batch, hidden))
+        for i in range(3))
+    return w, xp, carry
+
+
+def test_pallas_step_matches_jnp_step():
+    w, xp, carry = _setup()
+    h_ref, c_ref = ssm_cell_step(xp, carry, w)
+    h_pal, c_pal = ssm_cell_step_pallas(xp, carry, w, interpret=True)
+    np.testing.assert_allclose(np.asarray(h_pal), np.asarray(h_ref),
+                               atol=1e-6)
+    for a, b in zip(c_pal, c_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_pallas_step_zero_state_and_repeated_ticks():
+    """Stepping the kernel T times from zeros tracks the jnp cache tick
+    for tick — the serving loop's exact usage."""
+    w, _, _ = _setup(key=1)
+    B, H = 3, 8
+    x = jax.random.normal(jax.random.PRNGKey(2), (B, 6, 10))
+    xp = ssm_input_projection(x, w)
+    c_ref = c_pal = tuple(jnp.zeros((B, H)) for _ in range(3))
+    for t in range(6):
+        h_ref, c_ref = ssm_cell_step(xp[:, t], c_ref, w)
+        h_pal, c_pal = ssm_cell_step_pallas(
+            xp[:, t], c_pal, w, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(h_pal), np.asarray(h_ref), atol=1e-5)
+
+
+def test_pallas_step_bf16_numerics_close_to_jnp():
+    """bf16 I/O with f32 gate algebra in-kernel tracks the jnp step run
+    in f32 within bf16 tolerance."""
+    w, xp, carry = _setup(key=3)
+    to_bf16 = lambda t: jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16), t)
+    h_ref, c_ref = ssm_cell_step(xp, carry, w)
+    h_pal, c_pal = ssm_cell_step_pallas(
+        to_bf16(xp), to_bf16(carry), SSMWeights(*to_bf16(tuple(w))),
+        interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(h_pal, np.float32), np.asarray(h_ref), atol=0.05)
+    for a, b in zip(c_pal, c_ref):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b), atol=0.05)
+
+
+def test_pallas_step_lowers_for_tpu():
+    """Mosaic TPU lowering of the serve step at a fleet bucket shape via
+    jax.export — no TPU needed, rejects tiling/layout breakage."""
+    w, xp, carry = _setup(batch=16, hidden=32, key=4)
+
+    def serve_like(xp_, carry_):
+        return ssm_cell_step_pallas(xp_, carry_, w)
+
+    exported = jax.export.export(jax.jit(serve_like), platforms=["tpu"])(
+        xp, carry)
+    assert "tpu" in exported.platforms
+
+
+def test_pallas_step_on_tpu_device():
+    if jax.default_backend() != "tpu":
+        pytest.skip("no TPU backend")
+    w, xp, carry = _setup()
+    h_ref, c_ref = ssm_cell_step(xp, carry, w)
+    h_pal, c_pal = ssm_cell_step_pallas(xp, carry, w)
+    np.testing.assert_allclose(np.asarray(h_pal), np.asarray(h_ref),
+                               atol=1e-5)
+
+
+class TestKernelSupported:
+    def test_fleet_bucket_shapes_supported(self):
+        for batch in (1, 16, 64, 256):
+            assert kernel_supported(batch, 32, 4)
+        assert kernel_supported(256, 512, 4)
+
+    def test_absurd_shapes_fall_back(self):
+        assert not kernel_supported(200_000, 2048, 4)
+
+    def test_select_gates_on_shape_and_counts(self, monkeypatch):
+        from fmda_tpu.ops import ssm as ssm_mod
+        from fmda_tpu.ops.dispatch import (
+            kernel_fallbacks, reset_kernel_fallbacks)
+
+        monkeypatch.setattr(ssm_mod, "ssm_pallas_available", lambda: True)
+        reset_kernel_fallbacks()
+        assert ssm_mod.select_ssm_step_fn(
+            True, shape=(16, 32)) is ssm_cell_step_pallas
+        assert ssm_mod.select_ssm_step_fn(
+            True, shape=(200_000, 2048)) is ssm_mod.ssm_cell_step
+        assert kernel_fallbacks().get("ssm:vmem", 0) == 1
+        reset_kernel_fallbacks()

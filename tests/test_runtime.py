@@ -412,13 +412,21 @@ def test_admission_rejection_is_counted():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("cell", ["gru", "lstm"])
+@pytest.mark.parametrize("cell", ["gru", "lstm", "ssm"])
 def test_multiplexed_bucket1_bit_identical_to_solo(cell):
     """The multiplexing machinery itself — slot gather/scatter, per-slot
     ring positions, generation bookkeeping, interleaving with OTHER
     sessions' flushes — adds exactly zero numerical change: at bucket
     size 1 every multiplexed output is bit-identical to a solo
-    StreamingBiGRU run of the same tick stream."""
+    StreamingBiGRU run of the same tick stream.  Parametrized over the
+    whole carried-state family, including the ring-free O(1)-cache ssm
+    core (ISSUE 14).  Note the ssm caveat documented in
+    _recurrent_cell_ops: its matmul-free elementwise chain gives XLA
+    fusion freedom that can differ between the solo and pool programs
+    by ~1 ulp at some (wider) shapes — bit identity holds at this
+    pinned shape, same-program contracts (migration, drain/replay) are
+    bit-exact at every shape, and the batched test below carries the
+    1e-6 wide-shape contract for ssm too."""
     feats, window, n = 6, 4, 3
     cfg, params = _setup(feats=feats, cell=cell)
     pool = SessionPool(cfg, params, capacity=n, window=window)
@@ -444,13 +452,16 @@ def test_multiplexed_bucket1_bit_identical_to_solo(cell):
     assert pool.compile_count == 1
 
 
-def test_multiplexed_batched_matches_solo_within_ulp():
+@pytest.mark.parametrize("cell", ["gru", "ssm"])
+def test_multiplexed_batched_matches_solo_within_ulp(cell):
     """Batched buckets with ragged per-session duty cycles: every served
     tick matches the solo carrier to float32 ulp noise (1e-6 — the same
     tolerance the seed's lockstep-batched test uses; XLA's B>1 matmul
-    reduction order differs from B=1 at the last bit)."""
+    reduction order differs from B=1 at the last bit).  This is also
+    the ssm family's cross-program wide-shape contract (see the ulp
+    caveat on the bucket-1 test above)."""
     feats, window, n = 6, 4, 5
-    cfg, params = _setup(feats=feats)
+    cfg, params = _setup(feats=feats, cell=cell)
     pool = SessionPool(cfg, params, capacity=n, window=window)
     gw = FleetGateway(
         pool, batcher_config=BatcherConfig(bucket_sizes=(2, 8),
