@@ -35,6 +35,15 @@ rule id                   severity    contract
                                       at module scope
 ``chaos-guard``           error       every ``_CHAOS`` touch sits under
                                       ``if _CHAOS.enabled:``
+``counted-loss``          warning     hot-path except handlers re-raise,
+                                      count, or carry ``# loss-free:
+                                      reason``; loss counters cross-check
+                                      against the soak gates' vocabulary
+``wire-protocol``         error       every produced op/kind has a consumer
+                                      branch and vice versa; v2 constructs
+                                      keep a reachable legacy lowering
+``thread-lifecycle``      error       spawned threads are daemonized or
+                                      joined/cancelled on a close path
 ========================  ==========  =========================================
 
 Entry points: ``python -m fmda_tpu lint`` (exit 0 = clean vs baseline,
@@ -42,6 +51,7 @@ Entry points: ``python -m fmda_tpu lint`` (exit 0 = clean vs baseline,
 ``docs/analysis.md`` for the baseline workflow and how to write a rule.
 """
 
+from fmda_tpu.analysis.accounting import CountedLossRule
 from fmda_tpu.analysis.compat_required import CompatRequiredRule
 from fmda_tpu.analysis.drift import DRIFT_SCOPE, JaxApiDriftRule
 from fmda_tpu.analysis.engine import (
@@ -67,7 +77,11 @@ from fmda_tpu.analysis.hygiene import (
 )
 from fmda_tpu.analysis.locks import LockDisciplineRule
 from fmda_tpu.analysis.metric_names import MetricNamesRule
+from fmda_tpu.analysis.program import ProgramIndex
+from fmda_tpu.analysis.protocol import WireProtocolRule
 from fmda_tpu.analysis.purity import JitPurityRule
+from fmda_tpu.analysis.sarif import to_sarif
+from fmda_tpu.analysis.threads import ThreadLifecycleRule
 from fmda_tpu.analysis.topics import BusTopicRule
 
 __all__ = [
@@ -89,14 +103,19 @@ __all__ = [
     "BusTopicRule",
     "ChaosGuardRule",
     "CompatRequiredRule",
+    "CountedLossRule",
     "HotPathJsonRule",
     "JaxApiDriftRule",
     "JitPurityRule",
     "LockDisciplineRule",
     "LoggingHygieneRule",
     "MetricNamesRule",
+    "ProgramIndex",
     "RouterJaxImportRule",
     "SpanClockRule",
+    "ThreadLifecycleRule",
+    "WireProtocolRule",
+    "to_sarif",
 ]
 
 
@@ -115,6 +134,9 @@ def default_rules(*, drift: bool = True):
         MetricNamesRule(),
         CompatRequiredRule(),
         HotPathJsonRule(),
+        CountedLossRule(),
+        WireProtocolRule(),
+        ThreadLifecycleRule(),
     ]
     if drift:
         rules.append(JaxApiDriftRule())

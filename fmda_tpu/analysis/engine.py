@@ -135,12 +135,23 @@ class LintContext:
         self.package_dir = package_dir
         self.modules = list(modules)
         self.reports: Dict[str, object] = {}
+        self._index = None
 
     def module(self, rel: str) -> Optional[ParsedModule]:
         for m in self.modules:
             if m.rel == rel:
                 return m
         return None
+
+    def index(self):
+        """The whole-program :class:`~fmda_tpu.analysis.program
+        .ProgramIndex` (constants, function/counter catalog), built
+        lazily on first use and shared by every rule in the run."""
+        if self._index is None:
+            from fmda_tpu.analysis.program import ProgramIndex
+
+            self._index = ProgramIndex(self.modules)
+        return self._index
 
 
 class Rule:

@@ -142,7 +142,8 @@ class ChaosRuntime:
             if self.on_fault is not None:
                 try:
                     self.on_fault(point, e.kind, self._step)
-                except Exception:  # noqa: BLE001 — an observer must
+                except Exception:  # noqa: BLE001 — loss-free: an
+                    # observer failure loses telemetry only; it must
                     # never turn an injected fault into a real crash
                     log.exception("chaos on_fault observer raised")
 

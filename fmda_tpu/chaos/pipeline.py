@@ -74,6 +74,18 @@ log = logging.getLogger("fmda_tpu.chaos")
 #: book feed down just pauses the pipeline — no join stress)
 SIDE_FEED_TOPICS = ("vix", "volume", "cot", "ind")
 
+#: the pipeline gate's conservation vocabulary: report fields summed as
+#: losses in ``ingested == landed + Σ losses`` — the data-plane
+#: counterpart of ``fmda_tpu.chaos.soak.LOSS_COUNTERS`` (these are
+#: report keys over engine/journal stats, not RuntimeMetrics counter
+#: names; docs/analysis.md "The conservation vocabulary")
+PIPELINE_LOSS_FIELDS = (
+    "dropped_unjoinable",
+    "pending_joins",
+    "journal_pending",
+    "journal_shed",
+)
+
 
 def generate_pipeline_plan(
     seed: int,
@@ -273,6 +285,8 @@ def _run_pipeline(plan: FaultPlan, *, seed, rounds, bars_per_round,
                 engine_restarts += 1
             try:
                 engine.step()
+            # loss-free: the kill IS the experiment — the conservation
+            # gate re-derives every loss from the replayed/landed state
             except ChaosFault:
                 # SIGKILL semantics: drop the object with no cleanup;
                 # counters it accumulated since the last checkpoint die
@@ -369,6 +383,12 @@ def _gate_report(plan: FaultPlan, run: dict, *, predictor: bool) -> dict:
         "journal_pending": journal["pending"],
         "journal_shed": journal["shed_rows"],
     }
+    # the declared vocabulary and the summed terms must never drift
+    # apart: a reordered/extended PIPELINE_LOSS_FIELDS that this dict
+    # does not mirror would mislabel the per-field attribution
+    # operators act on while the (order-independent) total stayed green
+    assert set(losses) == set(PIPELINE_LOSS_FIELDS), (
+        sorted(losses), PIPELINE_LOSS_FIELDS)
     unaccounted = run["ingested"] - run["landed"] - sum(losses.values())
     planned = run["plan"]
     feed_faults = [k for k in planned if k.startswith("kill:feed:")]

@@ -393,8 +393,9 @@ def _run_topology(
         topo.router = router  # shutdown must stop through the live one
         try:
             worker_stats = topo.shutdown()
-        except Exception:  # noqa: BLE001 — a teardown failure must not
-            # mask the run's own verdict (or its exception)
+        except Exception:  # noqa: BLE001 — loss-free: a teardown
+            # failure must not mask the run's own verdict (or its
+            # exception); the gates already have their evidence
             log.exception("soak teardown failed")
             worker_stats = {}
     return {
@@ -530,6 +531,7 @@ def _router_takeover(
             n_features=old.n_features,
             from_end=True,
         )
+    # loss-free: the takeover retries next step; nothing is dropped
     except (ConnectionError, OSError) as e:
         log.warning(
             "chaos: router takeover at step %d blocked by an active "

@@ -1374,6 +1374,13 @@ def cmd_lint(args) -> int:
         out.parent.mkdir(parents=True, exist_ok=True)
         out.write_text(
             json.dumps(result.reports["jax_api_drift"], indent=2) + "\n")
+    if args.sarif:
+        from fmda_tpu.analysis import to_sarif
+
+        out = pathlib.Path(args.sarif)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(
+            json.dumps(to_sarif(result, rules), indent=2) + "\n")
     if args.json:
         print(json.dumps(result.as_dict(), indent=2))
         return 0 if result.ok else 1
@@ -1734,6 +1741,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the machine-readable jax drift inventory "
                         "(the porting work-list artifact: "
                         "artifacts/jax_api_drift.json in this repo)")
+    p.add_argument("--sarif", default=None, metavar="FILE",
+                   help="write the run as a SARIF 2.1.0 document (new "
+                        "findings as results, baselined ones suppressed) "
+                        "— what CI uploads to render findings as diff "
+                        "annotations")
     p.set_defaults(fn=cmd_lint)
     return parser
 

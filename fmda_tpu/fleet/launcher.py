@@ -53,7 +53,7 @@ def spawn_supported(python: str = sys.executable) -> bool:
             [python, "-c", "pass"], timeout=60,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         return proc.returncode == 0
-    except Exception:  # noqa: BLE001 — any failure means "no"
+    except Exception:  # noqa: BLE001 — loss-free: a capability probe; any failure means "no"
         return False
 
 
@@ -70,7 +70,7 @@ def _build_local_bus(config: FrameworkConfig, topics: Sequence[str]):
                 topics,
                 arena_bytes=config.fleet.bus_arena_bytes,
                 max_records=config.bus.capacity)
-    except Exception as e:  # noqa: BLE001 — fall back, never fail startup
+    except Exception as e:  # noqa: BLE001 — loss-free: loud fallback to InProcessBus, never a failed startup
         log.warning("native bus unavailable (%s); using InProcessBus", e)
     from fmda_tpu.stream.bus import InProcessBus
 
@@ -107,7 +107,7 @@ class LocalFleet:
     def proc_for(self, worker_id: str) -> Optional[subprocess.Popen]:
         try:
             return self.procs[self.worker_ids.index(worker_id)]
-        except ValueError:
+        except ValueError:  # loss-free: unknown id means "no process"
             return None
 
     def kill_worker(self, worker_id: str) -> bool:
@@ -163,6 +163,8 @@ class LocalFleet:
                 if all(p.poll() is not None for p in self.procs):
                     break
                 time.sleep(0.05)
+        # loss-free: shutdown path — the finally below still reaps
+        # every process, and final stats come from the router's view
         except ConnectionError:
             log.warning("bus connection lost during shutdown")
         finally:
@@ -173,6 +175,8 @@ class LocalFleet:
                 if p.poll() is None:
                     try:
                         p.wait(timeout=5.0)
+                    # loss-free: escalation, not a swallow — the kill
+                    # below reaps the process that ignored terminate()
                     except subprocess.TimeoutExpired:
                         p.kill()
             self.router.close()
@@ -187,7 +191,7 @@ class LocalFleet:
             try:
                 with open(path) as fh:
                     out[name] = fh.read()
-            except OSError:
+            except OSError:  # loss-free: post-mortem probe; no log is ""
                 out[name] = ""
         return out
 
@@ -299,6 +303,8 @@ def launch_local_fleet(
                         with open(os.path.join(
                                 log_dir, f"{wid}.log")) as fh:
                             tail = fh.read()[-2000:]
+                    # loss-free: the log tail is best-effort garnish —
+                    # the RuntimeError below still raises either way
                     except OSError:
                         pass
                     raise RuntimeError(

@@ -235,22 +235,22 @@ class BusServer:
             # shutdown interrupts the accept with an error immediately
             try:
                 self._listener.shutdown(socket.SHUT_RDWR)
-            except OSError:
+            except OSError:  # loss-free: teardown; close() follows
                 pass  # some platforms refuse shutdown on a listener
             try:
                 self._listener.close()
-            except OSError:
+            except OSError:  # loss-free: teardown of a dead listener
                 pass
         with self._lock:
             conns = list(self._conns)
         for conn in conns:
             try:
                 conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
+            except OSError:  # loss-free: teardown; close() follows
                 pass
             try:
                 conn.close()
-            except OSError:
+            except OSError:  # loss-free: teardown of a dying connection
                 pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5.0)
@@ -273,6 +273,8 @@ class BusServer:
         while not self._closing:
             try:
                 conn, _addr = self._listener.accept()
+            # loss-free: the listener died or stop() closed it — no
+            # frame was in flight on the not-yet-accepted connection
             except OSError:
                 return  # listener closed (stop)
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -299,9 +301,15 @@ class BusServer:
                     try:
                         io.send_frame({"err": str(e),
                                        "kind": "FrameDecodeError"})
+                    # loss-free: the error answer failed — the peer is
+                    # gone; the malformed frame itself was already
+                    # counted (frames_malformed_total) in recv_frame
                     except (OSError, RuntimeError):
                         return
                     continue
+                # loss-free: transport death ends the connection; every
+                # client hardens against it (link_errors / bus_errors
+                # are counted by the owner that loses the link)
                 except (ConnectionError, OSError):
                     return
                 if req is None:
@@ -320,8 +328,12 @@ class BusServer:
                     try:
                         io.send_frame({"err": "unencodable response",
                                        "kind": "FrameDecodeError"})
+                    # loss-free: peer gone mid-apology — the op already
+                    # executed; the client re-counts on its side
                     except (OSError, RuntimeError):
                         return
+                # loss-free: transport death; the client's request
+                # raises ConnectionError and its owner counts the loss
                 except (OSError, RuntimeError):
                     return
         finally:
@@ -332,16 +344,20 @@ class BusServer:
                     self._frame_totals[k] += v
             try:
                 conn.close()
-            except OSError:
+            except OSError:  # loss-free: teardown of a finished connection
                 pass
 
     def _respond(self, req: dict) -> dict:
         try:
             return {"ok": self._dispatch(req)}
+        # loss-free: nothing is swallowed by either handler — the
+        # failure is converted to an err frame and re-raised client-side
+        # by SocketBus._unwrap
         except KeyError as e:
             return {"err": str(e), "kind": "KeyError"}
-        except Exception as e:  # noqa: BLE001 — op failure is the
-            # client's problem; the connection stays usable
+        except Exception as e:  # noqa: BLE001 — loss-free: op failure is
+            # the client's problem (re-raised there); the connection
+            # stays usable
             return {"err": f"{e!r}", "kind": type(e).__name__}
 
     def _dispatch(self, req: dict) -> object:
@@ -446,6 +462,8 @@ class SocketBus:
             })
         except (ConnectionError, OSError):
             raise
+        # loss-free: negotiation fallback — the connection continues on
+        # JSON frames, no message existed yet to lose
         except (RuntimeError, KeyError):
             resp = None  # pre-v2 server: unknown op
         if isinstance(resp, dict) and resp.get("format") == "binary":
@@ -460,7 +478,7 @@ class SocketBus:
         with self._lock:
             try:
                 self._sock.close()
-            except OSError:
+            except OSError:  # loss-free: teardown of a dead socket
                 pass
 
     def __enter__(self) -> "SocketBus":

@@ -337,8 +337,10 @@ class FleetWorker:
             if bind is not None:
                 try:
                     bind(registry)
+                # loss-free: metrics re-binding must never turn a
+                # reconnect fatal; the stale collector only skews obs
                 except (ConnectionError, OSError):
-                    pass  # metrics must never turn a reconnect fatal
+                    pass
         self._control_down = False
         self.metrics.count("control_reconnects")
         log.info("worker %s: control plane reconnected", self.worker_id)
@@ -346,7 +348,7 @@ class FleetWorker:
         if close is not None:
             try:
                 close()
-            except OSError:
+            except OSError:  # loss-free: teardown of the dead control bus
                 pass
         # re-hello with the session report: a NEW router on the other
         # end rebuilds its registry from exactly this message
@@ -509,6 +511,7 @@ class FleetWorker:
                             or int(msg.get("wire", 1)) < 2)),
             })
             self.metrics.count("session_reports")
+        # lint: ignore[wire-protocol] operator entry point: published by hand (or tooling) onto a worker inbox — nothing in the package produces it by design
         elif kind == "leave":
             # operator-initiated graceful leave: tell the router, which
             # migrates our sessions off and stops us when none remain

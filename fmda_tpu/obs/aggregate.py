@@ -61,6 +61,11 @@ GATEWAY_LOSS_COUNTERS = (
     "shed_oldest",
     "stale_dropped",
     "flush_results_lost",
+    # a close/reopen between dispatch and completion drops the dead
+    # incarnation's result counted — submitted, state advanced, never
+    # served: it belongs in the loss sum (the counted-loss lint rule's
+    # vocabulary cross-check caught its absence)
+    "stale_results_dropped",
 )
 
 #: heartbeat-stats fields folded per worker: stat key -> (series, kind)

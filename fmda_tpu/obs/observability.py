@@ -384,7 +384,7 @@ class Observability:
         for name, fn in sorted(self.checks.items()):
             try:
                 passed, detail = fn()
-            except Exception as e:  # noqa: BLE001 — failure IS the signal
+            except Exception as e:  # noqa: BLE001 — loss-free: failure IS the signal — it flips the health verdict it was asked for
                 passed, detail = False, f"check raised: {e!r}"
             checks[name] = {"ok": bool(passed), "detail": str(detail)}
             ok = ok and passed

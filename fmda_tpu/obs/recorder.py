@@ -103,6 +103,8 @@ class FlightRecorder:
             os.makedirs(path, exist_ok=True)
             self._write(path, reason, detail, now)
             self._rotate()
+        # loss-free: every bundle write is best-effort by contract —
+        # a full disk must never take down the alerting that fired it
         except OSError as e:
             log.error("flight recorder: bundle %s failed: %s", name, e)
             return None
@@ -145,9 +147,9 @@ class FlightRecorder:
     def _guarded(self, path: str, name: str, fn) -> None:
         try:
             fn()
-        except Exception as e:  # noqa: BLE001 — one dead source (a
-            # closed warehouse, an unserialisable stat) degrades that
-            # file, never the rest of the bundle
+        except Exception as e:  # noqa: BLE001 — loss-free: one dead
+            # source (a closed warehouse, an unserialisable stat)
+            # degrades that file, never the rest of the bundle
             log.warning("flight recorder: %s/%s skipped: %s",
                         os.path.basename(path), name, e)
 
@@ -170,7 +172,7 @@ class FlightRecorder:
             names = sorted(
                 n for n in os.listdir(self.directory)
                 if n.startswith("postmortem_"))
-        except OSError:
+        except OSError:  # loss-free: no directory means no bundles
             return []
         return [os.path.join(self.directory, n) for n in names]
 
@@ -179,6 +181,7 @@ class FlightRecorder:
         for path in bundles[:max(0, len(bundles) - self.keep)]:
             try:
                 shutil.rmtree(path)
+            # loss-free: a bundle that refuses deletion only costs disk
             except OSError as e:
                 log.warning("flight recorder: rotate %s failed: %s",
                             path, e)

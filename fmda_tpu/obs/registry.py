@@ -380,9 +380,9 @@ class MetricsRegistry:
         for name, fn in collectors:
             try:
                 part = fn()
-            except Exception:  # noqa: BLE001 — one dead component (e.g.
-                # a closed warehouse) must not take the whole scrape
-                # down; /healthz is where its failure gets reported
+            except Exception:  # noqa: BLE001 — loss-free: one dead
+                # component (e.g. a closed warehouse) must not take the
+                # whole scrape down; /healthz reports its failure
                 _log().warning(
                     "metrics collector %r failed; skipped", name,
                     exc_info=True)

@@ -160,8 +160,9 @@ class MetricsServer:
                             "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
-                except Exception as e:  # noqa: BLE001 — a broken scrape
-                    # must never kill the serving thread; the client gets
+                except Exception as e:  # noqa: BLE001 — loss-free: a
+                    # broken scrape answers HTTP 500, never kills the
+                    # serving thread; the client gets
                     # a well-formed JSON error body (the body is built
                     # BEFORE any byte is sent, so a collector blowing up
                     # can never leave a half-written response on the wire)
@@ -170,7 +171,7 @@ class MetricsServer:
                         body = json.dumps(
                             {"error": repr(e), "path": self.path}).encode()
                         self._send(500, body, "application/json")
-                    except Exception:  # noqa: BLE001 — client went away
+                    except Exception:  # noqa: BLE001 — loss-free: the client went away mid-500; nothing to answer
                         pass
 
             def log_message(self, fmt: str, *args) -> None:

@@ -221,8 +221,9 @@ class SLOEngine:
                 if self.on_fire is not None:
                     try:
                         self.on_fire(name, alert)
-                    except Exception:  # noqa: BLE001 — a recorder
-                        # failure must never take alerting down with it
+                    except Exception:  # noqa: BLE001 — loss-free: a
+                        # recorder failure must never take alerting
+                        # down; the alert itself still fires/exports
                         log.exception("slo on_fire hook raised")
             elif was_firing and not firing:
                 log.warning("SLO alert resolved: %s (fast burn %.2fx)",
@@ -233,7 +234,7 @@ class SLOEngine:
                 if self.on_resolve is not None:
                     try:
                         self.on_resolve(name, alert)
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 — loss-free: hook-only failure; the resolve still lands
                         log.exception("slo on_resolve hook raised")
         return self._alerts
 

@@ -523,7 +523,7 @@ def _lane(stage: str, extra: Dict[str, int]) -> int:
     pipeline stage."""
     try:
         return STAGE_LANES.index(stage) + 1
-    except ValueError:
+    except ValueError:  # loss-free: unknown stage gets a fresh lane
         return extra.setdefault(stage, len(STAGE_LANES) + 1 + len(extra))
 
 

@@ -391,7 +391,10 @@ class FleetGateway:
                 prev, self._inflight = self._inflight, None
                 try:
                     self._complete_counted(prev)
-                except Exception:  # noqa: BLE001 — don't mask the unwind
+                except Exception:  # noqa: BLE001 — loss-free: double
+                    # fault while unwinding; _complete_counted already
+                    # counted the flush's ticks lost, and the outer
+                    # handler re-raises the original failure
                     log.exception(
                         "in-flight flush lost while unwinding pump failure")
             raise

@@ -360,6 +360,8 @@ class StreamEngine:
                     features.floor_s, features.join_tolerance_s,
                     features.watermark_s, len(self._stream_topics),
                 )
+            # loss-free: loud fallback, like default_bus for the ring
+            # bus — the python join path is bit-identical, just not C++
             except NativeJoinUnavailable as e:
                 # loud fallback, like default_bus for the ring bus: the
                 # python path is bit-identical, just not C++
@@ -396,6 +398,12 @@ class StreamEngine:
         )
         self._emitted = 0
         self._dropped = 0
+        #: malformed feed messages discarded at parse time — the
+        #: never-abort contract counts every discard (a book tick that
+        #: dies here was published but will never land, and the
+        #: counted-loss lint rule holds parse drops to the same
+        #: discipline as join drops)
+        self._bad_messages = 0
         #: degraded-mode accounting: rows emitted with ghost features,
         #: per side topic, plus the timestamps of those rows (pruned with
         #: the landed-dedupe set) so a chaos harness can exclude them
@@ -460,6 +468,7 @@ class StreamEngine:
             except (KeyError, ValueError, TypeError, AttributeError) as e:
                 # AttributeError: a nested level that should be a dict is a
                 # scalar — malformed producer output, not a crash
+                self._bad_messages += 1
                 log.warning("bad deep message at offset %d: %s", rec.offset, e)
                 continue
             raws.append(raw)
@@ -471,7 +480,8 @@ class StreamEngine:
         except (KeyError, ValueError, TypeError, AttributeError) as e:
             # one pathological message that survived extraction must not
             # abort the whole poll's batch — fall back to per-message
-            # parsing and drop only the offender(s)
+            # parsing and drop only the offender(s); the per-message
+            # retry below counts each actual discard (loss-free here)
             log.warning(
                 "batched deep parse failed (%s); retrying per-message", e)
             deep_events = []
@@ -479,6 +489,7 @@ class StreamEngine:
                 try:
                     parsed = _parse_deep_batch([raw])
                 except (KeyError, ValueError, TypeError, AttributeError) as e2:
+                    self._bad_messages += 1
                     log.warning("bad deep message %s dropped: %s", raw[0], e2)
                     continue
                 for event in parsed:
@@ -498,6 +509,7 @@ class StreamEngine:
                 try:
                     event = parsers[topic](rec.value)
                 except (KeyError, ValueError, TypeError, AttributeError) as e:
+                    self._bad_messages += 1
                     log.warning(
                         "bad %s message at offset %d: %s", topic, rec.offset, e
                     )
@@ -846,6 +858,7 @@ class StreamEngine:
         return {
             "emitted": self._emitted,
             "dropped": self._dropped,
+            "bad_messages": self._bad_messages,
             "pending": len(self._pending_deep),
             "consumer_lag": lag,
             "watermark_age_s": ages,
@@ -892,6 +905,7 @@ class StreamEngine:
             "offsets": {t: c.offset for t, c in self._consumers.items()},
             "emitted": self._emitted,
             "dropped": self._dropped,
+            "bad_messages": self._bad_messages,
             "max_deep_ts": self._max_deep_ts,
             "first_deep_ts": self._first_deep_ts,
             "degraded_rows": self._degraded_rows,
@@ -955,7 +969,7 @@ class StreamEngine:
             try:
                 os.replace(self.checkpoint_path,
                            f"{self.checkpoint_path}.corrupt")
-            except OSError:
+            except OSError:  # loss-free: the .corrupt copy is forensics only; the counted fresh start already happened
                 pass  # already gone / unwritable dir: nothing to keep
             return
 
@@ -964,6 +978,7 @@ class StreamEngine:
                 self._consumers[topic].seek(offset)
         self._emitted = state.get("emitted", 0)
         self._dropped = state.get("dropped", 0)
+        self._bad_messages = state.get("bad_messages", 0)
         for topic, n in state.get("degraded_rows", {}).items():
             if topic in self._degraded_rows:
                 self._degraded_rows[topic] = int(n)

@@ -304,8 +304,10 @@ class BufferedWarehouse:
                 log.error(
                     "journaled row %s is unlandable (%r): dropped "
                     "(poison_rows)", ts, e)
-            except Exception as e:  # noqa: BLE001 — still down: keep
-                # this row and everything after it
+            except Exception as e:  # noqa: BLE001 — loss-free: still
+                # down — this row and everything after it STAY in the
+                # journal (pending, the gate's summed term); retried
+                # next drain
                 failure = e
                 break
             done += 1

@@ -351,7 +351,7 @@ class MySQLWarehouse:
             self._cursor.execute("SELECT 1;")
             self._cursor.fetchone()
             return True
-        except Exception:  # noqa: BLE001 — any failure IS the signal
+        except Exception:  # noqa: BLE001 — loss-free: a health probe; any failure IS the "unhealthy" signal
             return False
 
     def fetch(self, ids: Sequence[int]):

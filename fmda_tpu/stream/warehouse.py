@@ -102,7 +102,7 @@ class Warehouse:
                 self._conn.execute("BEGIN IMMEDIATE")
                 self._conn.execute("ROLLBACK")
             return True
-        except Exception:  # noqa: BLE001 — any failure IS the signal
+        except Exception:  # noqa: BLE001 — loss-free: a health probe; any failure IS the "unhealthy" signal
             return False
 
     # -- DDL (config -> schema codegen) -------------------------------------

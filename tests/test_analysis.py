@@ -17,14 +17,18 @@ from fmda_tpu.analysis import (
     BusTopicRule,
     ChaosGuardRule,
     CompatRequiredRule,
+    CountedLossRule,
     Finding,
     JaxApiDriftRule,
     JitPurityRule,
     LintContext,
+    LintResult,
     LockDisciplineRule,
     LoggingHygieneRule,
     ParsedModule,
     SpanClockRule,
+    ThreadLifecycleRule,
+    WireProtocolRule,
     apply_baseline,
     collect_modules,
     default_rules,
@@ -32,6 +36,7 @@ from fmda_tpu.analysis import (
     run_lint,
     run_rules,
     save_baseline,
+    to_sarif,
 )
 
 PACKAGE_DIR = pathlib.Path(fmda_tpu.__file__).parent
@@ -832,3 +837,567 @@ def test_metric_names_sample_vs_call_label_mismatch_flags():
     findings, _, _ = run_on(MetricNamesRule(), {"mod.py": src})
     assert len(findings) == 1
     assert "served_total" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# counted-loss: exception accounting + the conservation vocabulary (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+SWALLOW_TP = """\
+class Pump:
+    def pump(self):
+        try:
+            self.bus.publish("t", {})
+        except ConnectionError:
+            pass
+"""
+
+
+def test_counted_loss_flags_silent_swallow():
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/x.py": SWALLOW_TP})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "counted-loss"
+    assert "Pump.pump" in f.message and "ConnectionError" in f.message
+
+
+def test_counted_loss_out_of_scope_module_skipped():
+    # the hot packages only: the same swallow in e.g. data/ is not this
+    # rule's business
+    findings, _, _ = run_on(CountedLossRule(), {"data/x.py": SWALLOW_TP})
+    assert not findings
+
+
+def test_counted_loss_clean_shapes():
+    # the four sanctioned outs: re-raise, direct count, `+=` tally,
+    # and the dict-tally assign
+    src = (
+        "class Pump:\n"
+        "    def a(self):\n"
+        "        try:\n"
+        "            work()\n"
+        "        except ValueError as e:\n"
+        "            raise RuntimeError('no') from e\n"
+        "    def b(self):\n"
+        "        try:\n"
+        "            work()\n"
+        "        except ConnectionError:\n"
+        "            self.metrics.count('bus_errors')\n"
+        "    def c(self):\n"
+        "        try:\n"
+        "            work()\n"
+        "        except OSError:\n"
+        "            self.errors += 1\n"
+        "    def d(self, skips, topic):\n"
+        "        try:\n"
+        "            work()\n"
+        "        except OSError:\n"
+        "            skips[topic] = skips.get(topic, 0) + 1\n"
+    )
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/x.py": src})
+    assert not findings
+
+
+def test_counted_loss_one_level_callee_counts():
+    # the interprocedural TN: the handler delegates its accounting to a
+    # same-module callee whose body counts (fleet/worker.py's
+    # _publish_control_counted is the real-repo instance)
+    src = (
+        "class W:\n"
+        "    def _record(self):\n"
+        "        self.metrics.count('control_errors')\n"
+        "    def beat(self):\n"
+        "        try:\n"
+        "            self.bus.publish('t', {})\n"
+        "        except ConnectionError:\n"
+        "            self._record()\n"
+    )
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/w.py": src})
+    assert not findings
+    # a callee that does NOT count leaves the handler unaccounted
+    bad = src.replace("self.metrics.count('control_errors')", "pass")
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/w.py": bad})
+    assert len(findings) == 1
+
+
+def test_counted_loss_loss_free_hatch():
+    hatched = SWALLOW_TP.replace(
+        "        except ConnectionError:",
+        "        # loss-free: teardown path, nothing in flight\n"
+        "        except ConnectionError:")
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/x.py": hatched})
+    assert not findings
+    # the marker may sit anywhere in the contiguous comment block above
+    wrapped = SWALLOW_TP.replace(
+        "        except ConnectionError:",
+        "        # loss-free: teardown path — nothing was in flight\n"
+        "        # on this connection, so nothing can be lost\n"
+        "        except ConnectionError:")
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/x.py": wrapped})
+    assert not findings
+    # reasonless = inert, same contract as # lock-free:
+    bare = SWALLOW_TP.replace(
+        "        except ConnectionError:",
+        "        # loss-free:\n"
+        "        except ConnectionError:")
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/x.py": bare})
+    assert len(findings) == 1
+
+
+def test_counted_loss_vocabulary_dead_term():
+    # a gate summing a counter nobody increments is a silently weakened
+    # identity — the cross-check reads the tuple the soak declares
+    soak = 'LOSS_COUNTERS = ("results_missing", "ghost_losses")\n'
+    router = (
+        "class R:\n"
+        "    def age(self):\n"
+        "        self.metrics.count('results_missing')\n"
+    )
+    findings, _, _ = run_on(
+        CountedLossRule(),
+        {"chaos/soak.py": soak, "fleet/router.py": router})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.path == "chaos/soak.py" and f.severity == "error"
+    assert "ghost_losses" in f.message and "dead term" in f.message
+
+
+def test_counted_loss_drop_site_outside_the_identity():
+    soak = 'LOSS_COUNTERS = ("results_missing",)\n'
+    router = (
+        "class R:\n"
+        "    def age(self):\n"
+        "        self.metrics.count('results_missing')\n"
+        "    def shed(self, n):\n"
+        "        self.metrics.count('ticks_dropped', n)\n"
+    )
+    findings, _, _ = run_on(
+        CountedLossRule(),
+        {"chaos/soak.py": soak, "fleet/router.py": router})
+    assert len(findings) == 1
+    assert "ticks_dropped" in findings[0].message
+    assert "never sums" in findings[0].message
+    # the standard in-place hatch sanctions a deliberate non-gate series
+    hatched = router.replace(
+        "        self.metrics.count('ticks_dropped', n)",
+        "        # lint: ignore[counted-loss] diagnostic-only series\n"
+        "        self.metrics.count('ticks_dropped', n)")
+    findings, suppressed, _ = run_on(
+        CountedLossRule(),
+        {"chaos/soak.py": soak, "fleet/router.py": hatched})
+    assert not findings and suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# wire-protocol: op/kind cross-check + the v2 dialect (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_consumed_only_op_flags():
+    # a dispatcher branch for an op no client ever sends: dead protocol
+    # surface (or the producer's literal is typo'd)
+    server = (
+        "class S:\n"
+        "    def dispatch(self, req):\n"
+        "        op = req.get('op')\n"
+        "        if op == 'publish':\n"
+        "            return 1\n"
+        "        if op == 'fetch_all':\n"
+        "            return 2\n"
+        "    def send(self):\n"
+        "        self._request({'op': 'publish', 'topic': 't'})\n"
+    )
+    findings, _, _ = run_on(WireProtocolRule(), {"fleet/wire.py": server})
+    assert len(findings) == 1
+    assert "'fetch_all'" in findings[0].message
+    assert "never produced" in findings[0].message
+
+
+def test_protocol_produced_only_kind_flags_and_symmetric_clean():
+    router = (
+        "class R:\n"
+        "    def a(self):\n"
+        "        self._enqueue({'kind': 'tick', 'seq': 1})\n"
+        "    def b(self):\n"
+        "        self._enqueue({'kind': 'mystery'})\n"
+    )
+    worker = (
+        "class W:\n"
+        "    def apply(self, msg):\n"
+        "        kind = msg.get('kind')\n"
+        "        if kind == 'tick':\n"
+        "            pass\n"
+    )
+    findings, _, _ = run_on(
+        WireProtocolRule(),
+        {"fleet/router.py": router, "fleet/worker.py": worker})
+    assert len(findings) == 1
+    assert "'mystery'" in findings[0].message
+    assert "no consumer branch" in findings[0].message
+
+
+def test_protocol_resolves_constants_and_param_flow():
+    # the heartbeat shape: kinds produced by passing module constants
+    # through a helper that stamps {"kind": kind} — the program index's
+    # one-level parameter flow must resolve them, and the consumer side
+    # compares against the imported constant names
+    membership = (
+        "HELLO = 'hello'\n"
+        "GOODBYE = 'goodbye'\n"
+        "class H:\n"
+        "    def _publish(self, kind, stats):\n"
+        "        self.bus.publish('t', {'kind': kind, 'stats': stats})\n"
+        "    def hello(self):\n"
+        "        self._publish(HELLO, None)\n"
+        "    def goodbye(self):\n"
+        "        self._publish(GOODBYE, None)\n"
+    )
+    router = (
+        "class R:\n"
+        "    def handle(self, msg):\n"
+        "        kind = msg.get('kind')\n"
+        "        if kind in (HELLO, GOODBYE):\n"
+        "            return True\n"
+    )
+    findings, _, ctx = run_on(
+        WireProtocolRule(),
+        {"fleet/membership.py": membership, "fleet/router.py": router})
+    assert not findings
+    rep = ctx.reports["wire_protocol"]
+    assert set(rep["kinds"]["produced"]) == {"hello", "goodbye"}
+    assert set(rep["kinds"]["consumed"]) == {"hello", "goodbye"}
+
+
+def test_protocol_local_constant_production():
+    # router.stop_workers' shape: {"kind": kind} where kind is a local
+    # `"drain_all" if graceful else "stop"`
+    router = (
+        "class R:\n"
+        "    def stop_workers(self, graceful):\n"
+        "        kind = 'drain_all' if graceful else 'stop'\n"
+        "        self._enqueue({'kind': kind})\n"
+    )
+    worker = (
+        "class W:\n"
+        "    def apply(self, msg):\n"
+        "        kind = msg.get('kind')\n"
+        "        if kind in ('drain_all', 'stop'):\n"
+        "            self.shutdown()\n"
+    )
+    findings, _, _ = run_on(
+        WireProtocolRule(),
+        {"fleet/router.py": router, "fleet/worker.py": worker})
+    assert not findings
+
+
+def test_protocol_v2_wire_default_must_stay_legacy():
+    worker = (
+        "class W:\n"
+        "    def apply(self, msg):\n"
+        "        return int(msg.get('wire', 2))\n"
+    )
+    findings, _, _ = run_on(WireProtocolRule(), {"fleet/worker.py": worker})
+    assert len(findings) == 1
+    assert "pre-v2" in findings[0].message
+    ok = worker.replace("msg.get('wire', 2)", "msg.get('wire', 1)")
+    findings, _, _ = run_on(WireProtocolRule(), {"fleet/worker.py": ok})
+    assert not findings
+
+
+def test_protocol_tick_blocks_need_a_lowering():
+    bare = (
+        "from fmda_tpu.stream import codec\n"
+        "class R:\n"
+        "    def send(self, msgs):\n"
+        "        return codec.coalesce_ticks(msgs)\n"
+    )
+    findings, _, _ = run_on(WireProtocolRule(), {"fleet/router.py": bare})
+    assert len(findings) == 1
+    assert "legacy lowering" in findings[0].message
+    lowered = bare.replace(
+        "from fmda_tpu.stream import codec\n",
+        "from fmda_tpu.stream import codec\n"
+        "from fmda_tpu.fleet.state import to_legacy_msgs\n").replace(
+        "        return codec.coalesce_ticks(msgs)\n",
+        "        if self.legacy:\n"
+        "            return to_legacy_msgs(msgs)\n"
+        "        return codec.coalesce_ticks(msgs)\n")
+    findings, _, _ = run_on(WireProtocolRule(), {"fleet/router.py": lowered})
+    assert not findings
+
+
+def test_protocol_pack_results_must_be_guarded():
+    bare = (
+        "class G:\n"
+        "    def publish(self, results):\n"
+        "        return pack_results(results, self.labels)\n"
+    )
+    findings, _, _ = run_on(WireProtocolRule(), {"runtime/gateway.py": bare})
+    assert len(findings) == 1
+    assert "per-tick result dialect" in findings[0].message
+    guarded = bare.replace(
+        "        return pack_results(results, self.labels)\n",
+        "        if self.result_blocks:\n"
+        "            return pack_results(results, self.labels)\n"
+        "        return results\n")
+    findings, _, _ = run_on(
+        WireProtocolRule(), {"runtime/gateway.py": guarded})
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# thread-lifecycle (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_thread_rule_flags_unjoined_non_daemon():
+    src = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self.run)\n"
+        "        self._t.start()\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"obs/x.py": src})
+    assert len(findings) == 1
+    assert "self._t" in findings[0].message
+    assert "join" in findings[0].message
+
+
+def test_thread_rule_daemon_and_joined_on_close_are_clean():
+    daemon = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self.run, daemon=True)\n"
+        "        self._t.start()\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"obs/x.py": daemon})
+    assert not findings
+    # the joined-on-close TN: a non-daemon thread whose owner settles it
+    joined = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self.run)\n"
+        "        self._t.start()\n"
+        "    def stop(self):\n"
+        "        self._t.join(timeout=5.0)\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"obs/x.py": joined})
+    assert not findings
+
+
+def test_thread_rule_timer_cancel_and_local_join():
+    timer = (
+        "import threading\n"
+        "class S:\n"
+        "    def arm(self):\n"
+        "        self._timer = threading.Timer(5.0, self.fire)\n"
+        "        self._timer.start()\n"
+        "    def close(self):\n"
+        "        self._timer.cancel()\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"obs/x.py": timer})
+    assert not findings
+    local = (
+        "from threading import Thread\n"
+        "def run_all(jobs):\n"
+        "    t = Thread(target=jobs.pop)\n"
+        "    t.start()\n"
+        "    t.join()\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"obs/y.py": local})
+    assert not findings
+
+
+def test_thread_rule_fire_and_forget_flags():
+    src = (
+        "import threading\n"
+        "def kick(fn):\n"
+        "    threading.Thread(target=fn).start()\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"fleet/x.py": src})
+    assert len(findings) == 1
+    assert "fire-and-forget" in findings[0].message
+    # alias-aware both ways, like the other import-tracking rules
+    aliased = (
+        "from threading import Thread as T\n"
+        "def kick(fn):\n"
+        "    T(target=fn).start()\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"fleet/x.py": aliased})
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# SARIF export (ISSUE 15 satellite) — schema is load-bearing for CI
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_document_schema():
+    result = LintResult(
+        new=[Finding("counted-loss", "fleet/x.py", 3, "swallowed", "warning")],
+        baselined=[Finding("lock-discipline", "obs/y.py", 7, "old debt",
+                           "warning")],
+    )
+    rules = default_rules(drift=False)
+    doc = to_sarif(result, rules)
+    assert set(doc) == {"$schema", "version", "runs"}
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "fmda-tpu-lint"
+    ids = {r["id"] for r in driver["rules"]}
+    assert {"counted-loss", "wire-protocol", "thread-lifecycle"} <= ids
+    assert all(set(r) == {"id", "shortDescription", "defaultConfiguration"}
+               for r in driver["rules"])
+    new, old = run["results"]
+    assert set(new) == {"ruleId", "level", "message", "locations"}
+    assert new["ruleId"] == "counted-loss" and new["level"] == "warning"
+    loc = new["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"] == {"uri": "fmda_tpu/fleet/x.py",
+                                       "uriBaseId": "SRCROOT"}
+    assert loc["region"] == {"startLine": 3}
+    # grandfathered findings export as externally suppressed results —
+    # visible to the scanner, non-blocking
+    assert old["suppressions"][0]["kind"] == "external"
+
+
+def test_lint_sarif_cli_writes_document(tmp_path):
+    from fmda_tpu import cli
+
+    out = tmp_path / "lint.sarif"
+    rc = cli.main(["lint", "--no-drift", "--sarif", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"] == []  # the repo is clean
+    rule_ids = {r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+    assert "wire-protocol" in rule_ids and "jax-api-drift" not in rule_ids
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate, extended to the never-abort rules (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+NEVER_ABORT_RULES = ("counted-loss", "wire-protocol", "thread-lifecycle")
+
+
+def test_never_abort_rules_hold_zero_findings(repo_lint_result):
+    """Stronger than "zero NEW": the three ISSUE-15 rules hold the repo
+    at zero findings outright — no baseline entries, nothing
+    grandfathered.  Deliberate exceptions are annotated in place, where
+    the next reader sees the reason."""
+    result = repo_lint_result
+    hits = [f for f in result.new + result.baselined
+            if f.rule in NEVER_ABORT_RULES]
+    assert hits == [], "\n".join(f.format() for f in hits)
+    assert [e for e in load_baseline()
+            if e["rule"] in NEVER_ABORT_RULES] == []
+
+
+def test_conservation_vocabulary_cross_check_green(repo_lint_result):
+    """The gates' loss sets resolve against counters the code really
+    increments, and the wire harvest sees the live protocol — pins the
+    cross-checks to the actual repo, not just fixtures."""
+    rep = repo_lint_result.reports["counted_loss"]
+    declared = {n for names in rep["vocabulary"].values() for n in names}
+    assert {"results_missing", "migration_buffer_shed",
+            "inflight_dropped_on_close"} <= declared
+    assert "stale_results_dropped" in declared  # the gap this PR closed
+    assert declared <= set(rep["registered_counters"])
+    # the pipeline gate's vocabulary is declared, not an inline dict
+    assert set(rep["pipeline_loss_fields"]) == {
+        "dropped_unjoinable", "pending_joins",
+        "journal_pending", "journal_shed"}
+    wire = repo_lint_result.reports["wire_protocol"]
+    assert {"tick", "tick_block", "open", "drain_session",
+            "session_state", "result_block"} <= set(
+        wire["kinds"]["produced"])
+    # the interprocedural resolution: hello/heartbeat/goodbye are
+    # produced only via Heartbeater._publish's kind parameter
+    assert {"hello", "heartbeat", "goodbye"} <= set(
+        wire["kinds"]["produced"])
+    assert {"publish", "read", "batch", "hello"} <= set(
+        wire["ops"]["produced"])
+
+
+def test_counted_loss_marker_does_not_bleed_to_next_handler():
+    # a previous handler's same-line hatch (a trailing comment on a
+    # CODE line) must not exempt the handler below it
+    src = (
+        "class P:\n"
+        "    def go(self):\n"
+        "        try:\n"
+        "            work()\n"
+        "        except ValueError:\n"
+        "            pass  # loss-free: benign probe\n"
+        "        except ConnectionError:\n"
+        "            pass\n"
+    )
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/x.py": src})
+    assert len(findings) == 2  # the marker sanctions NEITHER handler:
+    # it trails a code line inside handler A's body (put it on the
+    # `except` line or above), and it must not bleed into handler B
+    # and a stale marker trailing the last try-body statement doesn't
+    # sanction the handler either
+    trailing = (
+        "class P:\n"
+        "    def go(self):\n"
+        "        try:\n"
+        "            work()  # loss-free: stale note on a code line\n"
+        "        except ConnectionError:\n"
+        "            pass\n"
+    )
+    findings, _, _ = run_on(CountedLossRule(), {"fleet/x.py": trailing})
+    assert len(findings) == 1
+
+
+def test_protocol_param_flow_resolves_keyword_calls():
+    # a keyword-argument call into a kind-stamping helper must still
+    # register the production (a refactor to kwargs is not a protocol
+    # change)
+    membership = (
+        "HELLO = 'hello'\n"
+        "class H:\n"
+        "    def _publish(self, kind, stats):\n"
+        "        self.bus.publish('t', {'kind': kind, 'stats': stats})\n"
+        "    def hello(self):\n"
+        "        self._publish(kind=HELLO, stats=None)\n"
+    )
+    router = (
+        "class R:\n"
+        "    def handle(self, msg):\n"
+        "        kind = msg.get('kind')\n"
+        "        if kind == 'hello':\n"
+        "            return True\n"
+    )
+    findings, _, _ = run_on(
+        WireProtocolRule(),
+        {"fleet/membership.py": membership, "fleet/router.py": router})
+    assert not findings
+
+
+def test_thread_rule_annotated_assignment_tracked():
+    # an AnnAssign-bound thread is owned like a plain assignment: the
+    # joined-on-close shape stays clean, the unjoined one is flagged as
+    # bound (never as fire-and-forget)
+    joined = (
+        "import threading\n"
+        "class S:\n"
+        "    def start(self):\n"
+        "        self._t: threading.Thread = "
+        "threading.Thread(target=self.run)\n"
+        "        self._t.start()\n"
+        "    def stop(self):\n"
+        "        self._t.join(timeout=5.0)\n"
+    )
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"obs/x.py": joined})
+    assert not findings
+    unjoined = joined.replace(
+        "    def stop(self):\n        self._t.join(timeout=5.0)\n", "")
+    findings, _, _ = run_on(ThreadLifecycleRule(), {"obs/x.py": unjoined})
+    assert len(findings) == 1
+    assert "self._t" in findings[0].message
+    assert "fire-and-forget" not in findings[0].message

@@ -79,6 +79,18 @@ class TestPhaseRegistry:
         }
         assert expected == set(bench._PHASES)
 
+    def test_analysis_lint_pins_the_never_abort_rules(self):
+        """ISSUE 15 phase-change pin: the analysis_lint phase holds the
+        three never-abort analyzers at zero findings outright.  A rule
+        added to (or renamed in) the catalog must update this pin — and
+        the phase's zero-findings assertion — in the same PR."""
+        from fmda_tpu.analysis import rule_catalog
+
+        assert set(bench.NEVER_ABORT_RULES) == {
+            "counted-loss", "wire-protocol", "thread-lifecycle"}
+        assert set(bench.NEVER_ABORT_RULES) <= set(
+            rule_catalog(drift=False))
+
     def test_kernel_sweep_and_fleet_ab_cover_the_ssm_family(self):
         """ISSUE 14 phase-change pin: the kernel sweep races the SSM
         serve-step kernel alongside the GRU scan kernel, and the fleet
