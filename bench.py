@@ -1643,6 +1643,112 @@ def phase_runtime_multihost() -> dict:
     return result
 
 
+def phase_control_capacity_model() -> dict:
+    """Capacity model (ISSUE 16): the control plane's empirical sizing
+    sweep (fmda_tpu.control.capacity, docs/control.md) on a real
+    gateway — sessions × arrival-rate grid, each cell a fresh pool +
+    gateway serving a seeded load, sustainable when p99 meets the SLO
+    with zero sheds and served == submitted.  The phase result IS the
+    pinned-schema artifact (``fmda.control.capacity/1``) plus the gate
+    verdicts, so a bench run leaves the sizing table downstream tooling
+    parses.
+
+    Always gated hard: schema intact, every cell conserving ticks
+    (served + shed == submitted — a leak here is a gateway bug, not a
+    perf matter), and per-cell compile_count == len(buckets).  The
+    fixed-vs-adaptive linger A/B (the batching controller steering the
+    heaviest cell toward half the fixed-linger p99) hard-gates
+    ``improved`` only on a quiet host with >= 6 cores — same quietness
+    rule as the multihost scaling gate; elsewhere it reports
+    ``gate_inert`` (timer-resolution noise on a starved host can hide a
+    sub-millisecond win).  ``FMDA_FLEET_SLO_SOFT=1`` downgrades to
+    report-only either way.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.config import ModelConfig
+    from fmda_tpu.control.capacity import run_capacity_model
+    from fmda_tpu.models import build_model
+    from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+
+    buckets = (8, 32)
+    cfg = ModelConfig(hidden_size=HIDDEN, n_features=FEATURES,
+                      output_size=CLASSES, dropout=0.0,
+                      bidirectional=False, use_pallas=False)
+    model = build_model(cfg)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, WINDOW, FEATURES)))["params"]
+    pools: list = []
+
+    def gateway_factory(n_sessions: int) -> FleetGateway:
+        pool = SessionPool(cfg, params, capacity=n_sessions,
+                          window=WINDOW)
+        # steady-state cells: compile every bucket up front on
+        # padding-only flushes so no cell's p99 pays compile time
+        for b in buckets:
+            pool.step(np.full(b, pool.padding_slot, np.int32),
+                      np.zeros((b, FEATURES), np.float32))
+        pools.append(pool)
+        return FleetGateway(
+            pool, batcher_config=BatcherConfig(
+                bucket_sizes=buckets, max_linger_s=0.002))
+
+    slo_ms = float(os.environ.get("FMDA_FLEET_SLO_P99_MS", "50"))
+    artifact = run_capacity_model(
+        gateway_factory, slo_p99_ms=slo_ms,
+        session_grid=(8, 16, 32), duty_grid=(0.25, 0.5, 1.0),
+        rounds=60, seed=0)
+    soft = os.environ.get("FMDA_FLEET_SLO_SOFT", "") == "1"
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = None
+    cores = os.cpu_count() or 1
+    quiet = load1 is not None and load1 < 0.5 * cores
+    result = dict(artifact)
+    result.update({
+        "bucket_sizes": list(buckets),
+        "cpu_count": cores,
+        "quiet_host": quiet,
+        "compile_counts": [p.compile_count for p in pools],
+    })
+    leaks = [
+        {"sessions": c["sessions"], "duty": c["duty"],
+         "submitted": c["submitted"],
+         "served": c["served"], "shed": c["shed"]}
+        for c in artifact["grid"]
+        if c["served"] + c["shed"] != c["submitted"]
+    ]
+    bad_compile = [p.compile_count for p in pools
+                   if p.compile_count != len(buckets)]
+    ab = artifact.get("controller_ab") or {}
+    if leaks:
+        result["error"] = (
+            f"ticks leaked in {len(leaks)} cell(s) (served + shed != "
+            f"submitted: {leaks[:3]}) — the gateway's conservation "
+            "contract broke")
+    elif bad_compile:
+        result["error"] = (
+            f"compile_count != {len(buckets)} buckets ({bad_compile}): "
+            "something recompiled on the capacity sweep's tick path")
+    elif ab and ab.get("fixed_p99_ms") and not ab.get("improved") \
+            and quiet and cores >= 6 and not soft:
+        result["error"] = (
+            f"batching controller A/B did not improve p99 "
+            f"(fixed {ab.get('fixed_p99_ms')}ms vs adaptive "
+            f"{ab.get('adaptive_p99_ms')}ms after {ab.get('decisions')} "
+            "decisions) on a quiet multi-core host "
+            "(FMDA_FLEET_SLO_SOFT=1 to report-only)")
+    elif ab and ab.get("fixed_p99_ms") and not ab.get("improved"):
+        result["gate_inert"] = (
+            f"controller A/B not improved (fixed {ab.get('fixed_p99_ms')}"
+            f"ms vs adaptive {ab.get('adaptive_p99_ms')}ms) but the gate "
+            f"needs a quiet host with >= 6 cores (have {cores}, "
+            f"quiet={quiet})")
+    return result
+
+
 def phase_runtime_chaos_soak() -> dict:
     """Chaos soak (ISSUE 7): the full local multi-host topology under a
     seeded fault plan — a worker SIGKILLed and revived, a router
@@ -2159,6 +2265,7 @@ _PHASES = {
     "runtime_fleet_smoke": phase_runtime_fleet,
     "predictor_fleet_smoke": phase_predictor_fleet,
     "runtime_multihost_smoke": phase_runtime_multihost,
+    "control_capacity_model": phase_control_capacity_model,
     "runtime_chaos_soak": phase_runtime_chaos_soak,
     "pipeline_chaos_soak": phase_pipeline_chaos_soak,
     "obs_overhead": phase_obs_overhead,

@@ -77,6 +77,11 @@ class _Norm(NamedTuple):
     x_max: np.ndarray
 
 
+#: public alias — the elastic soak (fmda_tpu.control.elastic) opens its
+#: sessions with the same jax-free stand-in
+Norm = _Norm
+
+
 #: Loss counters that REMOVE a tick from the router's in-flight table —
 #: the accounting identity is submitted == served + the sum of these.
 LOSS_COUNTERS = (

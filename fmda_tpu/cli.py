@@ -481,11 +481,16 @@ def _cmd_fleet_worker(args) -> int:
     reconnect = (None if args.shared_bus
                  else (lambda: SocketBus.connect(
                      args.connect, wire_format=wire_format)))
+    qos = None
+    if cfg.control.enabled and cfg.control.tenant_classes:
+        from fmda_tpu.control.qos import QosPolicy
+
+        qos = QosPolicy.from_config(cfg.control)
     worker = FleetWorker(
         args.worker_id, bus, model_cfg, params,
         config=cfg.fleet, runtime=cfg.runtime, capacity=args.sessions,
         data_bus=data_bus, data_address=data_address,
-        reconnect_fn=reconnect)
+        reconnect_fn=reconnect, qos=qos)
     # per-process observability: every series this worker exports
     # carries a `process` label, so a fleet-wide scrape never collides
     obs = Observability(cfg.observability, process=args.worker_id)
@@ -572,6 +577,51 @@ def _fleet_telemetry(args, cfg):
     return FleetTelemetry(slo_cfg)
 
 
+def _control_plane(args, cfg, telemetry, *, router=None, actuator=None,
+                   initial_linger_ms=None, bucket_sizes=None):
+    """The adaptive control plane for --role router/local (fmda_tpu
+    .control; docs/control.md): on whenever fleet telemetry is — the
+    loops read its signals — unless the ``[control]`` section or
+    ``--no-controller`` opts out.  Attached to the telemetry so its
+    decision ring serves on ``/control``."""
+    if telemetry is None or not cfg.control.enabled:
+        return None
+    if getattr(args, "no_controller", False):
+        return None
+    from fmda_tpu.control import ControlPlane
+
+    plane = ControlPlane(
+        cfg.control, telemetry=telemetry, router=router,
+        actuator=actuator, slo_cfg=cfg.slo,
+        initial_linger_ms=(initial_linger_ms if initial_linger_ms
+                           is not None else cfg.runtime.max_linger_ms),
+        bucket_sizes=tuple(bucket_sizes if bucket_sizes is not None
+                           else cfg.runtime.bucket_sizes))
+    telemetry.attach_controller(plane)
+    return plane
+
+
+def _tenant_mix(args):
+    """Parse ``--tenant-mix gold:1,standard:4`` into the loadgen's
+    parallel (classes, weights) tuples; ((), ()) when unset."""
+    spec = getattr(args, "tenant_mix", None)
+    if not spec:
+        return (), ()
+    classes, weights = [], []
+    for part in spec.split(","):
+        name, _, w = part.partition(":")
+        if not name.strip():
+            raise SystemExit(f"bad --tenant-mix entry: {part!r}")
+        classes.append(name.strip())
+        try:
+            weights.append(float(w) if w else 1.0)
+        except ValueError:
+            raise SystemExit(
+                f"bad --tenant-mix weight in {part!r} "
+                "(want CLASS or CLASS:WEIGHT)") from None
+    return tuple(classes), tuple(weights)
+
+
 def _cmd_fleet_router(args) -> int:
     """serve-fleet --role router: the routing/membership/migration
     control loop on a bus-only host (no jax on this code path).  With
@@ -620,6 +670,7 @@ def _cmd_fleet_router(args) -> int:
               file=sys.stderr)
     router = FleetRouter(bus, fleet_cfg, n_features=cfg.features.n_features)
     telemetry = _fleet_telemetry(args, cfg)
+    plane = _control_plane(args, cfg, telemetry, router=router)
     tele_server = None
     if telemetry is not None and args.metrics_port is not None:
         # the router's OWN scrape surface: fleet-level series
@@ -637,6 +688,8 @@ def _cmd_fleet_router(args) -> int:
                 # cadence-gated fold (one clock read when not due) —
                 # aggregation stays off the routing hot path
                 telemetry.maybe_collect(router)
+            if plane is not None:
+                plane.maybe_tick()
             time.sleep(0.005)
     except KeyboardInterrupt:
         pass
@@ -663,6 +716,8 @@ def _cmd_fleet_router(args) -> int:
     out["n_features"] = router.n_features
     if telemetry is not None:
         out["alerts"] = telemetry.alerts()["firing"]
+    if plane is not None:
+        out["control"] = plane.status()
     _maybe_write_trace(args, out)
     print(json.dumps(out, indent=2, default=str))
     return 0
@@ -771,13 +826,28 @@ def _cmd_fleet_local(args) -> int:
         trace_dir=args.trace_dir,
     )
     telemetry = _fleet_telemetry(args, cfg)
+    plane = None
+    if telemetry is not None:
+        from fmda_tpu.control import LocalFleetActuator
+
+        plane = _control_plane(
+            args, cfg, telemetry, router=topo.router,
+            actuator=LocalFleetActuator(topo),
+            initial_linger_ms=args.max_linger_ms,
+            bucket_sizes=bucket_sizes)
     tele_server = None
     if telemetry is not None and args.metrics_port is not None:
         tele_server = telemetry.start_server(port=args.metrics_port)
         print(f"fleet telemetry: {tele_server.url}/metrics "
               f"(query, alerts, healthz)", file=sys.stderr)
-    on_round = (None if telemetry is None
-                else (lambda r: telemetry.maybe_collect(topo.router)))
+
+    def on_round(r):
+        if telemetry is not None:
+            telemetry.maybe_collect(topo.router)
+        if plane is not None:
+            plane.maybe_tick()
+
+    tenant_classes, tenant_weights = _tenant_mix(args)
     try:
         out = run_fleet_load(topo.router, FleetLoadConfig(
             n_sessions=args.sessions, n_ticks=args.ticks,
@@ -787,8 +857,11 @@ def _cmd_fleet_local(args) -> int:
             burst_every=args.burst_every,
             burst_rounds=args.burst_rounds,
             slow_fraction=args.slow_fraction,
-            slow_duty=args.slow_duty),
-            on_round=on_round)
+            slow_duty=args.slow_duty,
+            tenant_classes=tenant_classes,
+            tenant_weights=tenant_weights),
+            on_round=(on_round if telemetry is not None
+                      or plane is not None else None))
         if telemetry is not None:
             telemetry.collect(topo.router)  # final fold before teardown
     finally:
@@ -806,6 +879,8 @@ def _cmd_fleet_local(args) -> int:
         out["alerts"] = telemetry.alerts()["firing"]
         out["fleet"] = {
             g["name"]: g["value"] for g in telemetry.fleet_gauges()}
+    if plane is not None:
+        out["control"] = plane.status()
     if args.trace_dir:
         from fmda_tpu.obs.trace import default_tracer
 
@@ -1042,9 +1117,10 @@ def cmd_serve_fleet(args) -> int:
 
 
 def _print_status(snapshot: dict, health: dict,
-                  alerts: dict = None) -> None:
+                  alerts: dict = None, control: dict = None) -> None:
     """Human-readable registry snapshot + health verdict (+ the SLO
-    alert table when the endpoint serves ``/alerts``)."""
+    alert table when the endpoint serves ``/alerts``, + the control
+    plane's loop state when it serves ``/control``)."""
 
     def key(s):
         labels = ",".join(f"{k}={v}" for k, v in
@@ -1064,6 +1140,8 @@ def _print_status(snapshot: dict, health: dict,
                   f"fast {a.get('burn_fast', 0):>8.2f}x  "
                   f"slow {a.get('burn_slow', 0):>8.2f}x  "
                   f"{a.get('detail', '')}")
+    if control and control.get("enabled"):
+        _print_control(control)
     for kind in ("counters", "gauges"):
         samples = sorted(snapshot.get(kind, []), key=key)
         if samples:
@@ -1084,10 +1162,44 @@ def _print_status(snapshot: dict, health: dict,
                   f"{s['p99_s'] * 1e3:>9.3f} {mean_ms:>9.3f}")
 
 
+def _print_control(control: dict) -> None:
+    """The controller section of ``status``: loop modes + knobs, the
+    per-tenant admit/shed aggregates, and the last few decisions."""
+    batching = control.get("batching") or {}
+    autoscale = control.get("autoscale") or {}
+    line = f"control: target p99 {control.get('target_p99_ms')}ms"
+    if batching:
+        cap = batching.get("bucket_cap")
+        line += (f" | batching {batching.get('mode')} "
+                 f"linger {batching.get('linger_ms'):.2f}ms "
+                 f"cap {'-' if cap is None else cap}")
+    if autoscale:
+        line += (f" | autoscale {autoscale.get('mode')} "
+                 f"workers {autoscale.get('workers')} "
+                 f"[{autoscale.get('min_workers')}.."
+                 f"{autoscale.get('max_workers')}]")
+    print(line)
+    tenants = control.get("tenants") or {}
+    if tenants:
+        print("  tenants:")
+        for name, v in sorted(tenants.items()):
+            print(f"    {name:<36} {v}")
+    decisions = control.get("decisions") or []
+    if decisions:
+        print(f"  decisions (last {min(len(decisions), 5)}):")
+        for d in decisions[-5:]:
+            extra = (f"worker {d.get('worker')}"
+                     if d.get("loop") == "autoscale"
+                     else f"linger {d.get('linger_ms')}ms "
+                          f"cap {d.get('bucket_cap')}")
+            print(f"    t+{d.get('t', 0):.1f}s {d.get('loop'):<9} "
+                  f"{d.get('action'):<12} {extra}")
+
+
 def _scrape_endpoint(endpoint: str):
-    """GET /snapshot + /healthz (+ /alerts, absent on pre-ISSUE-13
-    endpoints) off one endpoint; raises on transport failure (callers
-    decide whether one dead worker fails the probe)."""
+    """GET /snapshot + /healthz (+ /alerts and /control, absent on
+    older endpoints) off one endpoint; raises on transport failure
+    (callers decide whether one dead worker fails the probe)."""
     import urllib.error
     import urllib.request
 
@@ -1101,15 +1213,17 @@ def _scrape_endpoint(endpoint: str):
     except urllib.error.HTTPError as e:
         # 503 = degraded; the body still carries the check detail
         health = json.loads(e.read())
-    alerts = None
-    try:
-        with urllib.request.urlopen(base + "/alerts", timeout=10) as r:
-            alerts = json.loads(r.read())
-    except (urllib.error.URLError, OSError, json.JSONDecodeError):
-        # a worker endpoint (no telemetry) 404s here — the snapshot and
-        # health verdict still stand alone
-        alerts = None
-    return snapshot, health, alerts
+
+    def _optional(path: str):
+        # absent on worker endpoints (no telemetry) and on older
+        # routers — the snapshot and health verdict still stand alone
+        try:
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.loads(r.read())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError):
+            return None
+
+    return snapshot, health, _optional("/alerts"), _optional("/control")
 
 
 def _status_multi(endpoints) -> int:
@@ -1129,9 +1243,9 @@ def _status_multi(endpoints) -> int:
                 "status": "unreachable",
                 "checks": {},
                 "error": str(e),
-            }, None)
+            }, None, None)
     n_ok = 0
-    for ep, (snapshot, health, alerts) in per.items():
+    for ep, (snapshot, health, alerts, control) in per.items():
         status = health.get("status")
         print(f"===== {ep}: {status} =====")
         if status == "unreachable":
@@ -1139,7 +1253,7 @@ def _status_multi(endpoints) -> int:
             continue
         if status == "ok":
             n_ok += 1
-        _print_status(snapshot, health, alerts)
+        _print_status(snapshot, health, alerts, control)
     aggregate = "ok" if n_ok == len(endpoints) else "degraded"
     print(f"aggregate: {aggregate} ({n_ok}/{len(endpoints)} endpoints ok)")
     return 0 if aggregate == "ok" else 1
@@ -1177,13 +1291,15 @@ def _status_watch(args) -> int:
 
 def _status_once(args) -> int:
     alerts = None
+    control = None
     if args.endpoint:
         import urllib.error
 
         if len(args.endpoint) > 1:
             return _status_multi(args.endpoint)
         try:
-            snapshot, health, alerts = _scrape_endpoint(args.endpoint[0])
+            snapshot, health, alerts, control = \
+                _scrape_endpoint(args.endpoint[0])
         except (urllib.error.URLError, OSError,
                 json.JSONDecodeError) as e:
             # a down daemon is the most common reason to run this probe
@@ -1214,7 +1330,7 @@ def _status_once(args) -> int:
         app = Application(cfg)
         snapshot = app.observability.snapshot()
         health = app.observability.health()
-    _print_status(snapshot, health, alerts)
+    _print_status(snapshot, health, alerts, control)
     firing = bool(alerts and alerts.get("firing"))
     return 0 if health.get("status") == "ok" and not firing else 1
 
@@ -1547,6 +1663,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slow-duty", type=float, default=0.05,
                    help="tick probability per round for the slow-drip "
                         "straggler set")
+    p.add_argument("--no-controller", action="store_true",
+                   help="--role router/local: disable the adaptive "
+                        "control plane (fmda_tpu.control; on by default "
+                        "whenever fleet telemetry is) — fixed linger, "
+                        "no autoscaling, global oldest-drop shedding")
+    p.add_argument("--tenant-mix", default=None,
+                   metavar="CLASS:WEIGHT,...",
+                   help="--role local: tenant-labeled traffic mix, e.g. "
+                        "'gold:1,standard:4' — sessions are assigned a "
+                        "priority class weight-proportionally and opened "
+                        "labeled (per-tenant QoS applies when [control] "
+                        "tenant_classes configures the policy); "
+                        "composable with --burst-every/--storm-every/"
+                        "--slow-fraction")
     p.add_argument("--chaos-plan", default=None, metavar="FILE",
                    help="--role local: run the chaos soak under this "
                         "fault-plan JSON (fmda_tpu.chaos.FaultPlan; "

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import subprocess
 import sys
 import tempfile
@@ -122,6 +123,43 @@ class LocalFleet:
         log.warning("chaos: killed worker %s (pid %d)",
                     worker_id, proc.pid)
         return True
+
+    def add_worker(self) -> Optional[str]:
+        """Spawn ONE MORE worker process into the running topology (the
+        autoscaler's scale-up actuation) — a fresh id, the same argv
+        template as the bootstrap workers.  Non-blocking: the new
+        worker hellos its own way into membership exactly like any
+        join, so the caller's ordinary pump loop sees it arrive (and no
+        results are consumed waiting here).  Returns the new worker id,
+        or None when the topology can't grow (no argv template)."""
+        if not self.worker_ids or self.repo_root is None:
+            return None
+        template = self.worker_argv.get(self.worker_ids[0])
+        if template is None or "--worker-id" not in template:
+            return None
+        m = re.match(r"^(.*?)(\d+)$", self.worker_ids[0])
+        prefix = m.group(1) if m else self.worker_ids[0]
+        used = set()
+        for wid in self.worker_ids:
+            m = re.match(re.escape(prefix) + r"(\d+)$", wid)
+            if m:
+                used.add(int(m.group(1)))
+        idx = 0
+        while idx in used:
+            # never reuse an id: revive_worker owns the same-id path,
+            # and a retired id's goodbye may still be settling
+            idx += 1
+        wid = f"{prefix}{idx}"
+        argv = list(template)
+        argv[argv.index("--worker-id") + 1] = wid
+        proc = _spawn(
+            argv, os.path.join(self.log_dir, f"{wid}.log"),
+            self.repo_root)
+        self.worker_ids.append(wid)
+        self.procs.append(proc)
+        self.worker_argv[wid] = argv
+        log.info("scale-up: spawned worker %s (pid %d)", wid, proc.pid)
+        return wid
 
     def revive_worker(self, worker_id: str) -> bool:
         """Spawn a fresh incarnation of a killed worker (same id, same

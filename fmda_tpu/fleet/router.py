@@ -125,6 +125,9 @@ class _Session:
     buffer: Deque[dict] = field(default_factory=deque)
     #: exported state that arrived while no worker could host it
     pending_state: Optional[dict] = None
+    #: tenant / priority-class label (fmda_tpu.control QoS); rides every
+    #: open so the owning gateway classifies the session's ticks
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -262,10 +265,17 @@ class FleetRouter:
 
     # -- session admission ---------------------------------------------------
 
-    def open_session(self, session_id: str, norm=None) -> None:
+    def open_session(
+        self, session_id: str, norm=None, *,
+        tenant: Optional[str] = None,
+    ) -> None:
         """Admit a session: register it and route an ``open`` to its
         owner.  Raises :class:`NoLiveWorkers` when the fleet is empty —
-        admission control stays loud, like the gateway's."""
+        admission control stays loud, like the gateway's.
+
+        ``tenant`` labels the session with its QoS priority class
+        (fmda_tpu.control); the label follows the session through every
+        migration and failover reopen."""
         if session_id in self._sessions:
             raise ValueError(f"session {session_id!r} already open")
         owner = self.table.owner_of(session_id)
@@ -274,7 +284,8 @@ class FleetRouter:
             raise NoLiveWorkers(
                 "no live workers to own sessions (did the fleet start? "
                 "wait_for_workers bootstraps membership)")
-        sess = _Session(session_id, owner, encode_norm(norm))
+        sess = _Session(session_id, owner, encode_norm(norm),
+                        tenant=tenant)
         self._sessions[session_id] = sess
         self._enqueue(owner, self._open_msg(sess))
         self.metrics.count("sessions_opened")
@@ -314,7 +325,16 @@ class FleetRouter:
             msg["state"] = state
         if sess.mig is not None:
             msg["mig"] = sess.mig
+        if sess.tenant is not None:
+            msg["tenant"] = sess.tenant
         return msg
+
+    def session_tenant(self, session_id: str) -> Optional[str]:
+        """An open session's tenant label (None when unlabeled)."""
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"no open session {session_id!r}")
+        return sess.tenant
 
     def _sessions_changed(self) -> None:
         self.metrics.gauge("active_sessions", len(self._sessions))
@@ -996,7 +1016,8 @@ class FleetRouter:
                 continue
             self._sessions[sid] = _Session(
                 sid, worker_id, info.get("norm"),
-                next_seq=int(info.get("seq", 0)))
+                next_seq=int(info.get("seq", 0)),
+                tenant=info.get("tenant"))
             adopted += 1
         if adopted:
             self.metrics.count("sessions_adopted", adopted)
@@ -1027,13 +1048,37 @@ class FleetRouter:
         self._enqueue(worker_id, {"kind": "report_sessions", "wire": 2})
         self.metrics.count("session_reports_requested")
 
-    def request_leave(self, worker_id: Optional[str]) -> None:
+    def request_leave(self, worker_id: Optional[str]) -> bool:
         """Gracefully drain a worker out of the fleet: it keeps serving
         while its sessions migrate off one ``drain_session`` at a time,
-        and is stopped once it owns nothing."""
+        and is stopped once it owns nothing.  True when the drain was
+        actually initiated (the autoscaler's scale-down branches on
+        this — a worker already leaving, or unknown, is not a move)."""
         if worker_id and self.membership.mark_leaving(worker_id):
             self.metrics.count("workers_leaving")
             self._rebalance(f"graceful leave: {worker_id}")
+            return True
+        return False
+
+    def broadcast_retune(
+        self, *, max_linger_ms: Optional[float] = None,
+        bucket_cap: Optional[int] = None,
+    ) -> int:
+        """Push new batching knobs to every live worker's gateway (the
+        batching controller's fleet-wide actuation).  Returns how many
+        workers were told; each applies via ``FleetGateway.retune`` —
+        bucket caps only ever select already-compiled buckets."""
+        live = self.membership.live()
+        for wid in live:
+            self._enqueue(wid, {
+                "kind": "retune",
+                "max_linger_ms": max_linger_ms,
+                "bucket_cap": bucket_cap,
+                "wire": 2,
+            })
+        if live:
+            self.metrics.count("retunes_broadcast")
+        return len(live)
 
     def _maybe_release_leaving(self) -> None:
         """Stop a leaving worker once no session is assigned to it any

@@ -128,7 +128,7 @@ def decode_norm(msg: Optional[dict]):
 
 def encode_session_state(state: dict) -> dict:
     """:meth:`FleetGateway.export_session` output -> wire form."""
-    return {
+    out = {
         "carry": [
             [encode_array(part) for part in layer]
             for layer in state["carry"]
@@ -139,11 +139,16 @@ def encode_session_state(state: dict) -> dict:
         "x_range": encode_array(state["x_range"]),
         "seq": int(state["seq"]),
     }
+    if state.get("tenant") is not None:
+        # the QoS class migrates with the session (fmda_tpu.control);
+        # pre-v2 decoders simply drop the extra key
+        out["tenant"] = str(state["tenant"])
+    return out
 
 
 def decode_session_state(msg: dict) -> dict:
     """Wire form -> :meth:`FleetGateway.import_session` input."""
-    return {
+    out = {
         "carry": [
             [decode_array(part) for part in layer]
             for layer in msg["carry"]
@@ -154,3 +159,6 @@ def decode_session_state(msg: dict) -> dict:
         "x_range": decode_array(msg["x_range"]),
         "seq": int(msg["seq"]),
     }
+    if msg.get("tenant") is not None:
+        out["tenant"] = str(msg["tenant"])
+    return out

@@ -69,6 +69,7 @@ class FleetWorker:
         data_bus=None,
         data_address: Optional[str] = None,
         reconnect_fn: Optional[Callable[[], object]] = None,
+        qos=None,
     ) -> None:
         self.worker_id = worker_id
         self.bus = bus
@@ -127,6 +128,11 @@ class FleetWorker:
             self.data_bus if self._split else self._pub,
             **kwargs)
         self.metrics = self.gateway.metrics
+        if qos is not None:
+            # per-tenant QoS policy (fmda_tpu.control.qos): overload
+            # shedding at THIS gateway becomes class-aware — sessions
+            # arrive labeled via the router's open messages
+            self.gateway.attach_qos(qos)
         self._inbox = self.data_bus.consumer(fleet_worker_topic(worker_id))
         announce = {"address": data_address} if data_address else None
         self.heartbeater = Heartbeater(
@@ -216,6 +222,11 @@ class FleetWorker:
                     "x_max": encode_array(x_min + x_range),
                 },
             }
+            tenant = self.gateway.session_tenant(sid)
+            if tenant is not None:
+                # the QoS class survives router failover with the rest
+                # of the session truth this report rebuilds
+                out[sid]["tenant"] = tenant
         if legacy is None:
             legacy = self._control_is_json()
         if out and legacy:
@@ -225,7 +236,7 @@ class FleetWorker:
     def stats(self) -> Dict[str, object]:
         """The serving stats every heartbeat carries."""
         c = self.metrics.counters
-        return {
+        out = {
             "active_sessions": self.pool.n_active,
             "ticks_served": c.get("ticks_served", 0),
             "flushes": c.get("flushes", 0),
@@ -237,6 +248,15 @@ class FleetWorker:
             "compile_count": self.pool.compile_count,
             "queue_depth": len(self.gateway.batcher),
         }
+        # per-class admit/shed attribution (fmda_tpu.control QoS): the
+        # gateway counts these in this process; the beat carries them so
+        # the control plane can fold fleet-wide per-tenant rates
+        tenant_counters = {
+            k: v for k, v in c.items()
+            if k.startswith(("admitted_class_", "shed_class_"))}
+        if tenant_counters:
+            out["tenant_counters"] = tenant_counters
+        return out
 
     def step(self) -> int:
         """One worker cycle: apply a bounded slice of the inbox, pump
@@ -511,6 +531,15 @@ class FleetWorker:
                             or int(msg.get("wire", 1)) < 2)),
             })
             self.metrics.count("session_reports")
+        elif kind == "retune":
+            # batching-controller actuation (fmda_tpu.control): swap the
+            # gateway's linger/bucket knobs in place — never a compile,
+            # never a dropped tick, applies between two pump cycles
+            linger = msg.get("max_linger_ms")
+            cap = msg.get("bucket_cap")
+            self.gateway.retune(
+                max_linger_ms=float(linger) if linger is not None else None,
+                bucket_cap=int(cap) if cap is not None else None)
         # lint: ignore[wire-protocol] operator entry point: published by hand (or tooling) onto a worker inbox — nothing in the package produces it by design
         elif kind == "leave":
             # operator-initiated graceful leave: tell the router, which
@@ -568,13 +597,18 @@ class FleetWorker:
             self.gateway.close_session(sid)
         try:
             if msg.get("state") is not None:
-                self.gateway.import_session(
-                    sid, decode_session_state(msg["state"]))
+                state = decode_session_state(msg["state"])
+                if msg.get("tenant") is not None:
+                    # the router's registry label wins when the exporting
+                    # gateway never learned the class (an adopted session)
+                    state.setdefault("tenant", msg["tenant"])
+                self.gateway.import_session(sid, state)
                 self.metrics.count("sessions_migrated_in")
             else:
                 self.gateway.open_session(
                     sid, decode_norm(msg.get("norm")),
-                    seq=int(msg.get("seq", 0)))
+                    seq=int(msg.get("seq", 0)),
+                    tenant=msg.get("tenant"))
         except PoolExhausted:
             # counted at the gateway too (rejected_sessions); tell the
             # router so the failure is visible fleet-wide

@@ -66,6 +66,11 @@ GATEWAY_LOSS_COUNTERS = (
     # served: it belongs in the loss sum (the counted-loss lint rule's
     # vocabulary cross-check caught its absence)
     "stale_results_dropped",
+    # per-tenant QoS (fmda_tpu.control): a class at its queue-share
+    # quota sheds its own oldest tick to admit the newer one — a
+    # counted loss distinct from the global shed_oldest overflow path
+    # (each shed increments exactly one of the two, never both)
+    "quota_shed",
 )
 
 #: heartbeat-stats fields folded per worker: stat key -> (series, kind)
@@ -254,6 +259,8 @@ class FleetTelemetry:
             on_fire=self._on_alert_fire)
         self._router = None
         self._registry: Optional[MetricsRegistry] = None
+        #: attached ControlPlane (fmda_tpu.control) — powers /control
+        self._controller = None
         self._last_collect: Optional[float] = None
         self._last_scrape: Optional[float] = None
         #: the in-flight background scrape round (HTTP must never run
@@ -470,10 +477,24 @@ class FleetTelemetry:
             "router_counters": dict(router.metrics.counters),
         }
 
+    def attach_controller(self, controller) -> None:
+        """Attach the :class:`~fmda_tpu.control.plane.ControlPlane` so
+        its loop state serves on ``/control`` next to the alerts it
+        reacts to (and ``python -m fmda_tpu status`` can read it)."""
+        self._controller = controller
+
+    def control(self) -> dict:
+        """The ``/control`` document: the attached control plane's
+        status, or an explicit disabled stub when none is attached."""
+        if self._controller is None:
+            return {"enabled": False}
+        return self._controller.status()
+
     def start_server(self, *, host: str = "127.0.0.1", port: int = 0):
         """A MetricsServer over this telemetry: ``/metrics``,
         ``/healthz`` (SLO-aware), ``/snapshot``, ``/events``, ``/trace``
-        plus the range endpoints ``/query`` and ``/alerts``."""
+        plus the range endpoints ``/query``, ``/alerts``, and
+        ``/control``."""
         from fmda_tpu.obs.server import MetricsServer
         from fmda_tpu.obs.trace import default_tracer
 
@@ -489,4 +510,5 @@ class FleetTelemetry:
             tracer=default_tracer(),
             query_fn=self.query,
             alerts_fn=self.alerts,
+            control_fn=self.control,
         ).start()

@@ -16,7 +16,9 @@ No third-party web framework — five fixed routes on a daemonised
   ``python -m fmda_tpu trace --endpoint``);
 - ``/query``    — time-series range queries (``?series=&window=``) when
   a fleet telemetry handle is attached (fmda_tpu.obs.aggregate);
-- ``/alerts``   — the SLO engine's alert document (fmda_tpu.obs.slo).
+- ``/alerts``   — the SLO engine's alert document (fmda_tpu.obs.slo);
+- ``/control``  — the control plane's loop state + decision ring
+  (fmda_tpu.control, when one is attached).
 
 A handler exception yields an HTTP 500 with a JSON ``{"error": ...}``
 body — never a half-written response — and the serving thread survives.
@@ -57,6 +59,7 @@ class MetricsServer:
         tracer: Optional[Tracer] = None,
         query_fn: Optional[Callable[..., dict]] = None,
         alerts_fn: Optional[Callable[[], dict]] = None,
+        control_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.registry = registry
         self.health_fn = health_fn
@@ -64,6 +67,7 @@ class MetricsServer:
         self.tracer = tracer
         self.query_fn = query_fn
         self.alerts_fn = alerts_fn
+        self.control_fn = control_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -147,6 +151,13 @@ class MetricsServer:
                         self._send(
                             200,
                             json.dumps(server.alerts_fn(),
+                                       indent=2).encode(),
+                            "application/json")
+                    elif path == "/control" \
+                            and server.control_fn is not None:
+                        self._send(
+                            200,
+                            json.dumps(server.control_fn(),
                                        indent=2).encode(),
                             "application/json")
                     elif path == "/trace":

@@ -95,6 +95,12 @@ class MicroBatcher:
         #: ``ready`` fires early.  None = only the largest bucket counts
         #: as batch-full.
         self.full_target: Optional[int] = None
+        #: Soft cap on the flush size the batching controller can lower
+        #: at runtime (fmda_tpu.control): flushes stop growing past the
+        #: largest *configured* bucket at or under the cap — only
+        #: already-compiled buckets are ever selected, so a retune can
+        #: never cost a compile on the tick path.  None = uncapped.
+        self.bucket_cap: Optional[int] = None
 
     def __len__(self) -> int:
         return len(self._pending)
@@ -117,6 +123,31 @@ class MicroBatcher:
         self._dec(tick)
         return tick
 
+    def shed_matching(self, pred: Callable[[Tick], bool]) -> Optional[Tick]:
+        """Drop (and return) the *oldest* pending tick satisfying
+        ``pred`` — the per-tenant QoS shed (fmda_tpu.control.qos picks
+        the victim class; this removes its oldest tick).  None when
+        nothing matches; the caller counts every drop, never silent.
+        O(queue) scan, but only ever on the contended-shed path."""
+        for i, tick in enumerate(self._pending):
+            if pred(tick):
+                del self._pending[i]
+                self._dec(tick)
+                return tick
+        return None
+
+    def effective_cap(self) -> int:
+        """The flush-size ceiling: the largest configured bucket at or
+        under ``bucket_cap`` (smallest bucket when the cap undercuts
+        them all; the largest when uncapped)."""
+        sizes = self.config.bucket_sizes
+        if self.bucket_cap is None:
+            return sizes[-1]
+        for b in reversed(sizes):
+            if b <= self.bucket_cap:
+                return b
+        return sizes[0]
+
     def _dec(self, tick: Tick) -> None:
         key = (tick.handle.slot, tick.handle.generation)
         n = self._per_session.get(key, 0) - 1
@@ -138,7 +169,7 @@ class MicroBatcher:
         budget)."""
         if not self._pending:
             return False
-        target = self.config.bucket_sizes[-1]
+        target = self.effective_cap()
         if self.full_target is not None:
             target = min(target, max(self.full_target, 1))
         if self.distinct_sessions >= target:
@@ -149,7 +180,7 @@ class MicroBatcher:
         """Pop the next flush: first pending row per session, FIFO, up to
         the largest bucket.  Later rows of the same session stay queued
         (their recurrence needs this flush's result first)."""
-        cap = self.config.bucket_sizes[-1]
+        cap = self.effective_cap()
         # fast path for the common lockstep flush: when no session has a
         # second row queued and everything fits one flush, the whole
         # queue is the batch — no per-tick set hashing or re-queueing

@@ -151,6 +151,16 @@ class FleetGateway:
         self.pipeline_depth = pipeline_depth
         self.batcher = MicroBatcher(batcher_config, clock=clock)
         self._seq: Dict[str, int] = {}
+        #: per-session tenant labels (None entries never stored); rides
+        #: export/import so a migrated session keeps its class
+        self._tenant: Dict[str, str] = {}
+        #: per-tenant QoS policy (fmda_tpu.control.qos.QosPolicy); None
+        #: = global oldest-drop shedding, exactly the pre-control path
+        self.qos = None
+        #: queued ticks per priority class, maintained O(1) per tick
+        #: (only while a policy is attached — the victim pick must not
+        #: scan the queue per submit)
+        self._queued_by_class: Dict[str, int] = {}
         # pre-allocated per-bucket staging for batch assembly, two
         # (slots, rows) pairs per bucket: with a one-deep pipeline at
         # most one earlier flush's dispatch can still be reading its
@@ -184,7 +194,7 @@ class FleetGateway:
 
     def open_session(
         self, session_id: str, norm: Optional[NormParams] = None,
-        *, seq: int = 0,
+        *, seq: int = 0, tenant: Optional[str] = None,
     ) -> SessionHandle:
         """Admit a session (raises :class:`PoolExhausted` when the fleet
         is full — counted, so rejected admissions show up on dashboards,
@@ -192,7 +202,9 @@ class FleetGateway:
 
         ``seq`` starts the session's result sequence above 0 — the
         multi-host router reopens a lost-state session mid-stream and
-        must not emit colliding (session, seq) pairs."""
+        must not emit colliding (session, seq) pairs.  ``tenant`` is
+        the session's priority-class label (fmda_tpu.control QoS);
+        unlabeled sessions ride the policy's default class."""
         try:
             handle = self.pool.alloc(session_id, norm)
         except PoolExhausted:
@@ -202,6 +214,8 @@ class FleetGateway:
             raise
         if seq:
             self._seq[session_id] = int(seq)
+        if tenant is not None:
+            self._tenant[session_id] = str(tenant)
         self._sessions_changed()
         return handle
 
@@ -211,7 +225,40 @@ class FleetGateway:
             raise KeyError(f"no open session {session_id!r}")
         self.pool.free(handle)
         self._seq.pop(session_id, None)
+        self._tenant.pop(session_id, None)
         self._sessions_changed()
+
+    def session_tenant(self, session_id: str) -> Optional[str]:
+        """The session's tenant label (None when opened unlabeled) —
+        what the worker's session report carries so failover and
+        migration preserve the class."""
+        return self._tenant.get(session_id)
+
+    # -- control-plane hooks (fmda_tpu.control; docs/control.md) ------------
+
+    def attach_qos(self, policy) -> None:
+        """Install a per-tenant QoS policy: admission bookkeeping turns
+        on and overload shedding becomes WFQ fair-share + quota based
+        (see :meth:`submit`).  Detach with ``None`` to restore global
+        oldest-drop."""
+        self.qos = policy
+        self._queued_by_class = {}
+
+    def retune(
+        self, *, max_linger_ms: Optional[float] = None,
+        bucket_cap: Optional[int] = None,
+    ) -> None:
+        """Swap the batching knobs at runtime (the batching controller's
+        actuation): the frozen config is replaced atomically, and the
+        bucket cap only ever selects an already-compiled bucket — a
+        retune can never cost a compile on the tick path."""
+        import dataclasses as _dc
+
+        if max_linger_ms is not None:
+            self.batcher.config = _dc.replace(
+                self.batcher.config, max_linger_s=max_linger_ms / 1e3)
+        self.batcher.bucket_cap = bucket_cap
+        self.metrics.count("retunes_applied")
 
     def _sessions_changed(self) -> None:
         self.metrics.gauge("active_sessions", self.pool.n_active)
@@ -234,6 +281,11 @@ class FleetGateway:
             raise KeyError(f"no open session {session_id!r}")
         state = self.pool.export_slot(handle)
         state["seq"] = self._seq.get(session_id, 0)
+        tenant = self._tenant.get(session_id)
+        if tenant is not None:
+            # the QoS class migrates with the session — a gold session
+            # must not land on the new owner as best-effort
+            state["tenant"] = tenant
         return state
 
     def session_seq(self, session_id: str) -> int:
@@ -258,12 +310,13 @@ class FleetGateway:
         """Open a session from an :meth:`export_session` snapshot (the
         receiving end of a migration): allocates a slot, loads the
         carried state bit-exact, and resumes the sequence counter."""
-        handle = self.open_session(session_id)
+        handle = self.open_session(session_id, tenant=state.get("tenant"))
         try:
             self.pool.import_slot(handle, state)
         except Exception:
             # a malformed snapshot must not leak the slot it claimed
             self.pool.free(handle)
+            self._tenant.pop(session_id, None)
             self._sessions_changed()
             raise
         self._seq[session_id] = int(state.get("seq", 0))
@@ -295,9 +348,38 @@ class FleetGateway:
             raise ValueError(
                 f"row shape {row.shape} != ({self.pool.cfg.n_features},) "
                 f"for session {session_id!r}")
+        cls = None
+        if self.qos is not None:
+            # per-tenant quota: a class at its queue-share budget sheds
+            # its OWN oldest tick to admit the new one — a storming
+            # tenant can never crowd other classes out of the queue
+            cls = self.qos.classify(self._tenant.get(session_id))
+            quota = self.qos.quota(cls, self.queue_bound)
+            while self._queued_by_class.get(cls, 0) >= quota:
+                shed = self.batcher.shed_matching(
+                    lambda t: self._class_of(t) == cls)
+                if shed is None:
+                    break
+                self.metrics.count("quota_shed")
+                self.metrics.count(f"shed_class_{cls}")
+                self._class_dec(cls)
         while len(self.batcher) >= self.queue_bound:
-            shed = self.batcher.shed_oldest()
+            shed = None
+            if self.qos is not None:
+                # WFQ fair-share shedding: the class furthest over its
+                # weighted share loses its oldest tick (global
+                # oldest-drop when no policy is attached)
+                vcls = self.qos.pick_victim(self._queued_by_class)
+                if vcls is not None:
+                    shed = self.batcher.shed_matching(
+                        lambda t: self._class_of(t) == vcls)
+            if shed is None:
+                shed = self.batcher.shed_oldest()
             self.metrics.count("shed_oldest")
+            if self.qos is not None and shed is not None:
+                scls = self._class_of(shed)
+                self.metrics.count(f"shed_class_{scls}")
+                self._class_dec(scls)
             n = self.metrics.counters["shed_oldest"]
             if n == 1 or n % self.SHED_LOG_EVERY == 0:
                 log.warning(
@@ -321,8 +403,23 @@ class FleetGateway:
         self.batcher.add(Tick(
             handle=handle, row=row, t_enqueue=self.clock(), seq=seq,
             trace=ref, wire=wire))
+        if self.qos is not None:
+            self._queued_by_class[cls] = \
+                self._queued_by_class.get(cls, 0) + 1
+            self.metrics.count(f"admitted_class_{cls}")
         self.metrics.gauge("queue_depth", len(self.batcher))
         return seq
+
+    def _class_of(self, tick: Tick) -> str:
+        """A queued tick's priority class under the attached policy."""
+        return self.qos.classify(self._tenant.get(tick.handle.session_id))
+
+    def _class_dec(self, cls: str) -> None:
+        n = self._queued_by_class.get(cls, 0) - 1
+        if n <= 0:
+            self._queued_by_class.pop(cls, None)
+        else:
+            self._queued_by_class[cls] = n
 
     @property
     def saturated(self) -> bool:
@@ -362,6 +459,11 @@ class FleetGateway:
                 ticks = self.batcher.take_batch()
                 if not ticks:
                     break
+                if self.qos is not None:
+                    # ticks leave the queue only here or via shed —
+                    # both decrement, so class counts stay exact
+                    for t in ticks:
+                        self._class_dec(self._class_of(t))
                 nxt = self._dispatch(ticks)
                 if nxt is not None:
                     dispatched_any = True

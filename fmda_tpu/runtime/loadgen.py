@@ -52,6 +52,33 @@ class FleetLoadConfig:
     #: ragged-fill the small buckets (the anti-batching shape).
     slow_fraction: float = 0.0
     slow_duty: float = 0.05
+    #: Tenant-labeled traffic mix (fmda_tpu.control QoS): parallel
+    #: tuples of class names and per-class session weights.  Each
+    #: session is assigned one class (deterministic from ``seed``,
+    #: proportional to weight) and opened with ``tenant=<class>`` —
+    #: composable with bursts, storms, and stragglers, so a spiky gold
+    #: tenant can storm a best-effort background fleet.  Empty =
+    #: unlabeled sessions (the pre-QoS shape, byte-for-byte).
+    tenant_classes: tuple = ()
+    tenant_weights: tuple = ()
+
+    def __post_init__(self) -> None:
+        if len(self.tenant_classes) != len(self.tenant_weights):
+            raise ValueError(
+                "tenant_classes and tenant_weights must be parallel: "
+                f"{self.tenant_classes} vs {self.tenant_weights}")
+
+
+def assign_tenants(load: "FleetLoadConfig", rng) -> Optional[list]:
+    """Per-session tenant labels for the configured mix (None when no
+    mix): weight-proportional draw, deterministic in the load's rng
+    stream so a reference replay assigns identically."""
+    if not load.tenant_classes:
+        return None
+    weights = np.asarray(load.tenant_weights, float)
+    probs = weights / weights.sum()
+    idx = rng.choice(len(load.tenant_classes), size=load.n_sessions, p=probs)
+    return [load.tenant_classes[i] for i in idx]
 
 
 def run_fleet_load(
@@ -79,6 +106,7 @@ def run_fleet_load(
     rng = np.random.default_rng(load.seed)
 
     session_ids = [f"T{i:04d}" for i in range(load.n_sessions)]
+    tenants = assign_tenants(load, rng)
     # per-session price scale: normalization stats differ per ticker, so
     # the pool's per-slot norm gather is actually exercised
     mins = rng.normal(0.0, 1.0, size=(load.n_sessions, feats)).astype(
@@ -86,7 +114,11 @@ def run_fleet_load(
     maxs = mins + rng.uniform(1.0, 5.0, size=(load.n_sessions, feats)).astype(
         np.float32)
     for i, sid in enumerate(session_ids):
-        gateway.open_session(sid, NormParams(mins[i], maxs[i]))
+        if tenants is None:
+            gateway.open_session(sid, NormParams(mins[i], maxs[i]))
+        else:
+            gateway.open_session(
+                sid, NormParams(mins[i], maxs[i]), tenant=tenants[i])
 
     # independent random walks (B, F), advanced only for sessions that tick
     walk = rng.normal(size=(load.n_sessions, feats)).astype(np.float32)
@@ -98,6 +130,7 @@ def run_fleet_load(
         slow_idx = rng.choice(load.n_sessions, size=n_slow, replace=False)
         per_session_duty[slow_idx] = load.slow_duty
     submitted = 0
+    submitted_by_class: Dict[str, int] = {}
     served = 0
     reopened = 0
     burst_ticks = 0
@@ -113,7 +146,13 @@ def run_fleet_load(
                                 replace=False):
                 sid = session_ids[i]
                 gateway.close_session(sid)
-                gateway.open_session(sid, NormParams(mins[i], maxs[i]))
+                if tenants is None:
+                    gateway.open_session(sid, NormParams(mins[i], maxs[i]))
+                else:
+                    # same client reconnecting: the class sticks
+                    gateway.open_session(
+                        sid, NormParams(mins[i], maxs[i]),
+                        tenant=tenants[i])
                 reopened += 1
         in_burst = (load.burst_every and r >= load.burst_every
                     and r % load.burst_every < load.burst_rounds)
@@ -139,6 +178,10 @@ def run_fleet_load(
                     time.sleep(0.002)
             gateway.submit(session_ids[i], walk[i])
             submitted += 1
+            if tenants is not None:
+                cls = tenants[i]
+                submitted_by_class[cls] = \
+                    submitted_by_class.get(cls, 0) + 1
         served += len(gateway.pump())
         if on_round is not None:
             on_round(r)
@@ -162,6 +205,8 @@ def run_fleet_load(
         out["burst_ticks"] = burst_ticks
     if n_slow:
         out["slow_sessions"] = n_slow
+    if tenants is not None:
+        out["submitted_by_class"] = submitted_by_class
     return out
 
 

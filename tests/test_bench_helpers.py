@@ -69,6 +69,7 @@ class TestPhaseRegistry:
             "runtime_fleet_smoke",
             "predictor_fleet_smoke",
             "runtime_multihost_smoke",
+            "control_capacity_model",
             "runtime_chaos_soak",
             "pipeline_chaos_soak",
             "obs_overhead",
