@@ -44,6 +44,10 @@ rule id                   severity    contract
                                       keep a reachable legacy lowering
 ``thread-lifecycle``      error       spawned threads are daemonized or
                                       joined/cancelled on a close path
+``tracked-jit``           error       serving-stack modules (runtime/,
+                                      ops/dispatch.py) compile through
+                                      obs.device.tracked_jit, never raw
+                                      jax.jit/pjit
 ========================  ==========  =========================================
 
 Entry points: ``python -m fmda_tpu lint`` (exit 0 = clean vs baseline,
@@ -83,6 +87,7 @@ from fmda_tpu.analysis.purity import JitPurityRule
 from fmda_tpu.analysis.sarif import to_sarif
 from fmda_tpu.analysis.threads import ThreadLifecycleRule
 from fmda_tpu.analysis.topics import BusTopicRule
+from fmda_tpu.analysis.tracked_jit import TrackedJitRule
 
 __all__ = [
     "DEFAULT_BASELINE",
@@ -114,6 +119,7 @@ __all__ = [
     "RouterJaxImportRule",
     "SpanClockRule",
     "ThreadLifecycleRule",
+    "TrackedJitRule",
     "WireProtocolRule",
     "to_sarif",
 ]
@@ -137,6 +143,7 @@ def default_rules(*, drift: bool = True):
         CountedLossRule(),
         WireProtocolRule(),
         ThreadLifecycleRule(),
+        TrackedJitRule(),
     ]
     if drift:
         rules.append(JaxApiDriftRule())

@@ -1,0 +1,102 @@
+"""tracked-jit: the serving stack compiles only through the ledger.
+
+ISSUE 17 put a compile ledger under every serving-path ``jit``
+(:func:`fmda_tpu.obs.device.tracked_jit`): per-program compile events,
+cost-analysis FLOPs, and the unexpected-recompile detector the SLO
+engine alerts on.  That visibility erodes one convenient ``jax.jit`` at
+a time — a helper jitted in a refactor here, an experiment left in
+there — and every untracked site is a program whose recompiles the
+fleet cannot see.  This rule is the ratchet: inside the serving scope —
+``runtime/`` and the kernel dispatch seam — any direct
+``jax.jit``/``jax.pjit`` call is a finding unless the site routes
+through :func:`tracked_jit` or carries the standard in-place hatch
+(``# lint: ignore[tracked-jit] reason``) naming why the program is
+deliberately off-ledger.  Alias-aware: ``import jax as j`` and ``from
+jax import jit as J`` are still caught.
+
+Pure AST, no imports beyond the engine — runs on jax-free hosts.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from fmda_tpu.analysis.engine import Finding, LintContext, ParsedModule, Rule
+
+#: directory prefixes inside the package that ARE the serving stack
+SCOPE_PREFIXES = ("runtime/",)
+
+#: single modules on the same compile path
+SCOPE_MODULES = ("ops/dispatch.py",)
+
+#: the one sanctioned home for a raw ``jax.jit`` in scope (the wrapper)
+WRAPPER_MODULES = ("obs/device.py",)
+
+JIT_FUNCS = ("jit", "pjit")
+
+#: modules whose ``jit``/``pjit`` attributes count as compile entry
+#: points when imported wholesale (``import jax``, ``import jax as j``)
+JIT_MODULES = ("jax", "jax.experimental.pjit")
+
+
+class TrackedJitRule(Rule):
+    id = "tracked-jit"
+    severity = "error"
+    description = ("serving-stack modules (runtime/, ops/dispatch.py) "
+                   "compile through obs.device.tracked_jit, never raw "
+                   "jax.jit/pjit, except at annotated off-ledger sites")
+
+    def check(self, module: ParsedModule, ctx: LintContext) -> List[Finding]:
+        rel = module.rel
+        in_scope = (rel.startswith(SCOPE_PREFIXES)
+                    or rel in SCOPE_MODULES)
+        if not in_scope or rel in WRAPPER_MODULES:
+            return []
+        mod_aliases: Set[str] = set()
+        func_aliases: Dict[str, str] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in JIT_MODULES:
+                        mod_aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module in JIT_MODULES:
+                    for a in node.names:
+                        if a.name in JIT_FUNCS:
+                            func_aliases[a.asname or a.name] = a.name
+        if not mod_aliases and not func_aliases:
+            return []
+        found: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            call = None
+            if (isinstance(fn, ast.Attribute) and fn.attr in JIT_FUNCS
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in mod_aliases):
+                call = f"jax.{fn.attr}"
+            elif isinstance(fn, ast.Name) and fn.id in func_aliases:
+                call = f"jax.{func_aliases[fn.id]}"
+            if call is not None:
+                found.append(self.finding(
+                    rel, node.lineno,
+                    f"serving-stack {call}() — compile through "
+                    f"fmda_tpu.obs.device.tracked_jit so the ledger sees "
+                    f"the program, or annotate a deliberate off-ledger "
+                    f"site with `# lint: ignore[{self.id}] reason`"))
+        return found
+
+    def finish(self, ctx: LintContext) -> List[Finding]:
+        # the scope lists police their own staleness, like every other
+        # module-list rule: a refactor that moves a listed file must
+        # shrink the list, not silently stop checking
+        found: List[Finding] = []
+        for rel in SCOPE_MODULES + WRAPPER_MODULES:
+            if not (ctx.package_dir / rel).is_file():
+                found.append(self.finding(
+                    rel, 0,
+                    f"stale scope entry: {rel} does not exist",
+                    severity="error"))
+        return found

@@ -574,6 +574,13 @@ def _gate_report(plan: FaultPlan, run: dict) -> dict:
     unaccounted = n_submitted - n_served - losses
     post_quiet = [sid for sid, n in run["post_served"].items() if n == 0]
     failover_ok = all(t["rebuilt_in_time"] for t in run["takeovers"])
+    # the compile ledger's warmup contract, checked under fire: chaos
+    # may kill workers and migrate sessions, but no surviving worker
+    # may ever hit an untraced shape after its precompile declared
+    # warmup over (ISSUE 17; workers ship the count in heartbeats)
+    recompiles = sum(
+        int(s.get("recompiles_after_warmup", 0) or 0)
+        for s in run["worker_stats"].values())
     gates = {
         "exit_ok": True,  # reaching here at all is gate zero
         "unaccounted_zero": unaccounted == 0,
@@ -581,6 +588,7 @@ def _gate_report(plan: FaultPlan, run: dict) -> dict:
         "post_chaos_all_served": not post_quiet,
         "failover_ok": failover_ok,
         "recovery_ok": run["recovery"]["ok"],
+        "no_recompiles_after_warmup": recompiles == 0,
     }
     return {
         "plan": run["plan"],
@@ -601,6 +609,7 @@ def _gate_report(plan: FaultPlan, run: dict) -> dict:
         "takeovers": run["takeovers"],
         "tainted_sessions": run["tainted"],
         "worker_stats": run["worker_stats"],
+        "recompiles_after_warmup": recompiles,
         "gates": gates,
     }
 

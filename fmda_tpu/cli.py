@@ -452,6 +452,13 @@ def _cmd_fleet_worker(args) -> int:
         from fmda_tpu.obs.trace import configure_tracing
 
         configure_tracing(enabled=True, sample_rate=args.trace_sample)
+    # apply [profiling] BEFORE the worker builds its pools, so the
+    # precompile burst is ledger-tracked under the deployment's
+    # cost-analysis setting and the host profiler (if opted in) covers
+    # the whole serve
+    from fmda_tpu.obs.device import configure_device_obs
+
+    configure_device_obs(cfg.profiling)
     from fmda_tpu.config import TOPIC_FLEET_PREDICTION, fleet_worker_topic
     from fmda_tpu.fleet.wire import BusServer, SocketBus
     from fmda_tpu.fleet.worker import FleetWorker
@@ -972,6 +979,11 @@ def cmd_serve_fleet(args) -> int:
         from fmda_tpu.obs.trace import configure_tracing
 
         configure_tracing(enabled=True, sample_rate=args.trace_sample)
+    # [profiling] applies before any pool compiles (ledger settings,
+    # memory cadence, optional continuous host profiler)
+    from fmda_tpu.obs.device import configure_device_obs
+
+    configure_device_obs(cfg.profiling)
 
     from fmda_tpu.models import build_model
     import jax.numpy as jnp
@@ -1142,6 +1154,9 @@ def _print_status(snapshot: dict, health: dict,
                   f"{a.get('detail', '')}")
     if control and control.get("enabled"):
         _print_control(control)
+    perf = _perf_summary(snapshot)
+    if perf:
+        _print_perf_summary(perf)
     for kind in ("counters", "gauges"):
         samples = sorted(snapshot.get(kind, []), key=key)
         if samples:
@@ -1160,6 +1175,75 @@ def _print_status(snapshot: dict, health: dict,
             mean_ms = (s["sum_s"] / n * 1e3) if n else 0.0
             print(f"  {key(s):<52} {n:>8} {s['p50_s'] * 1e3:>9.3f} "
                   f"{s['p99_s'] * 1e3:>9.3f} {mean_ms:>9.3f}")
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0:
+            return (f"{int(n)}B" if unit == "B" else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _perf_summary(snapshot: dict) -> dict:
+    """The device/compiler facts inside ``status`` (ISSUE 17): MFU,
+    post-warmup recompiles, memory watermark + leak verdict.  Reads
+    both vocabularies — a process registry's device collector
+    (``device_mfu``, ``compile_unexpected_total``, ...) and a fleet
+    telemetry's landed worker series (``worker_device_mfu``, ...) —
+    and returns {} when neither is present (older endpoints)."""
+    by_name: dict = {}
+    for kind in ("counters", "gauges"):
+        for s in snapshot.get(kind, []):
+            by_name.setdefault(s["name"], []).append(float(s["value"]))
+
+    def agg(fn, *names):
+        vals = [v for n in names for v in by_name.get(n, [])]
+        return fn(vals) if vals else None
+
+    out = {}
+    mfu = agg(max, "device_mfu", "worker_device_mfu")
+    if mfu is not None:
+        out["mfu"] = mfu
+    intensity = agg(max, "device_arithmetic_intensity")
+    if intensity is not None:
+        out["arithmetic_intensity"] = intensity
+    recompiles = agg(sum, "compile_unexpected_total",
+                     "worker_recompiles_total")
+    if recompiles is not None:
+        out["recompiles_after_warmup"] = int(recompiles)
+    compile_s = agg(sum, "compile_seconds_total",
+                    "worker_compile_seconds_total")
+    if compile_s is not None:
+        out["compile_seconds"] = compile_s
+    watermark = agg(max, "device_memory_watermark_bytes",
+                    "worker_memory_watermark_bytes")
+    if watermark is not None:
+        out["memory_watermark_bytes"] = watermark
+    leak = agg(max, "device_memory_leak_suspected",
+               "worker_memory_leak_suspected")
+    if leak is not None:
+        out["memory_leak_suspected"] = bool(leak)
+    return out
+
+
+def _print_perf_summary(perf: dict) -> None:
+    parts = []
+    if "mfu" in perf:
+        parts.append(f"mfu {perf['mfu'] * 100:.2f}%")
+    if "compile_seconds" in perf:
+        parts.append(f"compile {perf['compile_seconds']:.3f}s")
+    if "recompiles_after_warmup" in perf:
+        n = perf["recompiles_after_warmup"]
+        parts.append(f"post-warmup recompiles {n}"
+                     + (" !!" if n else ""))
+    if "memory_watermark_bytes" in perf:
+        parts.append(
+            f"mem watermark {_fmt_bytes(perf['memory_watermark_bytes'])}")
+    if perf.get("memory_leak_suspected"):
+        parts.append("LEAK SUSPECTED")
+    print("perf: " + " | ".join(parts))
 
 
 def _print_control(control: dict) -> None:
@@ -1432,6 +1516,121 @@ def cmd_trace(args) -> int:
     else:
         print("\n".join(format_trace(t) for t in traces))
     return 0
+
+
+def cmd_perf(args) -> int:
+    """The device/compiler performance report (docs/observability.md
+    §device): compile ledger, top programs by compile time, MFU +
+    roofline position, memory watermarks, kernel fallbacks, and the
+    host profiler's hottest stacks.  Input is a running endpoint's
+    ``/device`` (+ ``/profile``) or a saved device report — a
+    flight-recorder bundle's ``device.json`` or the bench phase's
+    ledger artifact."""
+    profile_text = None
+    if args.endpoint:
+        import urllib.error
+        import urllib.request
+
+        base = (args.endpoint if "://" in args.endpoint
+                else f"http://{args.endpoint}").rstrip("/")
+        try:
+            with urllib.request.urlopen(base + "/device", timeout=10) as r:
+                doc = json.loads(r.read())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            print(f"cannot scrape {base}/device: {e}", file=sys.stderr)
+            return 2
+        try:
+            with urllib.request.urlopen(base + "/profile", timeout=10) as r:
+                profile_text = r.read().decode("utf-8", "replace")
+        except (urllib.error.URLError, OSError):
+            # older endpoints / profiler not attached: the device
+            # report still stands alone
+            profile_text = None
+    elif args.input:
+        try:
+            with open(args.input) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read {args.input}: {e}", file=sys.stderr)
+            return 2
+    else:
+        print("pass --endpoint HOST:PORT (a running /device endpoint) "
+              "or --input FILE (a flight-recorder bundle's device.json "
+              "or a bench ledger artifact)", file=sys.stderr)
+        return 2
+    if args.profile:
+        try:
+            with open(args.profile) as fh:
+                profile_text = fh.read()
+        except OSError as e:
+            print(f"cannot read {args.profile}: {e}", file=sys.stderr)
+            return 2
+    # a bare ledger dump (the bench artifact) renders like a report
+    # with only the ledger section
+    if "ledger" not in doc and "programs" in doc:
+        doc = {"ledger": doc}
+    if args.json:
+        if profile_text is not None:
+            doc = {**doc, "profile_folded": profile_text}
+        print(json.dumps(doc, indent=2))
+        return 0
+    _print_perf_report(doc, profile_text, top=args.top)
+    return 0
+
+
+def _print_perf_report(doc: dict, profile_text, *, top: int) -> None:
+    ledger = doc.get("ledger") or {}
+    programs = list(ledger.get("programs") or [])
+    print("compile ledger"
+          + (f" (backend {ledger['backend']})"
+             if ledger.get("backend") else "") + ":")
+    print(f"  compiles {ledger.get('compiles_total', 0)}"
+          f" | compile time {ledger.get('compile_seconds_total', 0.0):.3f}s"
+          f" | post-warmup recompiles"
+          f" {ledger.get('unexpected_recompiles_total', 0)}"
+          f" | cost-probe failures {ledger.get('cost_probe_failures', 0)}")
+    if "mfu" in doc:
+        print(f"  mfu {float(doc['mfu']) * 100:.2f}%")
+    if programs:
+        programs.sort(key=lambda p: -float(p.get("compile_seconds", 0.0)))
+        print(f"  top {min(top, len(programs))} programs "
+              f"by compile time:")
+        print(f"    {'program':<32} {'signature':<18} {'compiles':>8} "
+              f"{'calls':>8} {'compile_s':>10} {'gflops':>9}")
+        for p in programs[:top]:
+            print(f"    {str(p.get('program', '')):<32} "
+                  f"{str(p.get('signature', '')):<18} "
+                  f"{p.get('compiles', 0):>8} {p.get('calls', 0):>8} "
+                  f"{float(p.get('compile_seconds', 0.0)):>10.3f} "
+                  f"{float(p.get('flops', 0.0)) / 1e9:>9.3f}")
+    memory = doc.get("memory") or {}
+    if memory.get("samples"):
+        leak = " | LEAK SUSPECTED" if memory.get("leak_suspected") else ""
+        print("device memory:")
+        print(f"  live {_fmt_bytes(memory.get('live_bytes', 0))}"
+              f" | watermark {_fmt_bytes(memory.get('watermark_bytes', 0))}"
+              f" | samples {memory.get('samples', 0)}{leak}")
+        for owner, nbytes in sorted((memory.get("by_owner") or {}).items()):
+            print(f"    {owner:<44} {_fmt_bytes(nbytes)}")
+    fallbacks = doc.get("kernel_fallbacks") or {}
+    if fallbacks:
+        print("kernel fallbacks:")
+        for key, n in sorted(fallbacks.items()):
+            print(f"    {key:<44} {n}")
+    if profile_text:
+        from fmda_tpu.obs.pyprof import HostProfiler
+
+        stacks = sorted(HostProfiler.parse_folded(profile_text).items(),
+                        key=lambda kv: -kv[1])
+        if stacks:
+            total = sum(n for _, n in stacks)
+            print(f"hottest host stacks ({total} samples):")
+            for stack, n in stacks[:top]:
+                frames = stack.split(";")
+                leaf = frames[-1] if frames else stack
+                root = frames[0] if frames else ""
+                print(f"  {n:>7}  {root} ... {leaf}"
+                      if len(frames) > 2 else f"  {n:>7}  {stack}")
 
 
 def cmd_lint(args) -> int:
@@ -1828,6 +2027,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="machine-readable output (grouped trace dicts)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser(
+        "perf", parents=[common],
+        help="device/compiler performance report: compile ledger, "
+             "MFU, memory watermarks, hottest host stacks")
+    p.add_argument("--endpoint", default=None, metavar="HOST:PORT",
+                   help="scrape a running endpoint's /device (+ "
+                        "/profile) — a serve-fleet worker or the "
+                        "fleet telemetry endpoint")
+    p.add_argument("--input", default=None, metavar="FILE",
+                   help="saved device report JSON instead: a "
+                        "flight-recorder bundle's device.json or the "
+                        "bench device_obs_overhead ledger artifact")
+    p.add_argument("--profile", default=None, metavar="FILE",
+                   help="folded-stack profile text to report hottest "
+                        "stacks from (a bundle's profile.folded); "
+                        "--endpoint fetches /profile automatically")
+    p.add_argument("--top", type=int, default=10,
+                   help="rows per table: top programs, hottest "
+                        "stacks (default 10)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (the device report "
+                        "document, plus profile_folded when present)")
+    p.set_defaults(fn=cmd_perf)
 
     p = sub.add_parser(
         "chaos-pipeline", parents=[common],

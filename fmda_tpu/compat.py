@@ -24,6 +24,9 @@ shim                spellings it arbitrates
 ``shard_map``       ``jax.shard_map`` (new, ``check_vma=``) vs
                     ``jax.experimental.shard_map.shard_map`` (old,
                     ``check_rep=``); the kwarg is translated
+``cost_analysis``   ``lowered.compile().cost_analysis()`` (dict on new
+                    jax, ``[dict]`` on some 0.4.x, absent on older) —
+                    probed per call, normalised to ``dict | None``
 ==================  =======================================================
 
 Everything resolves lazily (PEP 562): importing this module never
@@ -64,6 +67,7 @@ __all__ = [
     "CompilerParams",
     "SHIMMED_SYMBOLS",
     "axis_size",
+    "cost_analysis",
     "pcast",
     "shard_map",
 ]
@@ -151,9 +155,48 @@ def _resolve_shard_map() -> Callable[..., Any]:
     return shard_map
 
 
+def _resolve_cost_analysis() -> Callable[..., Any]:
+    """HLO cost accounting (FLOPs / bytes accessed) for a jitted call.
+
+    Returns ``probe(jitted, args, kwargs) -> dict | None``: the call
+    is re-lowered against **abstract** arguments (``ShapeDtypeStruct``
+    per array leaf — the concrete buffers may already be donated and
+    deleted by the time the compile ledger probes), compiled, and the
+    compiled object's ``cost_analysis`` is read.  Newer jax returns a
+    flat dict (``{"flops": ..., "bytes accessed": ...}``), some 0.4.x
+    builds wrap it in a one-element list, and older builds lack the
+    method entirely — all three normalise here, with ``None`` meaning
+    "this jax cannot cost programs" (the ledger counts, never raises).
+    """
+    import jax
+
+    def _abstract(leaf):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return leaf
+
+    def cost_analysis(jitted, args, kwargs=None) -> Any:
+        kwargs = kwargs or {}
+        a_args, a_kwargs = jax.tree_util.tree_map(_abstract,
+                                                  (args, kwargs))
+        compiled = jitted.lower(*a_args, **a_kwargs).compile()
+        probe = getattr(compiled, "cost_analysis", None)
+        if probe is None:
+            return None
+        cost = probe()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        return dict(cost) if cost else None
+
+    return cost_analysis
+
+
 _RESOLVERS: Dict[str, Callable[[], Any]] = {
     "CompilerParams": _resolve_compiler_params,
     "axis_size": _resolve_axis_size,
+    "cost_analysis": _resolve_cost_analysis,
     "pcast": _resolve_pcast,
     "shard_map": _resolve_shard_map,
 }

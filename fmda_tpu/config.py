@@ -713,6 +713,14 @@ class SLOConfig:
     #: Degraded-feed objective: minutes per slow window any side feed
     #: may serve ghost rows before the alert fires.
     degraded_feed_budget_minutes: float = 5.0
+    #: Recompile objective: unexpected XLA recompiles after warmup are
+    #: judged as a raw count per window — a budget below 1 means a
+    #: single recompile burns past ``burn_threshold`` (zero is the
+    #: steady-state contract; fmda_tpu.obs.device).
+    recompile_budget: float = 0.5
+    #: Memory-leak objective: fraction of samples the device memory
+    #: monitor's monotonic-growth heuristic may be raised.
+    memory_leak_budget: float = 0.05
     #: Flight-recorder bundle directory; None disables postmortems.
     postmortem_dir: Optional[str] = None
     #: Rotated bundle count (oldest deleted past this).
@@ -743,6 +751,41 @@ class TracingConfig:
     #: Span-ring capacity; overflow evicts the oldest spans, so a
     #: long-running daemon keeps the newest traces and bounded memory.
     max_spans: int = 16384
+
+
+@dataclass(frozen=True)
+class ProfilingConfig:
+    """Device & compiler observability knobs (fmda_tpu.obs.device /
+    fmda_tpu.obs.pyprof; docs/observability.md "Device & compiler
+    telemetry").
+
+    The compile ledger itself is on by default everywhere — a tracked
+    jit call with the ledger enabled costs two cache-size reads and one
+    short lock window (``device_obs_overhead`` gates the whole plane
+    under 2% of the fleet hot loop).  ``cost_analysis`` re-lowers each
+    program once per compile to read FLOPs/bytes, so it is a
+    *deployment* default (serving hosts want MFU; unit tests do not
+    want doubled compile time — the module-level default is off and
+    ``configure_device_obs`` applies this section at serve time).
+    """
+
+    #: Master switch for the ledger + memory monitor.
+    enabled: bool = True
+    #: Probe ``.lower().compile().cost_analysis()`` per compile (via
+    #: fmda_tpu.compat) for per-program FLOPs / bytes-accessed → MFU.
+    cost_analysis: bool = True
+    #: Run the continuous host sampling profiler (``/profile``,
+    #: flight-recorder ``profile.folded``).
+    host_profiler: bool = False
+    #: Host-profiler sampling period (milliseconds).
+    profile_interval_ms: float = 10.0
+    #: Bounded distinct-stack table; overflow folds into ``<other>``.
+    profile_max_stacks: int = 4096
+    #: Device memory sampling cadence (seconds).
+    memory_interval_s: float = 5.0
+    #: Consecutive strictly-growing samples before the leak heuristic
+    #: raises ``device_memory_leak_suspected``.
+    memory_leak_window: int = 12
 
 
 @dataclass(frozen=True)
@@ -919,6 +962,7 @@ class FrameworkConfig:
         default_factory=ObservabilityConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    profiling: ProfilingConfig = field(default_factory=ProfilingConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
 
@@ -954,6 +998,7 @@ _SECTIONS = {
     "observability": ObservabilityConfig,
     "slo": SLOConfig,
     "tracing": TracingConfig,
+    "profiling": ProfilingConfig,
     "chaos": ChaosConfig,
     "control": ControlConfig,
 }

@@ -341,6 +341,7 @@ def _run_topology(
             router.session_tenant(sid) == tenants[sid] for sid in sids
             if sid in router.open_session_ids())
         counters = dict(router.metrics.counters)
+        worker_stats = dict(router.worker_stats())
         final_live = len(router.membership.live())
         decisions = list(plane.decisions) if plane is not None else []
     finally:
@@ -359,6 +360,7 @@ def _run_topology(
         "unexpected_results": unexpected,
         "seq_reused": [],  # no takeover path: wire seqs never reused
         "counters": counters,
+        "worker_stats": worker_stats,
         "tainted": sorted(tainted),
         "tenant_intact": tenant_intact,
         "target_p99_ms": target_p99_ms,
@@ -376,10 +378,17 @@ def _gate_report(run: dict, min_workers: int) -> dict:
     unaccounted = n_submitted - n_served - losses
     post_quiet = [s for s, n in run["post_served"].items() if n == 0]
     actions = [d["action"] for d in run["decisions"]]
+    # elastic scaling must never pay a compile mid-traffic: migrated-in
+    # sessions land on already-traced buckets, so every worker's
+    # post-warmup recompile count stays zero (ISSUE 17 ledger contract)
+    recompiles = sum(
+        int(s.get("recompiles_after_warmup", 0) or 0)
+        for s in run["worker_stats"].values())
     gates = {
         "exit_ok": True,  # reaching here at all is gate zero
         "unaccounted_zero": unaccounted == 0,
         "no_unexpected_results": run["unexpected_results"] == 0,
+        "no_recompiles_after_warmup": recompiles == 0,
         "scaled_up": ("scale_up" in actions
                       and run["max_live"] > min_workers),
         "scaled_down": ("scale_down" in actions
@@ -403,5 +412,6 @@ def _gate_report(run: dict, min_workers: int) -> dict:
         "decisions": run["decisions"],
         "post_scale_quiet_sessions": post_quiet,
         "submit_failures": run["submit_failures"],
+        "recompiles_after_warmup": recompiles,
         "gates": gates,
     }

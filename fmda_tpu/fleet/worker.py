@@ -171,6 +171,23 @@ class FleetWorker:
                 self.pool.step(
                     np.full(b, self.pool.padding_slot, np.int32),
                     np.zeros((b, feats), np.float32))
+            # warmup is over: any further compile is an *unexpected
+            # recompile* — counted by the compile ledger, evented, and
+            # SLO-alertable; the chaos/elastic soaks hard-gate zero
+            # (fmda_tpu.obs.device)
+            self.pool.mark_warm()
+        # device memory attribution: this pool's live tree, sampled on
+        # the worker loop at the monitor's cadence (one clock read per
+        # step when not due)
+        from fmda_tpu.obs.device import (
+            default_ledger,
+            default_memory_monitor,
+        )
+
+        self._ledger = default_ledger()
+        self._memory = default_memory_monitor()
+        self._memory.register_owner(
+            f"session_pool:{worker_id}", self.pool.live_tree)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -247,6 +264,17 @@ class FleetWorker:
             "inbox_records_lost": c.get("inbox_records_lost", 0),
             "compile_count": self.pool.compile_count,
             "queue_depth": len(self.gateway.batcher),
+            # device/compiler telemetry (fmda_tpu.obs.device): the beat
+            # carries the recompile + memory truth so the router-side
+            # SLO engine can alert fleet-wide without scraping
+            "recompiles_after_warmup": self.pool.recompiles_after_warmup,
+            "compile_seconds": round(
+                self._ledger.compile_seconds_total, 6),
+            "live_bytes": self._memory.live_bytes,
+            "memory_watermark_bytes": self._memory.watermark_bytes,
+            "memory_leak_suspected": (
+                1 if self._memory.leak_suspected else 0),
+            "device_mfu": self._ledger.mfu(),
         }
         # per-class admit/shed attribution (fmda_tpu.control QoS): the
         # gateway counts these in this process; the beat carries them so
@@ -271,6 +299,8 @@ class FleetWorker:
         # beat first: a long pump last cycle must not push two beats
         # more than one step duration apart
         self._beat_counted()
+        # device memory cadence: one clock read per step when not due
+        self._memory.maybe_sample()
         if self._failed_drains and not self._control_down:
             self._retry_failed_drains()
         processed = 0

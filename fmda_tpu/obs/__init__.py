@@ -14,6 +14,12 @@ scrape).  The pieces:
   ``/metrics``, ``/healthz``, ``/snapshot``, ``/events``, ``/trace``;
 - :mod:`~fmda_tpu.obs.trace`        — end-to-end tick tracing
   (:class:`Tracer`, in-band bus trace context, Perfetto export);
+- :mod:`~fmda_tpu.obs.device`       — device/compiler telemetry: the
+  :func:`tracked_jit` compile ledger (per-program compiles, FLOPs,
+  unexpected-recompile detection), MFU/roofline gauges, and the
+  :class:`DeviceMemoryMonitor` watermark/leak sampler;
+- :mod:`~fmda_tpu.obs.pyprof`       — continuous host sampling profiler
+  (folded stacks at ``/profile``, flight-recorder bundles);
 - :mod:`~fmda_tpu.obs.observability` — the :class:`Observability` handle
   an :class:`~fmda_tpu.app.Application` owns (collectors + health checks
   + endpoint lifecycle).
@@ -22,6 +28,15 @@ Architecture and metric vocabulary: docs/observability.md.
 """
 
 from fmda_tpu.obs.aggregate import FleetAggregator, FleetTelemetry
+from fmda_tpu.obs.device import (
+    CompileLedger,
+    DeviceMemoryMonitor,
+    TrackedFunction,
+    default_ledger,
+    default_memory_monitor,
+    device_report,
+    tracked_jit,
+)
 from fmda_tpu.obs.events import EventLog
 from fmda_tpu.obs.observability import (
     Observability,
@@ -31,6 +46,7 @@ from fmda_tpu.obs.observability import (
     stage_timer_families,
 )
 from fmda_tpu.obs.prometheus import render_prometheus
+from fmda_tpu.obs.pyprof import HostProfiler, default_profiler
 from fmda_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -52,12 +68,15 @@ from fmda_tpu.obs.trace import (
 from fmda_tpu.obs.tsdb import TimeSeriesStore
 
 __all__ = [
+    "CompileLedger",
     "Counter",
+    "DeviceMemoryMonitor",
     "EventLog",
     "FleetAggregator",
     "FleetTelemetry",
     "FlightRecorder",
     "Gauge",
+    "HostProfiler",
     "LatencyHistogram",
     "MetricsRegistry",
     "MetricsServer",
@@ -66,10 +85,15 @@ __all__ = [
     "Span",
     "TimeSeriesStore",
     "TraceRef",
+    "TrackedFunction",
     "Tracer",
     "configure_tracing",
+    "default_ledger",
+    "default_memory_monitor",
+    "default_profiler",
     "default_registry",
     "default_tracer",
+    "device_report",
     "engine_families",
     "journal_families",
     "render_prometheus",

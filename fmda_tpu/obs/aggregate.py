@@ -80,6 +80,16 @@ WORKER_STAT_SERIES = {
     "active_sessions": ("worker_sessions", "gauge"),
     "inbox_records_lost": ("worker_inbox_records_lost_total", "counter"),
     "shed_oldest": ("worker_shed_oldest_total", "counter"),
+    # device/compiler telemetry (fmda_tpu.obs.device) — the recompile
+    # counter feeds the [slo] `recompile` objective, the leak gauge the
+    # `memory_leak` objective (fmda_tpu.obs.slo SERIES_RECOMPILES /
+    # SERIES_LEAK name these two; keep them in sync)
+    "recompiles_after_warmup": ("worker_recompiles_total", "counter"),
+    "compile_seconds": ("worker_compile_seconds_total", "counter"),
+    "live_bytes": ("worker_live_bytes", "gauge"),
+    "memory_watermark_bytes": ("worker_memory_watermark_bytes", "gauge"),
+    "memory_leak_suspected": ("worker_memory_leak_suspected", "gauge"),
+    "device_mfu": ("worker_device_mfu", "gauge"),
 }
 
 
@@ -242,6 +252,9 @@ class FleetTelemetry:
             from fmda_tpu.obs.recorder import FlightRecorder
             from fmda_tpu.obs.trace import default_tracer
 
+            from fmda_tpu.obs.device import device_report
+            from fmda_tpu.obs.pyprof import default_profiler
+
             self.recorder = FlightRecorder(
                 self.cfg.postmortem_dir,
                 keep=self.cfg.postmortem_keep,
@@ -253,6 +266,11 @@ class FleetTelemetry:
                 tracer=default_tracer(),
                 snapshot_fn=self._registry_snapshot,
                 workers_fn=self._workers_doc,
+                # an SLO breach freezes where the host was (folded
+                # stacks) and what the device side looked like (compile
+                # ledger + memory watermarks) alongside traces/tsdb
+                profile_fn=lambda: default_profiler().folded(),
+                device_fn=device_report,
             )
         self.slo = SLOEngine(
             self.cfg, self.store, events=self.events, clock=clock,
@@ -493,8 +511,10 @@ class FleetTelemetry:
     def start_server(self, *, host: str = "127.0.0.1", port: int = 0):
         """A MetricsServer over this telemetry: ``/metrics``,
         ``/healthz`` (SLO-aware), ``/snapshot``, ``/events``, ``/trace``
-        plus the range endpoints ``/query``, ``/alerts``, and
-        ``/control``."""
+        plus the range endpoints ``/query``, ``/alerts``,
+        ``/control``, ``/profile``, and ``/device``."""
+        from fmda_tpu.obs.device import device_report
+        from fmda_tpu.obs.pyprof import default_profiler
         from fmda_tpu.obs.server import MetricsServer
         from fmda_tpu.obs.trace import default_tracer
 
@@ -511,4 +531,6 @@ class FleetTelemetry:
             query_fn=self.query,
             alerts_fn=self.alerts,
             control_fn=self.control,
+            profile_fn=lambda: default_profiler().folded(),
+            device_fn=device_report,
         ).start()

@@ -1,0 +1,826 @@
+"""Device & compiler observability: compile ledger, cost/MFU, memory.
+
+Every observability layer so far watches the *host* side of serving;
+what XLA actually compiled, what each program costs, and what the
+device is holding in memory were invisible.  This module closes that
+gap with three host-resident instruments, all jax-free at import time
+(jax is imported lazily, only on the paths that need a live runtime —
+the module must be importable on router-role analysis hosts):
+
+- :func:`tracked_jit` / :class:`TrackedFunction` — a drop-in wrapper
+  over ``jax.jit`` that the hot jit sites (``SessionPool`` step,
+  ``PredictorPool`` forward/gather) route through.  It detects each
+  compile by watching the underlying jit cache size (the same private
+  ``_cache_size`` probe the pools already used for their
+  ``compile_count``, with a distinct-signature fallback), stamps it
+  with a wall-clock duration and an abstract shape signature, and —
+  once :meth:`TrackedFunction.mark_warm` has been called (after the
+  precompile loop) — counts any further compile as an **unexpected
+  recompile**: the recompile-storm failure mode promoted to a counted,
+  alertable property (``[slo]`` ``recompile`` objective; the chaos and
+  elastic soaks hard-gate ``recompiles_after_warmup == 0``).
+- :class:`CompileLedger` — the process-wide record of every tracked
+  program: compiles, calls, compile seconds, and (where the installed
+  jax supports ``cost_analysis``, probed through
+  :mod:`fmda_tpu.compat`) per-program FLOPs/bytes-accessed.  Scrape
+  time derives ``device_mfu`` / arithmetic-intensity gauges against a
+  per-backend peak table — estimated peaks on CPU/interpret hosts so
+  tier-1 exercises the whole path, real peaks when a TPU appears.
+- :class:`DeviceMemoryMonitor` — a cadence-gated sampler over
+  ``jax.live_arrays()`` (plus ``device.memory_stats()`` where the
+  backend exposes it) with per-owner attribution (pools register a
+  param/state tree callback), high-watermark tracking, and a
+  monotonic-growth leak heuristic exported as a gauge the SLO engine
+  alerts on.
+
+Cost discipline: a :class:`TrackedFunction` whose ledger is disabled
+is one attribute check + the underlying jit call — no allocation, no
+lock.  The enabled steady-state path (no compile) is two cache-size
+reads and one small lock window; the ``device_obs_overhead`` bench
+phase holds the whole plane (ledger + host profiler) under 2% of the
+fleet hot loop.  ``cost_analysis`` probing re-lowers the program once
+per compile, so it defaults OFF at module level and ON in
+``[profiling]`` config (serving hosts want the numbers; unit tests do
+not want doubled compile time).
+
+The ledger dump (:meth:`CompileLedger.dump`) has a pinned schema
+(``LEDGER_SCHEMA`` / ``PROGRAM_SCHEMA``, ``LEDGER_SCHEMA_VERSION``)
+— it is a bench artifact and a flight-recorder bundle member, so its
+keys are load-bearing for tooling and asserted in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: bump when LEDGER_SCHEMA / PROGRAM_SCHEMA change shape
+LEDGER_SCHEMA_VERSION = 1
+
+#: exact key set of CompileLedger.dump() (pinned; bench artifact)
+LEDGER_SCHEMA = (
+    "schema_version", "backend", "compiles_total",
+    "compile_seconds_total", "unexpected_recompiles_total",
+    "cost_probe_failures", "programs",
+)
+
+#: exact key set of each dump()["programs"] entry (pinned)
+PROGRAM_SCHEMA = (
+    "program", "signature", "compiles", "calls", "compile_seconds",
+    "unexpected", "flops", "bytes_accessed",
+)
+
+#: per-backend peak FLOP/s for MFU accounting.  TPU/GPU entries are
+#: representative datasheet numbers (TPU v5e bf16; A100 bf16); the
+#: cpu/interpreter entries are deliberate *estimates* so the whole MFU
+#: path runs (and is tested) on CPU containers — the absolute value is
+#: wrong there and documented as such, the plumbing is what tier-1
+#: exercises.
+PEAK_FLOPS: Dict[str, float] = {
+    "tpu": 197e12,
+    "gpu": 312e12,
+    "cpu": 5e10,
+    "interpreter": 1e9,
+}
+
+#: per-backend peak memory bandwidth (bytes/s) for roofline position
+PEAK_BYTES_PER_S: Dict[str, float] = {
+    "tpu": 819e9,
+    "gpu": 2039e9,
+    "cpu": 2e10,
+    "interpreter": 1e9,
+}
+
+
+def _log():
+    import logging
+
+    return logging.getLogger("fmda_tpu.obs")
+
+
+def _leaf_signature(args: tuple, kwargs: dict) -> Tuple:
+    """Abstract shape signature of a call: ``(shape, dtype)`` per
+    array-like leaf (non-arrays fold in by repr of type + value where
+    hashable).  Only computed on compile events / fallback counting —
+    never on the per-call hot path when a cheap ``signature_of`` is
+    supplied by the call site."""
+    import jax
+
+    sig = []
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append((tuple(shape), str(dtype)))
+        else:
+            try:
+                hash(leaf)
+                sig.append(("py", repr(leaf)))
+            except TypeError:  # noqa: BLE001 — loss-free: an unhashable
+                # static arg still signs by type; nothing is dropped
+                sig.append(("py", type(leaf).__name__))
+    return tuple(sig)
+
+
+class ProgramRecord:
+    """Per-(program, signature) accounting inside a TrackedFunction."""
+
+    __slots__ = ("signature", "compiles", "calls", "compile_s",
+                 "unexpected", "flops", "bytes_accessed")
+
+    def __init__(self, signature: object) -> None:
+        self.signature = signature
+        self.compiles = 0
+        self.calls = 0
+        self.compile_s = 0.0
+        self.unexpected = 0
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+
+
+class TrackedFunction:
+    """A jitted callable with compile accounting.
+
+    Compile detection reads the underlying jit's private
+    ``_cache_size`` hook before and after each call; a growth is a
+    compile, attributed to this call's signature.  Under concurrent
+    callers the *sum of observed deltas* equals the final cache size
+    (each delta is claimed under the lock), so totals stay consistent
+    — the thread-safety test pins exactly that.  On jax builds
+    without the hook, distinct-signature counting is the fallback
+    (the same degradation the pools' ``compile_count`` always had).
+
+    The recorded "compile seconds" are first-call wall time (trace +
+    compile + first execution) — the operationally useful number for
+    a serving host deciding whether precompile covered its buckets.
+    """
+
+    def __init__(
+        self,
+        jitted,
+        *,
+        name: str,
+        ledger: "CompileLedger",
+        signature_of: Optional[Callable[..., object]] = None,
+    ) -> None:
+        self.name = name
+        self.ledger = ledger
+        self._jit = jitted
+        self._signature_of = signature_of
+        self._lock = threading.Lock()
+        self._records: Dict[object, ProgramRecord] = {}
+        self._seen_cache_size = 0
+        self._fallback_sigs: set = set()
+        self._warm = False
+        self._unexpected = 0
+
+    # -- cache probe ---------------------------------------------------------
+
+    def _raw_cache_size(self) -> Optional[int]:
+        probe = getattr(self._jit, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 — loss-free: a private-API
+            # probe failing on some jax build must degrade to the
+            # fallback counter, never break serving
+            return None
+
+    def cache_size(self) -> Optional[int]:
+        """Compiled-program count from the jit cache, or None when the
+        installed jax lacks the probe (callers fall back to their own
+        distinct-shape counting, as the pools always did)."""
+        return self._raw_cache_size()
+
+    def _absorb_cache_size(self) -> None:
+        """Fold the current cache size into the seen watermark without
+        recording a compile — the cost probe's re-lower can grow the
+        cache, and that growth must not read as a phantom compile."""
+        raw = self._raw_cache_size()
+        if raw is None:
+            return
+        with self._lock:
+            if raw > self._seen_cache_size:
+                self._seen_cache_size = raw
+
+    # -- warmup --------------------------------------------------------------
+
+    def mark_warm(self) -> None:
+        """Declare warmup over: every compile from here on is
+        *unexpected* (counted, evented, SLO-alertable)."""
+        with self._lock:
+            self._warm = True
+
+    @property
+    def warm(self) -> bool:
+        with self._lock:
+            return self._warm
+
+    @property
+    def unexpected_recompiles(self) -> int:
+        with self._lock:
+            return self._unexpected
+
+    # -- the call path -------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        ledger = self.ledger
+        if not ledger.enabled:
+            return self._jit(*args, **kwargs)
+        sig = (self._signature_of(*args, **kwargs)
+               if self._signature_of is not None else None)
+        with self._lock:
+            before = self._seen_cache_size
+        t0 = time.perf_counter()
+        out = self._jit(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        after = self._raw_cache_size()
+        compiled = False
+        unexpected = False
+        with self._lock:
+            if after is not None:
+                if after > self._seen_cache_size:
+                    compiled = True
+                    self._seen_cache_size = after
+            else:
+                key = sig if sig is not None \
+                    else _leaf_signature(args, kwargs)
+                if key not in self._fallback_sigs:
+                    self._fallback_sigs.add(key)
+                    compiled = True
+            if compiled and sig is None:
+                sig = _leaf_signature(args, kwargs)
+            rec = None
+            if sig is not None:
+                rec = self._records.get(sig)
+                if rec is None:
+                    rec = self._records[sig] = ProgramRecord(sig)
+                rec.calls += 1
+            if compiled:
+                unexpected = self._warm
+                if unexpected:
+                    self._unexpected += 1
+                if rec is not None:
+                    rec.compiles += 1
+                    rec.compile_s += dt
+                    if unexpected:
+                        rec.unexpected += 1
+        if compiled:
+            ledger._on_compile(self, sig, dt, unexpected, args, kwargs,
+                               cache_size_before=before)
+        return out
+
+    # -- export --------------------------------------------------------------
+
+    def snapshot(self) -> List[Dict[str, object]]:
+        """Per-signature program records (PROGRAM_SCHEMA keys)."""
+        with self._lock:
+            records = list(self._records.items())
+        out = []
+        for sig, rec in records:
+            out.append({
+                "program": self.name,
+                "signature": repr(sig),
+                "compiles": rec.compiles,
+                "calls": rec.calls,
+                "compile_seconds": round(rec.compile_s, 6),
+                "unexpected": rec.unexpected,
+                "flops": rec.flops,
+                "bytes_accessed": rec.bytes_accessed,
+            })
+        return out
+
+    def _totals(self) -> Tuple[int, float, int, float, float]:
+        """(compiles, compile_s, unexpected, flops_done, bytes_done)."""
+        with self._lock:
+            records = list(self._records.values())
+            unexpected = self._unexpected
+        compiles = sum(r.compiles for r in records)
+        compile_s = sum(r.compile_s for r in records)
+        flops_done = sum(r.calls * r.flops for r in records)
+        bytes_done = sum(r.calls * r.bytes_accessed for r in records)
+        return compiles, compile_s, unexpected, flops_done, bytes_done
+
+
+class CompileLedger:
+    """Process-wide compile/cost accounting over tracked functions.
+
+    Thread-safe; zero-cost when ``enabled`` is False (tracked calls
+    skip straight to the jit).  ``events`` is an optional
+    :class:`fmda_tpu.obs.events.EventLog` attached by the
+    Observability plane (latest instance wins, the chaos-hook
+    discipline)."""
+
+    def __init__(self, *, enabled: bool = True,
+                 cost_analysis: bool = False) -> None:
+        self.enabled = enabled
+        self.cost_analysis = cost_analysis
+        self.events = None
+        self._lock = threading.Lock()
+        self._functions: List[TrackedFunction] = []
+        self._backend: Optional[str] = None
+        self._cost_probe_failures = 0
+        self._mfu_prev: Optional[Tuple[float, float, float]] = None
+        self._mfu = 0.0
+        self._intensity = 0.0
+
+    # -- registration --------------------------------------------------------
+
+    def track(self, fn: TrackedFunction) -> None:
+        with self._lock:
+            self._functions.append(fn)
+
+    def functions(self) -> List[TrackedFunction]:
+        with self._lock:
+            return list(self._functions)
+
+    def mark_warm(self) -> None:
+        for fn in self.functions():
+            fn.mark_warm()
+
+    def reset(self) -> None:
+        """Drop every tracked function and derived state (test
+        isolation only — live pools keep their own references)."""
+        with self._lock:
+            self._functions = []
+            self._backend = None
+            self._cost_probe_failures = 0
+            self._mfu_prev = None
+            self._mfu = 0.0
+            self._intensity = 0.0
+
+    # -- compile events ------------------------------------------------------
+
+    def backend(self) -> str:
+        with self._lock:
+            if self._backend is not None:
+                return self._backend
+        name = "unknown"
+        try:
+            import jax
+
+            name = str(jax.default_backend())
+        except Exception:  # noqa: BLE001 — loss-free: a jax-free or
+            # broken-runtime host still gets a ledger, just without a
+            # backend name (MFU reads 0 against the estimated peak)
+            pass
+        with self._lock:
+            self._backend = name
+        return name
+
+    def _on_compile(self, fn: TrackedFunction, sig: object, dt: float,
+                    unexpected: bool, args: tuple, kwargs: dict, *,
+                    cache_size_before: int) -> None:
+        backend = self.backend()
+        if self.cost_analysis:
+            self._probe_cost(fn, sig, args, kwargs)
+        events = self.events
+        if events is not None:
+            events.emit(
+                "device.compile",
+                program=fn.name,
+                signature=repr(sig),
+                compile_s=round(dt, 6),
+                backend=backend,
+                unexpected=bool(unexpected),
+                cache_size_before=cache_size_before,
+            )
+            if unexpected:
+                events.emit(
+                    "device.unexpected_recompile",
+                    program=fn.name,
+                    signature=repr(sig),
+                    backend=backend,
+                )
+
+    def _probe_cost(self, fn: TrackedFunction, sig: object,
+                    args: tuple, kwargs: dict) -> None:
+        try:
+            from fmda_tpu import compat
+
+            cost = compat.cost_analysis(fn._jit, args, kwargs)
+        except Exception:  # noqa: BLE001 — loss-free: the probe is
+            # best-effort telemetry over private-ish jax surface; a
+            # failure is counted below, never raised into serving
+            cost = None
+        if cost is None:
+            with self._lock:
+                self._cost_probe_failures += 1
+            return
+        flops = float(cost.get("flops", 0.0) or 0.0)
+        nbytes = float(cost.get("bytes accessed", 0.0) or 0.0)
+        with fn._lock:
+            rec = fn._records.get(sig)
+            if rec is not None:
+                rec.flops = flops
+                rec.bytes_accessed = nbytes
+        # the re-lower can grow the jit cache; absorb so the next call
+        # does not read it as a phantom compile
+        fn._absorb_cache_size()
+
+    # -- derived totals ------------------------------------------------------
+
+    @property
+    def recompiles_after_warmup(self) -> int:
+        return sum(f.unexpected_recompiles for f in self.functions())
+
+    @property
+    def compiles_total(self) -> int:
+        return sum(f._totals()[0] for f in self.functions())
+
+    @property
+    def compile_seconds_total(self) -> float:
+        return sum(f._totals()[1] for f in self.functions())
+
+    def flops_done(self) -> float:
+        return sum(f._totals()[3] for f in self.functions())
+
+    def mfu(self) -> float:
+        """Last scrape-interval MFU (0.0 until two scrapes land)."""
+        with self._lock:
+            return self._mfu
+
+    # -- export --------------------------------------------------------------
+
+    def dump(self) -> Dict[str, object]:
+        """The pinned-schema ledger document (LEDGER_SCHEMA keys;
+        bench artifact + flight-recorder bundle member)."""
+        functions = self.functions()
+        programs: List[Dict[str, object]] = []
+        for fn in functions:
+            programs.extend(fn.snapshot())
+        programs.sort(key=lambda p: (p["program"], p["signature"]))
+        compiles = sum(p["compiles"] for p in programs)
+        compile_s = sum(p["compile_seconds"] for p in programs)
+        unexpected = sum(f.unexpected_recompiles for f in functions)
+        with self._lock:
+            failures = self._cost_probe_failures
+            backend = self._backend
+        return {
+            "schema_version": LEDGER_SCHEMA_VERSION,
+            "backend": backend,
+            "compiles_total": compiles,
+            "compile_seconds_total": round(compile_s, 6),
+            "unexpected_recompiles_total": unexpected,
+            "cost_probe_failures": failures,
+            "programs": programs,
+        }
+
+    def families(self) -> Dict[str, List[Dict[str, object]]]:
+        """Scrape-time collector (registry snapshot shape): compile
+        counters per program, cost gauges, and the MFU/intensity
+        roofline position derived from inter-scrape FLOP deltas."""
+        counters: List[Dict[str, object]] = []
+        gauges: List[Dict[str, object]] = []
+        flops_done = 0.0
+        bytes_done = 0.0
+        # aggregate by program name: several pools in one process (a
+        # multi-worker soak) can track same-named programs, and the
+        # exposition must stay one sample per label set
+        by_program: Dict[str, List[float]] = {}
+        for fn in self.functions():
+            compiles, compile_s, unexpected, f_done, b_done = fn._totals()
+            flops_done += f_done
+            bytes_done += b_done
+            size = fn.cache_size()
+            cached = float(len(fn.snapshot()) if size is None else size)
+            acc = by_program.setdefault(fn.name, [0.0, 0.0, 0.0, 0.0])
+            acc[0] += compiles
+            acc[1] += compile_s
+            acc[2] += unexpected
+            acc[3] += cached
+        for name, (compiles, compile_s, unexpected, cached) \
+                in sorted(by_program.items()):
+            counters.append({
+                "name": "compile_total",
+                "labels": {"program": name},
+                "value": int(compiles),
+            })
+            counters.append({
+                "name": "compile_seconds_total",
+                "labels": {"program": name},
+                "value": compile_s,
+            })
+            counters.append({
+                "name": "compile_unexpected_total",
+                "labels": {"program": name},
+                "value": int(unexpected),
+            })
+            gauges.append({
+                "name": "compile_cached_programs",
+                "labels": {"program": name},
+                "value": cached,
+            })
+        with self._lock:
+            counters.append({
+                "name": "compile_cost_probe_failures_total",
+                "labels": {},
+                "value": self._cost_probe_failures,
+            })
+        backend = self.backend()
+        peak = PEAK_FLOPS.get(backend, PEAK_FLOPS["cpu"])
+        now = time.monotonic()
+        with self._lock:
+            prev = self._mfu_prev
+            self._mfu_prev = (now, flops_done, bytes_done)
+            if prev is not None and now > prev[0]:
+                elapsed = now - prev[0]
+                d_flops = max(0.0, flops_done - prev[1])
+                d_bytes = max(0.0, bytes_done - prev[2])
+                self._mfu = d_flops / elapsed / peak
+                self._intensity = (d_flops / d_bytes) if d_bytes else 0.0
+            mfu, intensity = self._mfu, self._intensity
+        gauges.append({
+            "name": "device_mfu",
+            "labels": {"backend": backend},
+            "value": mfu,
+        })
+        gauges.append({
+            "name": "device_arithmetic_intensity",
+            "labels": {"backend": backend},
+            "value": intensity,
+        })
+        # the cell-seam kernel-fallback counters (ops/dispatch) join
+        # the device vocabulary here: no family silently serves the
+        # reference path without a scrape noticing
+        try:
+            from fmda_tpu.ops.dispatch import kernel_fallbacks
+
+            for key, n in sorted(kernel_fallbacks().items()):
+                cell, _, reason = key.partition(":")
+                counters.append({
+                    "name": "device_kernel_fallback_total",
+                    "labels": {"cell": cell, "reason": reason},
+                    "value": n,
+                })
+        except Exception:  # noqa: BLE001 — loss-free: the dispatch
+            # seam is optional telemetry; a broken import must not
+            # take the scrape down
+            _log().warning("kernel-fallback scrape failed", exc_info=True)
+        return {"counters": counters, "gauges": gauges}
+
+
+class DeviceMemoryMonitor:
+    """Cadence-gated device/live-array memory sampler.
+
+    Owners (pools) register a callback returning their live pytree;
+    each sample attributes leaf ``nbytes`` by owner, sums the whole
+    process's ``jax.live_arrays()``, folds in the backend's
+    ``memory_stats()`` where exposed, tracks the high watermark, and
+    runs a monotonic-growth leak heuristic: ``leak_window``
+    consecutive samples each strictly above the last → suspected leak
+    (a gauge the SLO engine alerts on).  ``maybe_sample`` costs one
+    clock read when not due — safe to call per hot-loop step."""
+
+    def __init__(self, *, interval_s: float = 5.0,
+                 leak_window: int = 12, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self.interval_s = interval_s
+        self.leak_window = max(3, int(leak_window))
+        self._lock = threading.Lock()
+        self._owners: Dict[str, Callable[[], object]] = {}
+        self._next_due = 0.0
+        self._by_owner: Dict[str, float] = {}
+        self._live_bytes = 0.0
+        self._device_bytes = 0.0
+        self._watermark = 0.0
+        self._history: deque = deque(maxlen=self.leak_window)
+        self._leak = False
+        self._samples = 0
+
+    def register_owner(self, name: str,
+                       tree_fn: Callable[[], object]) -> None:
+        """Attach an owner's live-tree callback (same-name
+        re-registration replaces — pools rebuild across migrations)."""
+        with self._lock:
+            self._owners[name] = tree_fn
+
+    def maybe_sample(self, now: Optional[float] = None) -> bool:
+        """Sample if the cadence is due.  Returns True when a sample
+        was taken."""
+        if not self.enabled:
+            return False
+        if now is None:
+            now = time.monotonic()
+        if now < self._next_due:
+            return False
+        self._next_due = now + self.interval_s
+        self.sample()
+        return True
+
+    @staticmethod
+    def _tree_bytes(tree: object) -> float:
+        import jax
+
+        total = 0.0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            total += float(getattr(leaf, "nbytes", 0) or 0)
+        return total
+
+    def sample(self) -> Dict[str, object]:
+        """Take one sample now (cadence ignored)."""
+        live = 0.0
+        device_bytes = 0.0
+        by_owner: Dict[str, float] = {}
+        with self._lock:
+            owners = dict(self._owners)
+        try:
+            import jax
+
+            live = sum(float(getattr(a, "nbytes", 0) or 0)
+                       for a in jax.live_arrays())
+            for name, tree_fn in owners.items():
+                try:
+                    by_owner[name] = self._tree_bytes(tree_fn())
+                except Exception:  # noqa: BLE001 — loss-free: a
+                    # mid-teardown owner (migrating pool) reads as
+                    # zero for one sample, never breaks the monitor
+                    by_owner[name] = 0.0
+            try:
+                stats = jax.local_devices()[0].memory_stats()
+                if stats:
+                    device_bytes = float(stats.get("bytes_in_use", 0.0))
+            except Exception:  # noqa: BLE001 — loss-free: CPU/older
+                # backends expose no memory_stats; live_arrays is the
+                # signal there
+                device_bytes = 0.0
+        except Exception:  # noqa: BLE001 — loss-free: a jax-free host
+            # keeps an (empty) monitor rather than crashing telemetry
+            pass
+        with self._lock:
+            self._live_bytes = live
+            self._device_bytes = device_bytes
+            self._by_owner = by_owner
+            basis = max(live, device_bytes)
+            if basis > self._watermark:
+                self._watermark = basis
+            self._history.append(basis)
+            self._leak = (
+                len(self._history) == self.leak_window
+                and all(b > a for a, b in zip(self._history,
+                                              list(self._history)[1:]))
+            )
+            self._samples += 1
+            return self.doc_locked()
+
+    # -- export --------------------------------------------------------------
+
+    def doc_locked(self) -> Dict[str, object]:
+        return {
+            "live_bytes": self._live_bytes,
+            "device_bytes_in_use": self._device_bytes,
+            "by_owner": dict(self._by_owner),
+            "watermark_bytes": self._watermark,
+            "leak_suspected": self._leak,
+            "samples": self._samples,
+            "leak_window": self.leak_window,
+        }
+
+    def doc(self) -> Dict[str, object]:
+        with self._lock:
+            return self.doc_locked()
+
+    @property
+    def watermark_bytes(self) -> float:
+        with self._lock:
+            return self._watermark
+
+    @property
+    def live_bytes(self) -> float:
+        with self._lock:
+            return self._live_bytes
+
+    @property
+    def leak_suspected(self) -> bool:
+        with self._lock:
+            return self._leak
+
+    def families(self) -> Dict[str, List[Dict[str, object]]]:
+        with self._lock:
+            by_owner = dict(self._by_owner)
+            live = self._live_bytes
+            watermark = self._watermark
+            leak = self._leak
+            samples = self._samples
+        gauges = [{
+            "name": "device_live_bytes",
+            "labels": {"owner": "process"},
+            "value": live,
+        }]
+        for name, nbytes in sorted(by_owner.items()):
+            gauges.append({
+                "name": "device_live_bytes",
+                "labels": {"owner": name},
+                "value": nbytes,
+            })
+        gauges.append({
+            "name": "device_memory_watermark_bytes",
+            "labels": {},
+            "value": watermark,
+        })
+        gauges.append({
+            "name": "device_memory_leak_suspected",
+            "labels": {},
+            "value": 1.0 if leak else 0.0,
+        })
+        counters = [{
+            "name": "device_memory_samples_total",
+            "labels": {},
+            "value": samples,
+        }]
+        return {"counters": counters, "gauges": gauges}
+
+
+# -- the factory --------------------------------------------------------------
+
+
+def tracked_jit(fn, *, name: str,
+                ledger: Optional[CompileLedger] = None,
+                signature_of: Optional[Callable[..., object]] = None,
+                **jit_kwargs) -> TrackedFunction:
+    """``jax.jit`` with compile accounting: the tracked-jit seam every
+    hot jit site in ``runtime/`` routes through (enforced by the
+    ``tracked-jit`` lint rule).
+
+    ``signature_of(*args, **kwargs)`` is the cheap per-call program
+    signature (the pools pass the padded batch size); without it the
+    signature is derived from leaf shapes, but only on compile events
+    — the steady-state path never tree-flattens.  ``jit_kwargs`` pass
+    straight through (``donate_argnums``, shardings, ...)."""
+    import jax
+
+    if ledger is None:
+        ledger = default_ledger()
+    tracked = TrackedFunction(
+        jax.jit(fn, **jit_kwargs),
+        name=name, ledger=ledger, signature_of=signature_of)
+    ledger.track(tracked)
+    return tracked
+
+
+# -- process defaults + config ------------------------------------------------
+
+_DEFAULT_LEDGER = CompileLedger(enabled=True, cost_analysis=False)
+_DEFAULT_MEMORY = DeviceMemoryMonitor()
+
+
+def default_ledger() -> CompileLedger:
+    return _DEFAULT_LEDGER
+
+
+def default_memory_monitor() -> DeviceMemoryMonitor:
+    return _DEFAULT_MEMORY
+
+
+def configure_device_obs(cfg) -> None:
+    """Apply a ``ProfilingConfig`` to the process defaults (serve-time
+    entry points call this before building pools)."""
+    led = default_ledger()
+    led.enabled = bool(cfg.enabled)
+    led.cost_analysis = bool(cfg.cost_analysis)
+    mon = default_memory_monitor()
+    mon.enabled = bool(cfg.enabled)
+    mon.interval_s = float(cfg.memory_interval_s)
+    window = max(3, int(cfg.memory_leak_window))
+    if window != mon.leak_window:
+        mon.leak_window = window
+        mon._history = deque(mon._history, maxlen=window)
+    # the host profiler is a serve-time opt-in: daemons that set
+    # [profiling] host_profiler get the continuous sampler; everything
+    # else keeps the profiler importable-but-idle (tests drive
+    # sample_once directly)
+    from fmda_tpu.obs.pyprof import default_profiler
+
+    prof = default_profiler()
+    prof.interval_ms = float(cfg.profile_interval_ms)
+    prof.max_stacks = int(cfg.profile_max_stacks)
+    if cfg.enabled and cfg.host_profiler:
+        prof.start()
+    elif prof.running:
+        prof.stop()
+
+
+def device_report(*, ledger: Optional[CompileLedger] = None,
+                  memory: Optional[DeviceMemoryMonitor] = None
+                  ) -> Dict[str, object]:
+    """The ``/device`` endpoint / flight-recorder ``device.json``
+    document: ledger dump + memory doc + raw kernel-fallback map."""
+    ledger = ledger if ledger is not None else default_ledger()
+    memory = memory if memory is not None else default_memory_monitor()
+    try:
+        from fmda_tpu.ops.dispatch import kernel_fallbacks
+
+        fallbacks = kernel_fallbacks()
+    except Exception:  # noqa: BLE001 — loss-free: optional seam, see
+        # families(); an import failure reads as an empty map
+        fallbacks = {}
+    return {
+        "ledger": ledger.dump(),
+        "memory": memory.doc(),
+        "kernel_fallbacks": fallbacks,
+        "recompiles_after_warmup": ledger.recompiles_after_warmup,
+        "mfu": ledger.mfu(),
+    }

@@ -215,6 +215,27 @@ class Observability:
             chaos.on_fault = (
                 lambda point, kind, step: self.events.emit(
                     "chaos_fault", point=point, fault=kind, step=step))
+            # device/compiler telemetry (fmda_tpu.obs.device): compile
+            # ledger counters + MFU roofline + memory watermarks ride
+            # every scrape; latest-instance-wins for the ledger's event
+            # log (same discipline as the chaos hook above)
+            from fmda_tpu.obs.device import (
+                default_ledger,
+                default_memory_monitor,
+            )
+
+            ledger = default_ledger()
+            memory = default_memory_monitor()
+            ledger.events = self.events
+
+            def device_families() -> Snapshot:
+                fams = ledger.families()
+                mem = memory.families()
+                for kind in mem:
+                    fams.setdefault(kind, []).extend(mem[kind])
+                return fams
+
+            self.registry.register_collector("device", device_families)
         self.clock = clock
         self.checks: Dict[str, HealthCheck] = {}
         if self.registry.enabled:
@@ -411,6 +432,9 @@ class Observability:
                     "metrics endpoint already serving on %s; ignoring "
                     "requested port %d", self.server.url, requested)
             return self.server
+        from fmda_tpu.obs.device import device_report
+        from fmda_tpu.obs.pyprof import default_profiler
+
         self.server = MetricsServer(
             self.registry,
             host=host if host is not None else self.config.host,
@@ -418,6 +442,8 @@ class Observability:
             health_fn=self.health,
             events=self.events,
             tracer=default_tracer(),
+            profile_fn=lambda: default_profiler().folded(),
+            device_fn=device_report,
         ).start()
         self.events.emit("obs.server_started", url=self.server.url)
         return self.server

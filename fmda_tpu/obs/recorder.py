@@ -17,7 +17,11 @@ moment the trigger fires:
       latency summaries) covering the run-up to the trigger;
     - ``events.jsonl``  — the event-log tail;
     - ``workers.json``  — per-worker stats (heartbeat-carried serving
-      counters, wire frame stats) when a fleet context supplies them.
+      counters, wire frame stats) when a fleet context supplies them;
+    - ``profile.folded`` — the host profiler's flamegraph-collapsed
+      stacks (where the host was when the breach fired);
+    - ``device.json``   — the compile ledger + device memory report
+      (fmda_tpu.obs.device: programs, recompiles, MFU, watermarks).
 
 Bundles are **bounded and rotated**: at most ``keep`` on disk (oldest
 deleted), with a per-reason debounce so a flapping alert cannot write
@@ -60,6 +64,8 @@ class FlightRecorder:
         tracer=None,
         snapshot_fn: Optional[Callable[[], dict]] = None,
         workers_fn: Optional[Callable[[], dict]] = None,
+        profile_fn: Optional[Callable[[], str]] = None,
+        device_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
@@ -73,6 +79,8 @@ class FlightRecorder:
         self.tracer = tracer
         self.snapshot_fn = snapshot_fn
         self.workers_fn = workers_fn
+        self.profile_fn = profile_fn
+        self.device_fn = device_fn
         #: reason -> clock stamp of its last bundle (the debounce)
         self._last: Dict[str, float] = {}
         self._seq = 0
@@ -143,6 +151,14 @@ class FlightRecorder:
             self._guarded(path, "workers.json",
                           lambda: self._dump_json(
                               path, "workers.json", self.workers_fn()))
+        if self.profile_fn is not None:
+            self._guarded(path, "profile.folded",
+                          lambda: self._dump_text(
+                              path, "profile.folded", self.profile_fn()))
+        if self.device_fn is not None:
+            self._guarded(path, "device.json",
+                          lambda: self._dump_json(
+                              path, "device.json", self.device_fn()))
 
     def _guarded(self, path: str, name: str, fn) -> None:
         try:

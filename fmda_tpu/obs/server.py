@@ -18,7 +18,12 @@ No third-party web framework — five fixed routes on a daemonised
   a fleet telemetry handle is attached (fmda_tpu.obs.aggregate);
 - ``/alerts``   — the SLO engine's alert document (fmda_tpu.obs.slo);
 - ``/control``  — the control plane's loop state + decision ring
-  (fmda_tpu.control, when one is attached).
+  (fmda_tpu.control, when one is attached);
+- ``/profile``  — the host profiler's flamegraph-collapsed stacks as
+  text (fmda_tpu.obs.pyprof, when one is attached);
+- ``/device``   — the compile ledger + device memory report as JSON
+  (fmda_tpu.obs.device, when attached; what
+  ``python -m fmda_tpu perf --endpoint`` consumes).
 
 A handler exception yields an HTTP 500 with a JSON ``{"error": ...}``
 body — never a half-written response — and the serving thread survives.
@@ -60,6 +65,8 @@ class MetricsServer:
         query_fn: Optional[Callable[..., dict]] = None,
         alerts_fn: Optional[Callable[[], dict]] = None,
         control_fn: Optional[Callable[[], dict]] = None,
+        profile_fn: Optional[Callable[[], str]] = None,
+        device_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         self.registry = registry
         self.health_fn = health_fn
@@ -68,6 +75,8 @@ class MetricsServer:
         self.query_fn = query_fn
         self.alerts_fn = alerts_fn
         self.control_fn = control_fn
+        self.profile_fn = profile_fn
+        self.device_fn = device_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -158,6 +167,18 @@ class MetricsServer:
                         self._send(
                             200,
                             json.dumps(server.control_fn(),
+                                       indent=2).encode(),
+                            "application/json")
+                    elif path == "/profile" \
+                            and server.profile_fn is not None:
+                        self._send(
+                            200, server.profile_fn().encode(),
+                            "text/plain; charset=utf-8")
+                    elif path == "/device" \
+                            and server.device_fn is not None:
+                        self._send(
+                            200,
+                            json.dumps(server.device_fn(),
                                        indent=2).encode(),
                             "application/json")
                     elif path == "/trace":

@@ -24,6 +24,12 @@ Objectives ship with the framework (the ``[slo]`` config section —
                           ``journal_budget``
 ``degraded_feed``         minutes of any side feed serving ghost rows vs
                           ``degraded_feed_budget_minutes`` per slow window
+``recompile``             unexpected XLA recompiles after warmup (raw
+                          count; ``recompile_budget`` < 1 → one recompile
+                          fires) — fmda_tpu.obs.device's compile ledger
+``memory_leak``           fraction of samples with the device memory
+                          monitor's monotonic-growth heuristic raised vs
+                          ``memory_leak_budget``
 ========================  ===================================================
 
 Firing and resolving are **events** (the EventLog records both), the
@@ -51,6 +57,8 @@ SERIES_TICKS = "fleet_ticks_total"
 SERIES_LOSS = "fleet_loss_total"
 SERIES_JOURNAL = "warehouse_journal_pending"
 SERIES_DEGRADED = "engine_degraded_streams"
+SERIES_RECOMPILES = "worker_recompiles_total"
+SERIES_LEAK = "worker_memory_leak_suspected"
 
 
 def bad_fraction_above(hist: LatencyHistogram, bound_s: float) -> float:
@@ -130,7 +138,33 @@ class SLOEngine:
             "bad": lambda w, now: self._gauge_bad(
                 SERIES_DEGRADED, w, now, 0.0),
         })
+        out.append({
+            "objective": "recompile",
+            "budget": cfg.recompile_budget,
+            "detail": "unexpected XLA recompiles after warmup",
+            "bad": lambda w, now: self._recompile_bad(w, now),
+        })
+        out.append({
+            "objective": "memory_leak",
+            "budget": cfg.memory_leak_budget,
+            "detail": "monotonic device-memory growth suspected",
+            "bad": lambda w, now: self._gauge_bad(
+                SERIES_LEAK, w, now, 0.0),
+        })
         return out
+
+    def _recompile_bad(self, window_s: float, now: float
+                       ) -> Optional[float]:
+        """Unexpected recompiles in the window, as raw count (budget
+        ``recompile_budget`` < 1 means ONE recompile already burns past
+        threshold — the steady-state contract is zero).  None until the
+        series has ever been reported (a fleet without the device plane
+        must not read as perpetually healthy-zero OR alert)."""
+        if not self.store.query(SERIES_RECOMPILES, window_s=window_s,
+                                now=now)["points"]:
+            return None
+        return self.store.window_total(
+            SERIES_RECOMPILES, window_s=window_s, now=now)
 
     def _latency_bad(self, window_s: float, now: float) -> Optional[float]:
         hist = self.store.window_histogram(

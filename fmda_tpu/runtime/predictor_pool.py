@@ -71,6 +71,7 @@ from fmda_tpu.config import (
     ModelConfig,
 )
 from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.obs.device import tracked_jit
 from fmda_tpu.obs.trace import TraceRef, default_tracer, now_ns, parse_wire
 from fmda_tpu.runtime.batcher import BatcherConfig, MicroBatcher, Tick
 from fmda_tpu.runtime.metrics import RuntimeMetrics
@@ -120,7 +121,10 @@ class PredictorPool:
         self._x_range = jnp.asarray(norm_params.x_max - norm_params.x_min)
         # the ONE shared forward (serve/predictor.py) — jitting it here
         # and in the solo Predictor yields the same program at B=1
-        self._forward = jax.jit(make_batched_forward(model_cfg))
+        self._forward = tracked_jit(
+            make_batched_forward(model_cfg),
+            name="predictor_forward",
+            signature_of=lambda *a, **k: ("B", int(a[3].shape[0])))
         # fallback compile accounting (batch size is the only varying
         # shape in the forward signature; see SessionPool.compile_count)
         self._batch_sizes_seen: set = set()
@@ -153,7 +157,10 @@ class PredictorPool:
             new_ring = jax.lax.dynamic_slice_in_dim(buf, n_valid, w, axis=0)
             return x, new_ring
 
-        self._ring_gather = jax.jit(ring_gather)
+        self._ring_gather = tracked_jit(
+            ring_gather,
+            name="predictor_ring_gather",
+            signature_of=lambda *a, **k: ("B", int(a[1].shape[0])))
 
     @property
     def compile_count(self) -> int:
@@ -161,10 +168,26 @@ class PredictorPool:
         dispatched (the no-recompile-on-the-tick-path proof hook; the
         ring's gather programs are counted separately and never affect
         this).  Probes jax's jit cache when the hook exists."""
-        cache_size = getattr(self._forward, "_cache_size", None)
-        if cache_size is not None:
-            return cache_size()
+        size = self._forward.cache_size()
+        if size is not None:
+            return size
         return len(self._batch_sizes_seen)
+
+    def mark_warm(self) -> None:
+        """Declare precompile over: further forward/gather compiles are
+        unexpected recompiles (counted, evented, SLO-alertable)."""
+        self._forward.mark_warm()
+        self._ring_gather.mark_warm()
+
+    @property
+    def recompiles_after_warmup(self) -> int:
+        return (self._forward.unexpected_recompiles
+                + self._ring_gather.unexpected_recompiles)
+
+    def live_tree(self):
+        """The pool's live device tree (params + norms + window ring)
+        — the owner callback for the device memory monitor."""
+        return (self._params, self._x_min, self._x_range, self._ring)
 
     # -- the hot path -------------------------------------------------------
 

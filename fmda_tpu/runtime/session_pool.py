@@ -78,6 +78,7 @@ import numpy as np
 
 from fmda_tpu.config import ModelConfig
 from fmda_tpu.data.normalize import NormParams
+from fmda_tpu.obs.device import tracked_jit
 from fmda_tpu.serve.streaming import (
     _recurrent_cell_ops,
     advance_cells,
@@ -259,6 +260,14 @@ class SessionPool:
         # flush.  The attributes are rebound to the outputs immediately
         # below in step_device, so the consumed buffers are unreachable.
         donate = (1, 2, 3)
+        # batch size (slots, arg 6) is the only varying shape in the
+        # step signature — the cheap per-call program signature for the
+        # compile ledger (fmda_tpu.obs.device)
+        step_name = f"session_pool_step_{cfg.cell}"
+
+        def sig(*a, **k):
+            return ("B", int(a[6].shape[0]))
+
         if self.n_shards > 1:
             st, rp = self._state_sharding, self._repl_sharding
             # explicit shardings (pytree prefixes): state tree sharded on
@@ -266,14 +275,18 @@ class SessionPool:
             # specs on the outputs, so donation aliasing holds shard for
             # shard.  slots/rows arrive replicated; XLA inserts the
             # cross-chip gather/scatter for foreign lanes.
-            self._step = jax.jit(
+            self._step = tracked_jit(
                 step,
+                name=step_name,
+                signature_of=sig,
                 donate_argnums=donate,
                 in_shardings=(rp, st, st, st, st, st, rp, rp),
                 out_shardings=(rp, st, st, st),
             )
         else:
-            self._step = jax.jit(step, donate_argnums=donate)
+            self._step = tracked_jit(
+                step, name=step_name, signature_of=sig,
+                donate_argnums=donate)
 
     # -- slot lifecycle (host-side, off the hot path) -----------------------
 
@@ -439,10 +452,28 @@ class SessionPool:
         batch sizes (equivalent here: batch size is the only varying
         shape in the step signature) if a jax upgrade removes it.
         """
-        cache_size = getattr(self._step, "_cache_size", None)
-        if cache_size is not None:
-            return cache_size()
+        size = self._step.cache_size()
+        if size is not None:
+            return size
         return len(self._batch_sizes_seen)
+
+    def mark_warm(self) -> None:
+        """Declare precompile over: any further compile of the step is
+        an *unexpected recompile* — counted by the compile ledger,
+        evented, and SLO-alertable (fmda_tpu.obs.device)."""
+        self._step.mark_warm()
+
+    @property
+    def recompiles_after_warmup(self) -> int:
+        """Compiles observed after :meth:`mark_warm` (0 is the
+        steady-state contract the chaos/elastic soaks hard-gate)."""
+        return self._step.unexpected_recompiles
+
+    def live_tree(self):
+        """The pool's live device tree (params + pooled state + norms)
+        — the owner callback for the device memory monitor."""
+        return (self._params, self._carry, self._ring, self._pos,
+                self._x_min, self._x_range)
 
     # -- the hot path -------------------------------------------------------
 

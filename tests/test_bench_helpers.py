@@ -75,6 +75,7 @@ class TestPhaseRegistry:
             "obs_overhead",
             "obs_aggregate_overhead",
             "trace_overhead",
+            "device_obs_overhead",
             "analysis_lint",
             "wire_codec_bench",
         }

@@ -1,0 +1,561 @@
+"""Device & compiler observability (ISSUE 17): the tracked-jit compile
+ledger, unexpected-recompile detection, the cost-analysis probe, the
+memory watermark monitor, the host sampling profiler, and the SLO /
+flight-recorder integration.
+
+The acceptance test is the ISSUE's contract: a runtime bucket-set
+change after warmup triggers the unexpected-recompile path end to end
+— ledger event, counter, SLO burn-rate alert, and a flight-recorder
+bundle carrying both the folded-stack profile and the ledger snapshot.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fmda_tpu import compat
+from fmda_tpu.config import ModelConfig, ProfilingConfig, SLOConfig
+from fmda_tpu.obs import EventLog, FleetTelemetry, FlightRecorder
+from fmda_tpu.obs.device import (
+    LEDGER_SCHEMA,
+    PROGRAM_SCHEMA,
+    CompileLedger,
+    DeviceMemoryMonitor,
+    TrackedFunction,
+    configure_device_obs,
+    device_report,
+    tracked_jit,
+)
+from fmda_tpu.obs.pyprof import HostProfiler, thread_stage
+from fmda_tpu.obs.slo import SERIES_LEAK, SERIES_RECOMPILES
+from fmda_tpu.runtime import SessionPool
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _setup(feats=6, hidden=5, window=4, seed=0):
+    cfg = ModelConfig(hidden_size=hidden, n_features=feats, output_size=4,
+                      dropout=0.0, bidirectional=False, use_pallas=False)
+    from fmda_tpu.models import build_model
+
+    model = build_model(cfg)
+    params = model.init({"params": jax.random.PRNGKey(seed)},
+                        jnp.zeros((1, window, feats)))["params"]
+    return cfg, params
+
+
+def _slo_cfg(**over):
+    base = dict(
+        interval_s=1.0, retention_s=600.0, scrape_interval_s=1.0,
+        fast_window_s=8.0, slow_window_s=24.0, burn_threshold=2.0,
+        latency_p99_ms=100.0, latency_budget=0.05, loss_budget=0.01,
+        journal_depth=100, journal_budget=0.1,
+        degraded_feed_budget_minutes=0.05)
+    base.update(over)
+    return SLOConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# ledger basics + pinned schemas
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_dump_schema_is_pinned():
+    """The dump document is a bench artifact and a flight-recorder
+    bundle member — its key set is part of the operational contract."""
+    led = CompileLedger(enabled=True)
+    f = tracked_jit(lambda x: x + 1.0, name="prog", ledger=led,
+                    signature_of=lambda x: int(x.shape[0]))
+    f(jnp.ones((2,)))
+    dump = led.dump()
+    assert tuple(sorted(dump)) == tuple(sorted(LEDGER_SCHEMA))
+    assert dump["schema_version"] == 1
+    assert len(dump["programs"]) == 1
+    for prog in dump["programs"]:
+        assert tuple(sorted(prog)) == tuple(sorted(PROGRAM_SCHEMA))
+    assert dump["compiles_total"] == 1
+    assert dump["compile_seconds_total"] > 0.0
+
+
+def test_tracked_jit_counts_compiles_per_signature_not_per_call():
+    led = CompileLedger(enabled=True)
+    f = tracked_jit(lambda x: (x * 2.0).sum(), name="prog", ledger=led,
+                    signature_of=lambda x: int(x.shape[0]))
+    for _ in range(3):
+        f(jnp.ones((4,)))
+    f(jnp.ones((8,)))
+    assert led.compiles_total == 2
+    recs = {p["signature"]: p for p in led.dump()["programs"]}
+    assert recs["4"]["calls"] == 3 and recs["4"]["compiles"] == 1
+    assert recs["8"]["calls"] == 1 and recs["8"]["compiles"] == 1
+
+
+def test_disabled_ledger_is_passthrough_and_records_nothing():
+    led = CompileLedger(enabled=False)
+    f = tracked_jit(lambda x: x + 1.0, name="prog", ledger=led)
+    out = f(jnp.ones((2,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert led.compiles_total == 0
+    assert led.dump()["programs"] == []
+
+
+def test_unexpected_recompile_counted_and_evented_after_mark_warm():
+    led = CompileLedger(enabled=True)
+    led.events = EventLog()
+    f = tracked_jit(lambda x: x * 3.0, name="prog", ledger=led,
+                    signature_of=lambda x: int(x.shape[0]))
+    f(jnp.ones((2,)))
+    led.mark_warm()
+    assert led.recompiles_after_warmup == 0
+    f(jnp.ones((2,)))  # same program: no compile, no event
+    assert led.recompiles_after_warmup == 0
+    f(jnp.ones((5,)))  # new shape after warmup: the alarm case
+    assert led.recompiles_after_warmup == 1
+    kinds = [e["kind"] for e in led.events.tail()]
+    assert "device.compile" in kinds
+    assert "device.unexpected_recompile" in kinds
+    fired = [e for e in led.events.tail()
+             if e["kind"] == "device.unexpected_recompile"]
+    assert fired[0]["program"] == "prog"
+
+
+def test_ledger_families_aggregate_same_named_programs():
+    """Several pools in one process can track same-named programs (a
+    multi-worker soak) — the exposition must stay one sample per label
+    set, summed."""
+    led = CompileLedger(enabled=True)
+    for _ in range(2):
+        f = tracked_jit(lambda x: x - 1.0, name="shared", ledger=led,
+                        signature_of=lambda x: int(x.shape[0]))
+        f(jnp.ones((3,)))
+    fams = led.families()
+    compiles = [s for s in fams["counters"] if s["name"] == "compile_total"
+                and s["labels"].get("program") == "shared"]
+    assert len(compiles) == 1
+    assert compiles[0]["value"] == 2
+
+
+def test_ledger_thread_safety_sum_of_deltas_equals_cache_size():
+    """Concurrent callers racing distinct shapes: every compile is
+    claimed exactly once (sum of per-signature compiles == the jit
+    cache's final size) and call counts are exact."""
+    led = CompileLedger(enabled=True)
+    f = tracked_jit(lambda x: (x + 1.0).sum(), name="prog", ledger=led,
+                    signature_of=lambda x: int(x.shape[0]))
+    n_threads, calls_each = 8, 25
+    errors = []
+
+    def hammer(tid):
+        try:
+            for i in range(calls_each):
+                f(jnp.ones((1 + (tid * calls_each + i) % 5,)))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    size = f.cache_size()
+    if size is not None:
+        assert led.compiles_total == size
+    else:
+        assert led.compiles_total == 5  # distinct-signature fallback
+    assert sum(p["calls"] for p in led.dump()["programs"]) \
+        == n_threads * calls_each
+
+
+def test_cache_size_fallback_counts_distinct_signatures():
+    """On a jax without the private cache probe the ledger degrades to
+    distinct-signature counting instead of going blind."""
+    led = CompileLedger(enabled=True)
+
+    calls = []
+
+    class NoProbeJit:
+        def __call__(self, *a, **k):
+            calls.append(a)
+            return 0.0
+
+    f = TrackedFunction(NoProbeJit(), name="prog", ledger=led,
+                        signature_of=lambda x: int(x.shape[0]))
+    led.track(f)
+    assert f.cache_size() is None
+    f(jnp.ones((2,)))
+    f(jnp.ones((2,)))
+    f(jnp.ones((6,)))
+    assert led.compiles_total == 2
+    assert len(calls) == 3
+
+
+# ---------------------------------------------------------------------------
+# cost-analysis probe (compat seam)
+# ---------------------------------------------------------------------------
+
+
+class _FakeCompiled:
+    def __init__(self, cost):
+        self._cost = cost
+
+    def cost_analysis(self):
+        return self._cost
+
+
+class _FakeJit:
+    def __init__(self, cost):
+        self._cost = cost
+
+    def lower(self, *a, **k):
+        compiled = _FakeCompiled(self._cost)
+        return type("L", (), {"compile": lambda self_: compiled})()
+
+
+def test_cost_analysis_probe_returns_dict_and_unwraps_lists():
+    cost = compat.cost_analysis(
+        _FakeJit({"flops": 12.0, "bytes accessed": 34.0}),
+        (jnp.ones((2, 3)),))
+    assert cost == {"flops": 12.0, "bytes accessed": 34.0}
+    # some jax versions hand back a list of per-computation dicts
+    cost = compat.cost_analysis(
+        _FakeJit([{"flops": 5.0}]), (jnp.ones((2,)),))
+    assert cost == {"flops": 5.0}
+
+
+def test_cost_analysis_probe_none_when_method_missing():
+    class NoCost:
+        def lower(self, *a, **k):
+            compiled = object()  # no cost_analysis attribute
+            return type("L", (), {"compile": lambda self_: compiled})()
+
+    assert compat.cost_analysis(NoCost(), (jnp.ones((2,)),)) is None
+
+
+def test_cost_probe_failure_is_counted_never_raised():
+    led = CompileLedger(enabled=True, cost_analysis=True)
+
+    class BrokenJit:
+        def __call__(self, *a, **k):
+            return 0.0
+
+        def lower(self, *a, **k):
+            raise RuntimeError("no lowering on this build")
+
+    f = TrackedFunction(BrokenJit(), name="prog", ledger=led,
+                        signature_of=lambda x: int(x.shape[0]))
+    led.track(f)
+    f(jnp.ones((2,)))  # fallback compile detection + failing probe
+    assert led.dump()["cost_probe_failures"] == 1
+    assert led.compiles_total == 1
+
+
+def test_cost_analysis_populates_flops_on_real_jax():
+    led = CompileLedger(enabled=True, cost_analysis=True)
+    f = tracked_jit(lambda x: x @ x.T, name="prog", ledger=led,
+                    signature_of=lambda x: int(x.shape[0]))
+    f(jnp.ones((8, 8)))
+    progs = led.dump()["programs"]
+    if led.dump()["cost_probe_failures"]:
+        pytest.skip("installed jax exposes no cost_analysis")
+    assert progs[0]["flops"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# memory watermarks + leak heuristic
+# ---------------------------------------------------------------------------
+
+
+def test_memory_monitor_attributes_owners_and_tracks_watermark():
+    mon = DeviceMemoryMonitor(interval_s=100.0, leak_window=3)
+    tree = {"w": jnp.ones((16, 4), jnp.float32)}
+    mon.register_owner("pool:a", lambda: tree)
+    doc = mon.sample()
+    assert doc["by_owner"]["pool:a"] == 16 * 4 * 4
+    assert doc["watermark_bytes"] >= doc["by_owner"]["pool:a"]
+    assert mon.watermark_bytes == doc["watermark_bytes"]
+    fams = mon.families()
+    owners = {s["labels"]["owner"]: s["value"] for s in fams["gauges"]
+              if s["name"] == "device_live_bytes"}
+    assert owners["pool:a"] == 16 * 4 * 4
+    assert "process" in owners
+
+
+def test_memory_monitor_cadence_gate_and_leak_heuristic(monkeypatch):
+    mon = DeviceMemoryMonitor(interval_s=5.0, leak_window=3)
+    assert mon.maybe_sample(now=0.0) is True
+    assert mon.maybe_sample(now=1.0) is False  # not due: one clock read
+    assert mon.maybe_sample(now=5.1) is True
+    # strictly monotonic growth across the full window => suspected
+    grow = iter([10.0, 20.0, 30.0, 30.0])
+
+    def fake_live():
+        return [type("A", (), {"nbytes": next(grow)})()]
+
+    monkeypatch.setattr(jax, "live_arrays", fake_live)
+    mon2 = DeviceMemoryMonitor(interval_s=0.0, leak_window=3)
+    mon2.sample()
+    mon2.sample()
+    assert mon2.leak_suspected is False  # window not full yet
+    mon2.sample()
+    assert mon2.leak_suspected is True
+    mon2.sample()  # plateau breaks the strict-growth window
+    assert mon2.leak_suspected is False
+
+
+def test_configure_device_obs_applies_profiling_config():
+    cfg = ProfilingConfig(enabled=False, cost_analysis=False,
+                          memory_interval_s=9.0, memory_leak_window=5,
+                          profile_interval_ms=25.0, profile_max_stacks=7)
+    configure_device_obs(cfg)
+    from fmda_tpu.obs.device import default_ledger, default_memory_monitor
+    from fmda_tpu.obs.pyprof import default_profiler
+
+    try:
+        assert default_ledger().enabled is False
+        assert default_memory_monitor().interval_s == 9.0
+        assert default_memory_monitor().leak_window == 5
+        assert default_profiler().interval_ms == 25.0
+        assert default_profiler().max_stacks == 7
+        assert not default_profiler().running
+    finally:
+        configure_device_obs(ProfilingConfig(cost_analysis=False))
+    assert default_ledger().enabled is True
+
+
+def test_configure_device_obs_starts_and_stops_host_profiler():
+    from fmda_tpu.obs.pyprof import default_profiler
+
+    try:
+        configure_device_obs(ProfilingConfig(
+            cost_analysis=False, host_profiler=True,
+            profile_interval_ms=50.0))
+        assert default_profiler().running
+    finally:
+        configure_device_obs(ProfilingConfig(cost_analysis=False))
+    assert not default_profiler().running
+
+
+# ---------------------------------------------------------------------------
+# host sampling profiler
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_folded_round_trip_and_stage_attribution():
+    prof = HostProfiler(interval_ms=1000.0)
+    ready = threading.Event()
+    done = threading.Event()
+
+    def busservice():
+        ready.set()
+        done.wait(timeout=10.0)
+
+    t = threading.Thread(target=busservice, name="fmda-bus-server-0",
+                         daemon=True)
+    t.start()
+    ready.wait(timeout=10.0)
+    try:
+        n = prof.sample_once()
+        assert n >= 1
+    finally:
+        done.set()
+        t.join(timeout=5.0)
+    folded = prof.folded()
+    parsed = HostProfiler.parse_folded(folded)
+    assert parsed  # at least this test's threads
+    assert sum(parsed.values()) == sum(
+        int(line.rsplit(" ", 1)[1]) for line in folded.splitlines())
+    bus_stacks = [s for s in parsed if s.startswith("fmda-bus-server-0;")]
+    assert bus_stacks and "busservice" in bus_stacks[0]
+    assert prof.stage_summary().get("bus", 0) >= 1
+    assert thread_stage("fmda-bus-server-0") == "bus"
+    assert thread_stage("totally-unrelated") == "other"
+
+
+def test_profiler_start_stop_is_clean_and_families_export():
+    prof = HostProfiler(interval_ms=2.0)
+    prof.start()
+    assert prof.running
+    import time as _time
+
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        if HostProfiler.parse_folded(prof.folded()):
+            break
+        _time.sleep(0.01)
+    prof.stop()
+    assert not prof.running
+    fams = prof.families()
+    samples = [s for s in fams["counters"]
+               if s["name"] == "profile_samples_total"]
+    assert samples and samples[0]["value"] >= 1
+
+
+def test_profiler_overflow_folds_into_other_bucket():
+    prof = HostProfiler(max_stacks=1)
+    prof.sample_once()
+    parsed = HostProfiler.parse_folded(prof.folded())
+    assert len(parsed) <= 2  # the one stack + the <other> bucket
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + /device report
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_bundles_profile_and_device_report(tmp_path):
+    led = CompileLedger(enabled=True)
+    f = tracked_jit(lambda x: x + 1.0, name="prog", ledger=led,
+                    signature_of=lambda x: int(x.shape[0]))
+    f(jnp.ones((2,)))
+    rec = FlightRecorder(
+        str(tmp_path), keep=2, min_interval_s=0.0,
+        profile_fn=lambda: "MainThread;mod:fn 7\n",
+        device_fn=lambda: device_report(ledger=led))
+    path = rec.trigger("slo-recompile")
+    files = set(os.listdir(path))
+    assert {"profile.folded", "device.json"} <= files
+    assert HostProfiler.parse_folded(
+        open(os.path.join(path, "profile.folded")).read()) \
+        == {"MainThread;mod:fn": 7}
+    device = json.load(open(os.path.join(path, "device.json")))
+    assert tuple(sorted(device["ledger"])) == tuple(sorted(LEDGER_SCHEMA))
+    assert device["ledger"]["compiles_total"] == 1
+    assert "memory" in device and "kernel_fallbacks" in device
+
+
+def test_device_report_shape():
+    doc = device_report(ledger=CompileLedger(enabled=True),
+                        memory=DeviceMemoryMonitor())
+    assert set(doc) == {"ledger", "memory", "kernel_fallbacks",
+                        "recompiles_after_warmup", "mfu"}
+
+
+# ---------------------------------------------------------------------------
+# SLO integration
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_objective_fires_on_one_recompile_and_needs_data():
+    clock = FakeClock()
+    from fmda_tpu.obs import SLOEngine, TimeSeriesStore
+
+    cfg = _slo_cfg(recompile_budget=0.5)
+    store = TimeSeriesStore(interval_s=1.0, capacity=64, clock=clock)
+    slo = SLOEngine(cfg, store, clock=clock)
+    # no data => no alert (a fleet without the device plane is not
+    # perpetually healthy-zero OR alerting)
+    assert slo.evaluate()["recompile"]["state"] == "ok"
+    total = 0
+    saw_firing = False
+    for step in range(20):
+        clock.t = float(step)
+        if step == 10:
+            total += 1  # ONE post-warmup recompile
+        store.record_counter(SERIES_RECOMPILES, float(total), process="w0")
+        slo.evaluate()
+        if "recompile" in slo.firing():
+            saw_firing = True
+            assert slo.alerts()["alerts"]["recompile"]["state"] == "firing"
+    assert saw_firing
+    # and once the event rolls out of both windows the alert resolves —
+    # a single historic recompile must not page forever
+    assert slo.alerts()["alerts"]["recompile"]["state"] == "ok"
+
+
+def test_memory_leak_objective_reads_worker_gauges():
+    clock = FakeClock()
+    from fmda_tpu.obs import SLOEngine, TimeSeriesStore
+
+    cfg = _slo_cfg(memory_leak_budget=0.05)
+    store = TimeSeriesStore(interval_s=1.0, capacity=64, clock=clock)
+    slo = SLOEngine(cfg, store, clock=clock)
+    for step in range(30):
+        clock.t = float(step)
+        store.record_gauge(SERIES_LEAK, 1.0 if step >= 10 else 0.0,
+                           process="w0")
+        slo.evaluate()
+    assert slo.alerts()["alerts"]["memory_leak"]["state"] == "firing"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: bucket-set change -> recompile -> alert -> bundle
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_change_recompile_alerts_and_bundles_end_to_end(tmp_path):
+    """The ISSUE 17 contract.  A SessionPool precompiled on its bucket
+    set and marked warm hits an off-bucket batch: the ledger records
+    the unexpected recompile (event + counter), the landed worker
+    series burns the recompile SLO, the firing alert triggers a
+    flight-recorder bundle, and the bundle carries both the host
+    profile and the ledger snapshot."""
+    from fmda_tpu.obs.device import default_ledger
+
+    led = default_ledger()
+    led.reset()
+    led.enabled = True
+    events = EventLog()
+    led.events = events
+
+    cfg, params = _setup()
+    pool = SessionPool(cfg, params, capacity=8, window=4)
+    # precompile the declared bucket set, then declare warmup over
+    pool.step(np.full(4, pool.padding_slot, np.int32),
+              np.zeros((4, 6), np.float32))
+    pool.mark_warm()
+    assert pool.recompiles_after_warmup == 0
+    # the fault: an off-bucket batch size reaches the step seam
+    pool.step(np.full(6, pool.padding_slot, np.int32),
+              np.zeros((6, 6), np.float32))
+    assert pool.recompiles_after_warmup == 1
+    assert led.recompiles_after_warmup == 1
+    kinds = [e["kind"] for e in events.tail()]
+    assert "device.unexpected_recompile" in kinds
+
+    # the worker heartbeat ships the count; the aggregator lands it;
+    # the SLO engine burns through the zero-recompile budget and the
+    # firing alert freezes a postmortem bundle
+    clock = FakeClock()
+    telemetry = FleetTelemetry(
+        _slo_cfg(recompile_budget=0.5, postmortem_dir=str(tmp_path),
+                 postmortem_min_interval_s=0.0),
+        clock=clock)
+    saw_firing = False
+    for step in range(20):
+        clock.t = float(step)
+        n = led.recompiles_after_warmup if step >= 10 else 0
+        telemetry.store.record_counter(
+            SERIES_RECOMPILES, float(n), process="w0")
+        telemetry.slo.evaluate(now=clock.t)
+        if "recompile" in telemetry.slo.firing():
+            saw_firing = True
+    assert saw_firing
+    bundles = telemetry.recorder.bundles()
+    assert bundles
+    newest = bundles[-1]
+    files = set(os.listdir(newest))
+    assert {"profile.folded", "device.json"} <= files
+    device = json.load(open(os.path.join(newest, "device.json")))
+    assert device["recompiles_after_warmup"] >= 1
+    programs = {p["program"] for p in device["ledger"]["programs"]}
+    assert any(p.startswith("session_pool_step") for p in programs)
+    telemetry.close()
+    led.reset()
+    led.events = None
