@@ -1389,6 +1389,222 @@ def phase_runtime_fleet() -> dict:
     return result
 
 
+#: pinned top-level schema of artifacts/replay_throughput.json — the
+#: per-cell rows/s evidence, the bit-identity verdict, and the hot-swap
+#: zero-downtime accounting (test_bench_helpers pins this tuple)
+REPLAY_THROUGHPUT_SCHEMA = (
+    "tickers", "rounds", "buckets", "cadence_s", "quiet_host",
+    "cells", "identity_ok", "hot_swap",
+)
+
+
+def _replay_cell_run(cell: str, tickers: int, rounds: int,
+                     buckets: tuple, cadence_s: float) -> dict:
+    """One replay-vs-live A/B for one carried-state cell family, plus
+    the mid-backfill hot swap, all at the flagship feature width.
+
+    Three gateway builds off ONE params tree: (a) the max-speed replay
+    backfill, (b) a fresh gateway serving the same history cadence-
+    paced per-tick (the live baseline replay deletes), (c) a fresh
+    gateway replaying again with a shifted-seed checkpoint hot-swapped
+    in halfway.  (a) vs (b) sorted by (session, seq) is the in-phase
+    bit-identity check; (a) vs (c) proves the swap barrier — pre-swap
+    results byte-equal, post-swap results from the NEW weights — while
+    the seq/served accounting proves zero dropped sessions and zero
+    downtime rounds."""
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.config import ModelConfig
+    from fmda_tpu.models import build_model
+    from fmda_tpu.replay import (
+        ReplayDriver, SyntheticHistory, run_live_reference)
+    from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+
+    cfg = ModelConfig(hidden_size=HIDDEN, n_features=FEATURES,
+                      output_size=CLASSES, dropout=0.0,
+                      bidirectional=False, use_pallas=False, cell=cell)
+    model = build_model(cfg)
+
+    def init_params(seed: int):
+        return model.init({"params": jax.random.PRNGKey(seed)},
+                          jnp.zeros((1, WINDOW, FEATURES)))["params"]
+
+    params = init_params(0)
+
+    def fresh_gateway():
+        pool = SessionPool(cfg, params, capacity=tickers, window=WINDOW)
+        gw = FleetGateway(
+            pool,
+            batcher_config=BatcherConfig(bucket_sizes=buckets,
+                                         max_linger_s=0.002))
+        for b in buckets:
+            pool.step(np.full(b, pool.padding_slot, np.int32),
+                      np.zeros((b, FEATURES), np.float32))
+        assert pool.compile_count == len(buckets)
+        pool.mark_warm()
+        return gw, pool
+
+    source = SyntheticHistory(tickers, rounds, FEATURES, seed=0)
+
+    # (a) the backfill under test
+    gw_a, pool_a = fresh_gateway()
+    drv = ReplayDriver(gw_a, source, collect=True)
+    rep = drv.run()
+
+    # (b) the cadence-paced live baseline over the same rows
+    gw_b, pool_b = fresh_gateway()
+    live = run_live_reference(gw_b, source, cadence_s=cadence_s,
+                              collect=True)
+
+    def by_key(results):
+        return sorted(results, key=lambda r: (r.session_id, r.seq))
+
+    a, b = by_key(drv.results), by_key(live["results"])
+    identity_ok = (
+        len(a) == len(b)
+        and all(x.session_id == y.session_id and x.seq == y.seq
+                and np.array_equal(x.probabilities, y.probabilities)
+                for x, y in zip(a, b)))
+
+    # (c) the same backfill with a checkpoint landing halfway through
+    gw_c, pool_c = fresh_gateway()
+    swap_at = rounds // 2
+    swapped: dict = {}
+
+    def on_round(r):
+        if not swapped and r + 1 >= swap_at:
+            swapped["version"] = gw_c.hot_swap(init_params(1))
+            swapped["round"] = r + 1
+
+    drv_c = ReplayDriver(gw_c, source, collect=True, on_round=on_round)
+    swap_run = drv_c.run()
+    c = by_key(drv_c.results)
+    # seq == round index under lockstep duty=1.0, so the swap round
+    # splits the result stream exactly
+    seqs_ok = all(
+        [r.seq for r in c if r.session_id == f"T{i:04d}"]
+        == list(range(rounds)) for i in range(tickers))
+    pre = [(x, y) for x, y in zip(a, c) if y.seq < swapped.get("round", 0)]
+    post = [(x, y) for x, y in zip(a, c)
+            if y.seq >= swapped.get("round", 0)]
+    pre_identical = all(
+        np.array_equal(x.probabilities, y.probabilities) for x, y in pre)
+    post_new_weights = any(
+        not np.array_equal(x.probabilities, y.probabilities)
+        for x, y in post)
+
+    return {
+        "replay_rows_per_s": rep["rows_per_s"],
+        "replay_ticks_per_s": rep["ticks_per_s"],
+        "live_ticks_per_s": live["ticks_per_s"],
+        "speedup_vs_live": (
+            round(rep["ticks_per_s"] / live["ticks_per_s"], 2)
+            if live["ticks_per_s"] else None),
+        "compile_count": rep["compile_count"],
+        "identity_ok": identity_ok,
+        "hot_swap": {
+            "round": swapped.get("round"),
+            "weights_version": swapped.get("version"),
+            "dropped_sessions": tickers - swap_run["sessions"],
+            "downtime_rounds": rounds - swap_run["rounds"],
+            "ticks_lost": tickers * rounds - swap_run["ticks_served"],
+            "seqs_contiguous": seqs_ok,
+            "recompiles_after_warmup": pool_c.recompiles_after_warmup,
+            "pre_swap_identical": pre_identical,
+            "post_swap_new_weights": post_new_weights,
+        },
+    }
+
+
+def phase_replay_throughput() -> dict:
+    """Fleet-scale historical replay (docs/replay.md): the virtual-clock
+    max-speed backfill vs the cadence-paced live loop, per carried-state
+    cell family, with the mid-backfill checkpoint hot swap.
+
+    Three hard gates on a quiet host, two of them host-load-independent:
+
+    * **speed** (quiet hosts only, else ``gate_inert``): replay ticks/s
+      must be >= 3x the cadence-paced live loop for every cell.  The
+      cadence here (25 ms/round) is the market's 60 s bar cadence
+      compressed ~2400x so the phase fits CI — the gate measures the
+      pacing deletion, which is cadence-scale-free at >=3x.
+    * **identity** (always): replay results sorted by (session, seq)
+      are byte-equal to the live loop's over the same row sequence —
+      the backfill serves through the UNMODIFIED path or this fails.
+    * **hot swap** (always): the halfway checkpoint swap drops zero
+      sessions, loses zero ticks, recompiles nothing after warmup, and
+      post-swap results come from the NEW weights while pre-swap
+      results stay byte-equal to a swap-free run (the barrier).
+
+    compile_count is pinned to len(buckets) per gateway (asserted in
+    the cell run).  Artifact: ``artifacts/replay_throughput.json`` with
+    the ``REPLAY_THROUGHPUT_SCHEMA`` top level."""
+    tickers, rounds = 16, 96
+    buckets = (16,)
+    cadence_s = 0.025
+    cells = {}
+    for cell in FLEET_AB_CELLS:
+        cells[cell] = _replay_cell_run(
+            cell, tickers, rounds, buckets, cadence_s)
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = None
+    quiet = load1 is not None and load1 < 0.5 * (os.cpu_count() or 1)
+
+    identity_ok = all(c["identity_ok"] for c in cells.values())
+    swap_ok = all(
+        c["hot_swap"]["dropped_sessions"] == 0
+        and c["hot_swap"]["downtime_rounds"] == 0
+        and c["hot_swap"]["ticks_lost"] == 0
+        and c["hot_swap"]["seqs_contiguous"]
+        and c["hot_swap"]["recompiles_after_warmup"] == 0
+        and c["hot_swap"]["pre_swap_identical"]
+        and c["hot_swap"]["post_swap_new_weights"]
+        for c in cells.values())
+    result = {
+        "tickers": tickers,
+        "rounds": rounds,
+        "buckets": list(buckets),
+        "cadence_s": cadence_s,
+        "quiet_host": quiet,
+        "cells": cells,
+        "identity_ok": identity_ok,
+        "hot_swap": {cell: c["hot_swap"] for cell, c in cells.items()},
+    }
+    assert tuple(sorted(result)) == tuple(sorted(REPLAY_THROUGHPUT_SCHEMA))
+    artifact_dir = os.path.join(_REPO_DIR, "artifacts")
+    os.makedirs(artifact_dir, exist_ok=True)
+    artifact = os.path.join(artifact_dir, "replay_throughput.json")
+    with open(artifact, "w") as fh:
+        json.dump(result, fh, indent=2, default=str)
+    result["artifact"] = os.path.relpath(artifact, _REPO_DIR)
+
+    errors = []
+    if not identity_ok:
+        errors.append(
+            "replay-vs-live bit-identity failed: the backfill's "
+            "published probabilities diverge from the cadence-paced "
+            "live loop over the same row sequence")
+    if not swap_ok:
+        bad = {cell: c["hot_swap"] for cell, c in cells.items()}
+        errors.append(f"hot-swap zero-downtime gate failed: {bad}")
+    if quiet:
+        slow = {
+            cell: c["speedup_vs_live"] for cell, c in cells.items()
+            if c["speedup_vs_live"] is None or c["speedup_vs_live"] < 3.0}
+        if slow:
+            errors.append(
+                f"replay did not beat the cadence-paced live loop 3x "
+                f"on a quiet host: {slow}")
+    else:
+        result["speed_gate"] = "gate_inert: loaded host"
+    if errors:
+        result["error"] = "; ".join(errors)
+    return result
+
+
 def phase_predictor_fleet() -> dict:
     """Batched-Predictor smoke + latency-SLO gate (ISSUE 5): the
     window-re-scan serving path multiplexed onto the fleet runtime
@@ -2375,6 +2591,7 @@ _PHASES = {
     "torch": phase_torch,
     "tpu_export": phase_tpu_export,
     "replay": phase_replay,
+    "replay_throughput": phase_replay_throughput,
     "longctx_sp": phase_longctx_sp,
     "runtime_fleet_smoke": phase_runtime_fleet,
     "predictor_fleet_smoke": phase_predictor_fleet,
@@ -2814,6 +3031,7 @@ def main() -> None:
         ("multiticker", 420.0),
         ("serving", 300.0),
         ("runtime_fleet_smoke", 240.0),
+        ("replay_throughput", 300.0),
         ("predictor_fleet_smoke", 300.0),
         ("runtime_multihost_smoke", 420.0),
         ("runtime_chaos_soak", 600.0),

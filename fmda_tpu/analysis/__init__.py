@@ -48,6 +48,9 @@ rule id                   severity    contract
                                       ops/dispatch.py) compile through
                                       obs.device.tracked_jit, never raw
                                       jax.jit/pjit
+``virtual-clock``         error       replay/ modules pace and order on the
+                                      virtual clock only; wall-clock reads
+                                      are annotated telemetry sites
 ========================  ==========  =========================================
 
 Entry points: ``python -m fmda_tpu lint`` (exit 0 = clean vs baseline,
@@ -88,6 +91,7 @@ from fmda_tpu.analysis.sarif import to_sarif
 from fmda_tpu.analysis.threads import ThreadLifecycleRule
 from fmda_tpu.analysis.topics import BusTopicRule
 from fmda_tpu.analysis.tracked_jit import TrackedJitRule
+from fmda_tpu.analysis.virtual_clock import VirtualClockRule
 
 __all__ = [
     "DEFAULT_BASELINE",
@@ -120,6 +124,7 @@ __all__ = [
     "SpanClockRule",
     "ThreadLifecycleRule",
     "TrackedJitRule",
+    "VirtualClockRule",
     "WireProtocolRule",
     "to_sarif",
 ]
@@ -144,6 +149,7 @@ def default_rules(*, drift: bool = True):
         WireProtocolRule(),
         ThreadLifecycleRule(),
         TrackedJitRule(),
+        VirtualClockRule(),
     ]
     if drift:
         rules.append(JaxApiDriftRule())

@@ -800,6 +800,87 @@ def cmd_chaos_pipeline(args) -> int:
     return 0 if out["gates_ok"] else 1
 
 
+def _replay_swap_params(args, cfg):
+    """The --hot-swap checkpoint: the worker-model stack re-initialised
+    from a shifted seed — same tree structure and leaf shapes (a hot
+    swap must not change the compiled program), observably different
+    weights (post-swap probes prove the new checkpoint serves)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.models import build_model
+
+    model_cfg = dataclasses.replace(
+        cfg.model, bidirectional=False, dropout=0.0,
+        hidden_size=args.hidden, n_features=cfg.features.n_features,
+        cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
+    window = args.window if args.window is not None else cfg.runtime.window
+    return build_model(model_cfg).init(
+        {"params": jax.random.PRNGKey(args.seed + 1)},
+        jnp.zeros((1, window, model_cfg.n_features)))["params"]
+
+
+def _run_replay(target, cfg, args, *, warehouse=None, swap_params=None,
+                is_router=False, extra_on_round=None):
+    """The --replay load: a max-speed virtual-clock backfill through
+    the target's unmodified submit/pump surface (fmda_tpu.replay;
+    docs/replay.md) instead of the cadence-shaped synthetic load.  With
+    ``swap_params`` the checkpoint lands halfway through the backfill —
+    straight into a solo gateway, or broadcast to every live worker
+    through the router — without dropping a session."""
+    from fmda_tpu.replay import (
+        ReplayDriver, SyntheticHistory, WarehouseHistory,
+    )
+
+    rc = cfg.replay
+    n_features = cfg.features.n_features
+    if rc.source == "warehouse":
+        if warehouse is None:
+            from fmda_tpu.stream.warehouse import Warehouse
+
+            warehouse = Warehouse(cfg.features, cfg.warehouse)
+        source = WarehouseHistory(
+            warehouse, rc.n_tickers, n_features=n_features,
+            start_ts=rc.start_ts, end_ts=rc.end_ts, chunk=rc.chunk)
+    else:
+        source = SyntheticHistory(
+            rc.n_tickers, rc.n_rounds, n_features,
+            seed=rc.seed, duty=rc.duty, step_s=rc.step_s)
+    # halfway for the synthetic source; best effort for a warehouse
+    # backfill (its round count is only known once the rows stream)
+    swap_at = max(1, rc.n_rounds // 2)
+    tenant_classes, tenant_weights = _tenant_mix(args)
+    swapped: dict = {}
+
+    def on_round(r):
+        if swap_params is not None and not swapped and r + 1 >= swap_at:
+            if is_router:
+                told = target.broadcast_hot_swap(swap_params)
+                swapped.update({"round": r + 1, "workers_told": told})
+            else:
+                version = target.hot_swap(swap_params)
+                swapped.update({"round": r + 1,
+                                "weights_version": version})
+        if extra_on_round is not None:
+            extra_on_round(r)
+
+    driver = ReplayDriver(
+        target, source,
+        tenant_classes=tenant_classes, tenant_weights=tenant_weights,
+        seed=rc.seed,
+        # a router encodes per link itself; the dialect round-trip is
+        # the solo gateway's stand-in for those bytes
+        wire_dialect=(None if is_router else rc.wire_dialect),
+        on_round=on_round)
+    out = driver.run()
+    out["replay"] = {"source": rc.source, "n_tickers": rc.n_tickers}
+    if swapped:
+        out["hot_swap"] = swapped
+    return out
+
+
 def _cmd_fleet_local(args) -> int:
     """serve-fleet --role local: the single-command topology — spawn
     router (inline) + N worker processes, drive the synthetic fleet
@@ -856,19 +937,37 @@ def _cmd_fleet_local(args) -> int:
 
     tenant_classes, tenant_weights = _tenant_mix(args)
     try:
-        out = run_fleet_load(topo.router, FleetLoadConfig(
-            n_sessions=args.sessions, n_ticks=args.ticks,
-            duty=args.duty, seed=args.seed,
-            storm_every=args.storm_every,
-            storm_fraction=args.storm_fraction,
-            burst_every=args.burst_every,
-            burst_rounds=args.burst_rounds,
-            slow_fraction=args.slow_fraction,
-            slow_duty=args.slow_duty,
-            tenant_classes=tenant_classes,
-            tenant_weights=tenant_weights),
-            on_round=(on_round if telemetry is not None
-                      or plane is not None else None))
+        if args.replay:
+            out = _run_replay(
+                topo.router, cfg, args,
+                swap_params=(_replay_swap_params(args, cfg)
+                             if args.hot_swap else None),
+                is_router=True,
+                extra_on_round=(on_round if telemetry is not None
+                                or plane is not None else None))
+            if args.hot_swap:
+                # the router's view of who acked which version — the
+                # zero-downtime proof is spread == 0 with sessions intact
+                fleet = topo.router.summary()
+                out.setdefault("hot_swap", {})
+                out["hot_swap"]["weights_versions"] = fleet.get(
+                    "weights_versions")
+                out["hot_swap"]["weights_version_spread"] = fleet.get(
+                    "weights_version_spread")
+        else:
+            out = run_fleet_load(topo.router, FleetLoadConfig(
+                n_sessions=args.sessions, n_ticks=args.ticks,
+                duty=args.duty, seed=args.seed,
+                storm_every=args.storm_every,
+                storm_fraction=args.storm_fraction,
+                burst_every=args.burst_every,
+                burst_rounds=args.burst_rounds,
+                slow_fraction=args.slow_fraction,
+                slow_duty=args.slow_duty,
+                tenant_classes=tenant_classes,
+                tenant_weights=tenant_weights),
+                on_round=(on_round if telemetry is not None
+                          or plane is not None else None))
         if telemetry is not None:
             telemetry.collect(topo.router)  # final fold before teardown
     finally:
@@ -925,6 +1024,18 @@ def cmd_serve_fleet(args) -> int:
     (fmda_tpu.fleet; docs/multihost.md): a router fronting N worker
     processes over the cross-process bus, with session routing,
     membership, and live migration."""
+    if args.replay and args.role not in ("solo", "local"):
+        print("--replay drives a solo gateway or the local topology; "
+              "use --role solo or --role local", file=sys.stderr)
+        return 2
+    if args.hot_swap and not args.replay:
+        print("--hot-swap lands mid-backfill; it needs --replay",
+              file=sys.stderr)
+        return 2
+    if args.replay and args.predictor:
+        print("--replay serves carried-state sessions; it composes "
+              "with --cell, not --predictor", file=sys.stderr)
+        return 2
     if args.role == "worker":
         return _cmd_fleet_worker(args)
     if args.role == "broker":
@@ -961,7 +1072,8 @@ def cmd_serve_fleet(args) -> int:
     else:
         overrides = {
             k: v for k, v in dict(
-                capacity=max(args.sessions, cfg.runtime.capacity),
+                capacity=max(args.sessions, cfg.runtime.capacity,
+                             cfg.replay.n_tickers if args.replay else 0),
                 max_linger_ms=args.max_linger_ms,
                 queue_bound=args.queue_bound,
                 window=args.window,
@@ -1043,18 +1155,27 @@ def cmd_serve_fleet(args) -> int:
                        model_cfg.n_features)))["params"]
 
         gateway = app.attach_fleet(model_cfg, params)
-        load_cfg = FleetLoadConfig(
-            n_sessions=args.sessions,
-            n_ticks=args.ticks, duty=args.duty, seed=args.seed,
-            storm_every=args.storm_every,
-            storm_fraction=args.storm_fraction,
-            burst_every=args.burst_every,
-            burst_rounds=args.burst_rounds,
-            slow_fraction=args.slow_fraction,
-            slow_duty=args.slow_duty)
+        if args.replay:
+            swap_params = (_replay_swap_params(args, cfg)
+                           if args.hot_swap else None)
 
-        def run_load():
-            return run_fleet_load(gateway, load_cfg)
+            def run_load():
+                return _run_replay(gateway, cfg, args,
+                                   warehouse=app.warehouse,
+                                   swap_params=swap_params)
+        else:
+            load_cfg = FleetLoadConfig(
+                n_sessions=args.sessions,
+                n_ticks=args.ticks, duty=args.duty, seed=args.seed,
+                storm_every=args.storm_every,
+                storm_fraction=args.storm_fraction,
+                burst_every=args.burst_every,
+                burst_rounds=args.burst_rounds,
+                slow_fraction=args.slow_fraction,
+                slow_duty=args.slow_duty)
+
+            def run_load():
+                return run_fleet_load(gateway, load_cfg)
     if args.metrics_port is not None:
         server = app.observability.start_server(port=args.metrics_port)
         print(f"metrics endpoint: {server.url}/metrics "
@@ -1157,6 +1278,9 @@ def _print_status(snapshot: dict, health: dict,
     perf = _perf_summary(snapshot)
     if perf:
         _print_perf_summary(perf)
+    replay = _replay_summary(snapshot)
+    if replay:
+        _print_replay_summary(replay)
     for kind in ("counters", "gauges"):
         samples = sorted(snapshot.get(kind, []), key=key)
         if samples:
@@ -1244,6 +1368,41 @@ def _print_perf_summary(perf: dict) -> None:
     if perf.get("memory_leak_suspected"):
         parts.append("LEAK SUSPECTED")
     print("perf: " + " | ".join(parts))
+
+
+def _replay_summary(snapshot: dict) -> dict:
+    """The replay section of ``status`` — present only while a backfill
+    is active (the driver's ``replay_active`` gauge).  Reads any prefix
+    vocabulary (``runtime_``/``router_``/``worker_``), like
+    :func:`_perf_summary`."""
+    out: dict = {}
+    for s in snapshot.get("gauges", []):
+        name = s["name"]
+        for base in ("replay_active", "replay_rows_per_s",
+                     "replay_virtual_watermark",
+                     "replay_max_ticker_lag_s"):
+            if name == base or name.endswith("_" + base):
+                out[base] = max(float(s["value"]), out.get(base, 0.0))
+    if out.get("replay_active", 0.0) <= 0.0:
+        return {}
+    return out
+
+
+def _print_replay_summary(replay: dict) -> None:
+    from datetime import datetime, timezone
+
+    parts = ["backfill active"]
+    if "replay_rows_per_s" in replay:
+        parts.append(f"{replay['replay_rows_per_s']:,.0f} rows/s")
+    wm = replay.get("replay_virtual_watermark")
+    if wm:
+        stamp = datetime.fromtimestamp(
+            wm, tz=timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        parts.append(f"virtual watermark {stamp}")
+    if "replay_max_ticker_lag_s" in replay:
+        parts.append(
+            f"max ticker lag {replay['replay_max_ticker_lag_s']:.0f}s")
+    print("replay: " + " | ".join(parts))
 
 
 def _print_control(control: dict) -> None:
@@ -1876,6 +2035,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "tenant_classes configures the policy); "
                         "composable with --burst-every/--storm-every/"
                         "--slow-fraction")
+    p.add_argument("--replay", action="store_true",
+                   help="--role solo/local: historical backfill — serve "
+                        "the [replay] config section's history source "
+                        "(seeded synthetic or warehouse bulk reads) "
+                        "through the unmodified serving path at max "
+                        "speed on a virtual clock (the rows' own "
+                        "timestamps; no wall-clock pacing), instead of "
+                        "the cadence-shaped synthetic load "
+                        "(docs/replay.md)")
+    p.add_argument("--hot-swap", action="store_true",
+                   help="with --replay: land a fresh-seed checkpoint "
+                        "into the live fleet halfway through the "
+                        "backfill — zero dropped sessions, zero "
+                        "recompiles; results carry weights_version "
+                        "from the swap barrier on")
     p.add_argument("--chaos-plan", default=None, metavar="FILE",
                    help="--role local: run the chaos soak under this "
                         "fault-plan JSON (fmda_tpu.chaos.FaultPlan; "

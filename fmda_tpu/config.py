@@ -932,6 +932,62 @@ class ControlConfig:
 
 
 @dataclass(frozen=True)
+class ReplayConfig:
+    """Historical-replay knobs (fmda_tpu.replay; docs/replay.md).
+
+    A replay run backfills history through the **unmodified** serving
+    path at max speed on a virtual clock (the rows' own timestamps —
+    never the host clock; the ``virtual-clock`` lint rule pins that).
+    These knobs pick the history source and bound the run; the serving
+    side needs nothing — replay sessions are ordinary gateway sessions.
+    """
+
+    #: History source: ``"synthetic"`` (seeded generator — bit-identical
+    #: re-iteration, no warehouse needed) or ``"warehouse"`` (bulk
+    #: chunked reads via ``Warehouse.iter_row_chunks``).
+    source: str = "synthetic"
+    #: Tickers (= replay sessions) the backfill drives.
+    n_tickers: int = 8
+    #: Rounds served when ``source="synthetic"``.
+    n_rounds: int = 256
+    #: Seed for the synthetic generator and tenant assignment.
+    seed: int = 0
+    #: Fraction of tickers active per synthetic round (1.0 = lockstep,
+    #: the composition the bit-identity gate requires).
+    duty: float = 1.0
+    #: Virtual seconds between synthetic rounds (the virtual clock's
+    #: step; also the implied live cadence replay deletes).
+    step_s: float = 60.0
+    #: Warehouse row-range bounds (timestamp strings; None = unbounded)
+    #: when ``source="warehouse"``.
+    start_ts: Optional[str] = None
+    end_ts: Optional[str] = None
+    #: Rows per keyset-paginated warehouse read.
+    chunk: int = 4096
+    #: Wire dialect blocks round-trip through before serving: None
+    #: (in-process), ``"binary"`` or ``"json"`` — identity must hold on
+    #: all three (solo gateways only; a fleet router encodes per link).
+    wire_dialect: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.source not in ("synthetic", "warehouse"):
+            raise ValueError(
+                f"replay.source must be 'synthetic' or 'warehouse', "
+                f"got {self.source!r}")
+        if self.wire_dialect not in (None, "binary", "json"):
+            raise ValueError(
+                f"replay.wire_dialect must be null, 'binary' or 'json', "
+                f"got {self.wire_dialect!r}")
+        if self.n_tickers < 1 or self.n_rounds < 1 or self.chunk < 1:
+            raise ValueError(
+                f"replay.n_tickers/n_rounds/chunk must be >= 1, got "
+                f"{self.n_tickers}/{self.n_rounds}/{self.chunk}")
+        if not 0.0 < self.duty <= 1.0:
+            raise ValueError(
+                f"replay.duty must be in (0, 1], got {self.duty}")
+
+
+@dataclass(frozen=True)
 class SessionConfig:
     """Ingestion-session driver knobs (ref: producer.py:257-263)."""
 
@@ -965,6 +1021,7 @@ class FrameworkConfig:
     profiling: ProfilingConfig = field(default_factory=ProfilingConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     control: ControlConfig = field(default_factory=ControlConfig)
+    replay: ReplayConfig = field(default_factory=ReplayConfig)
 
     def __post_init__(self) -> None:
         if self.model.n_features is None:
@@ -1001,6 +1058,7 @@ _SECTIONS = {
     "profiling": ProfilingConfig,
     "chaos": ChaosConfig,
     "control": ControlConfig,
+    "replay": ReplayConfig,
 }
 
 

@@ -80,7 +80,12 @@ from fmda_tpu.config import (
 from fmda_tpu.stream import codec
 from fmda_tpu.fleet.hashring import OwnershipTable
 from fmda_tpu.fleet.membership import GOODBYE, HEARTBEAT, HELLO, MembershipView
-from fmda_tpu.fleet.state import encode_norm, encode_row, to_legacy_msgs
+from fmda_tpu.fleet.state import (
+    encode_norm,
+    encode_param_tree,
+    encode_row,
+    to_legacy_msgs,
+)
 from fmda_tpu.obs.trace import default_tracer, now_ns
 from fmda_tpu.runtime.metrics import RuntimeMetrics
 
@@ -218,6 +223,13 @@ class FleetRouter:
         #: arrays or the pre-v2 shapes — on a shared broker the
         #: router's own link format says nothing about the consumer
         self._peer_wire: Dict[str, int] = {}
+        #: last hot-swap version this router broadcast (bumped per
+        #: broadcast unless the caller pins one)
+        self._swap_version = 0
+        #: worker -> weights_version it last acked (``weights_swapped``
+        #: control messages) — the fleet's mixed-version window is the
+        #: spread of these values, surfaced in :meth:`summary`
+        self._worker_weights: Dict[str, int] = {}
         #: ``from_end=True`` is the RESTART posture (router failover,
         #: docs/chaos.md): skip the control topic's history — replaying
         #: hours-old hellos would resurrect dead workers at receipt-time
@@ -976,6 +988,14 @@ class FleetRouter:
             adopted = self._adopt_sessions(wid, msg.get("sessions"))
             if adopted:
                 self._rebalance(f"adopted {adopted} sessions from {wid}")
+        elif kind == "weights_swapped":
+            # hot-swap ack: the worker's gateway is now serving this
+            # version — the spread across workers IS the fleet's
+            # mixed-version window (summary surfaces min/max)
+            wid = msg.get("worker")
+            if wid:
+                self._worker_weights[wid] = int(msg.get("version", 0))
+            self.metrics.count("hot_swaps_acked")
         elif kind == "leaving":
             self.request_leave(msg.get("worker"))
         elif kind == "open_failed":
@@ -1078,6 +1098,36 @@ class FleetRouter:
             })
         if live:
             self.metrics.count("retunes_broadcast")
+        return len(live)
+
+    def broadcast_hot_swap(
+        self, params, *, version: Optional[int] = None,
+    ) -> int:
+        """Land a new checkpoint into every live worker's gateway —
+        zero dropped sessions fleet-wide (docs/replay.md "Hot swap").
+
+        ``params`` is the checkpoint tree (numpy/array leaves; this
+        process never imports jax — the worker casts on arrival).  The
+        version is pinned here so every worker lands the SAME stamp:
+        FIFO inbox ordering then bounds each worker's mixed-version
+        window to the one flush in flight when the swap message lands,
+        and each acks with a ``weights_swapped`` control message the
+        fleet summary aggregates.  Returns how many workers were told.
+        """
+        tree = encode_param_tree(params)
+        self._swap_version = (version if version is not None
+                              else self._swap_version + 1)
+        live = self.membership.live()
+        for wid in live:
+            self._enqueue(wid, {
+                "kind": "hot_swap",
+                "params": tree,
+                "version": int(self._swap_version),
+                "wire": 2,
+            })
+        if live:
+            self.metrics.count("hot_swaps_broadcast")
+            self.metrics.gauge("weights_version", float(self._swap_version))
         return len(live)
 
     def _maybe_release_leaving(self) -> None:
@@ -1289,9 +1339,17 @@ class FleetRouter:
         return out
 
     def summary(self) -> Dict[str, object]:
-        return {
+        out = {
             **self.metrics.summary(),
             "table_version": self.table.version,
             "workers": self.membership.live(),
             "worker_stats": self.worker_stats(),
         }
+        if self._worker_weights:
+            versions = [self._worker_weights.get(w, 0)
+                        for w in self.membership.live()]
+            out["weights_versions"] = dict(self._worker_weights)
+            # 0 spread = no mixed-version window open anywhere
+            out["weights_version_spread"] = (
+                (max(versions) - min(versions)) if versions else 0)
+        return out

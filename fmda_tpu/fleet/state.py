@@ -126,6 +126,33 @@ def decode_norm(msg: Optional[dict]):
         decode_array(msg["x_min"]), decode_array(msg["x_max"]))
 
 
+def encode_param_tree(tree):
+    """A checkpoint params tree (nested dicts/lists with array leaves)
+    -> wire form: structure preserved, every leaf a contiguous array
+    (raw on binary links; :func:`to_legacy` lowers per-link on JSON
+    fallbacks).  numpy-only on purpose — the router broadcasts hot
+    swaps without ever importing jax."""
+    if isinstance(tree, dict):
+        return {k: encode_param_tree(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [encode_param_tree(v) for v in tree]
+    return encode_array(np.asarray(tree))
+
+
+def decode_param_tree(tree):
+    """Wire form -> params tree.  A dict is a structure node unless it
+    is the legacy ``{"d", "sh", "b"}`` base64 envelope — the only dict
+    shape :func:`decode_array` accepts — so pre-v2 lowered trees decode
+    to the same leaves bit-exact."""
+    if isinstance(tree, dict):
+        if set(tree.keys()) == {"d", "sh", "b"}:
+            return decode_array(tree)
+        return {k: decode_param_tree(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [decode_param_tree(v) for v in tree]
+    return decode_array(tree)
+
+
 def encode_session_state(state: dict) -> dict:
     """:meth:`FleetGateway.export_session` output -> wire form."""
     out = {

@@ -33,6 +33,7 @@ from fmda_tpu.stream import codec
 from fmda_tpu.fleet.membership import Heartbeater
 from fmda_tpu.fleet.state import (
     decode_norm,
+    decode_param_tree,
     decode_row,
     decode_session_state,
     encode_array,
@@ -244,6 +245,12 @@ class FleetWorker:
                 # the QoS class survives router failover with the rest
                 # of the session truth this report rebuilds
                 out[sid]["tenant"] = tenant
+            if self.gateway.weights_version is not None:
+                # which checkpoint generation served this session last —
+                # makes mixed-version windows visible in the report a
+                # failover rebuilds from (pre-swap reports stay
+                # byte-identical: the key only appears after a swap)
+                out[sid]["weights_version"] = self.gateway.weights_version
         if legacy is None:
             legacy = self._control_is_json()
         if out and legacy:
@@ -276,6 +283,11 @@ class FleetWorker:
                 1 if self._memory.leak_suspected else 0),
             "device_mfu": self._ledger.mfu(),
         }
+        if self.gateway.weights_version is not None:
+            # the beat carries the serving checkpoint generation, so
+            # the router-side summary can report the fleet's version
+            # spread without an extra round trip
+            out["weights_version"] = self.gateway.weights_version
         # per-class admit/shed attribution (fmda_tpu.control QoS): the
         # gateway counts these in this process; the beat carries them so
         # the control plane can fold fleet-wide per-tenant rates
@@ -570,6 +582,8 @@ class FleetWorker:
             self.gateway.retune(
                 max_linger_ms=float(linger) if linger is not None else None,
                 bucket_cap=int(cap) if cap is not None else None)
+        elif kind == "hot_swap":
+            self._on_hot_swap(msg)
         # lint: ignore[wire-protocol] operator entry point: published by hand (or tooling) onto a worker inbox — nothing in the package produces it by design
         elif kind == "leave":
             # operator-initiated graceful leave: tell the router, which
@@ -584,6 +598,33 @@ class FleetWorker:
             log.warning(
                 "worker %s: unknown inbox message kind %r",
                 self.worker_id, kind)
+
+    def _on_hot_swap(self, msg: dict) -> None:
+        """Land a router-broadcast checkpoint into the live gateway.
+
+        The gateway's swap barrier publishes every old-weights result
+        before the version flips, and FIFO inbox ordering means every
+        tick already queued behind this message is served by the new
+        weights — the worker's mixed-version window is exactly the one
+        flush in flight at swap time.  A refused checkpoint (structure
+        or shape drift) is counted and logged, never fatal: serving the
+        old weights beats serving nothing."""
+        try:
+            params = decode_param_tree(msg["params"])
+            version = self.gateway.hot_swap(
+                params, version=msg.get("version"))
+        except Exception as e:  # noqa: BLE001 — loss-free: a bad
+            # checkpoint must degrade to "swap refused, old weights
+            # keep serving", visibly, never crash the serving loop
+            self.metrics.count("hot_swap_errors")
+            log.error(
+                "worker %s: hot swap refused: %s", self.worker_id, e)
+            return
+        self._publish_control_counted({
+            "kind": "weights_swapped",
+            "worker": self.worker_id,
+            "version": int(version),
+        })
 
     def _publish_control_counted(self, msg: dict) -> bool:
         """Control-topic publish with the transport failure absorbed

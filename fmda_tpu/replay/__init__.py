@@ -1,0 +1,32 @@
+"""Fleet-scale historical replay (docs/replay.md).
+
+Streams warehoused (or seeded synthetic) history for N tickers through
+the *unmodified* FleetGateway/SessionPool serving path at max speed — a
+**deterministic virtual clock** advances with the rows themselves, so
+the only speed limit is the pipeline, and the same row sequence produces
+bit-identical probabilities whether it arrives as a cadence-paced live
+feed or a full-throttle backfill.  The identity gate is the foundation:
+every backtest run through :class:`ReplayDriver` is simultaneously an
+end-to-end benchmark of the serving tier and a bit-exact replica of what
+live serving would have published.
+
+Wall-clock reads are banned from this package's pacing and ordering
+paths by the ``virtual-clock`` analysis rule (annotated telemetry sites
+excepted) — determinism is checked, not hoped for.
+"""
+
+from fmda_tpu.replay.driver import ReplayDriver
+from fmda_tpu.replay.history import (
+    ReplayBatch,
+    SyntheticHistory,
+    WarehouseHistory,
+)
+from fmda_tpu.replay.reference import run_live_reference
+
+__all__ = [
+    "ReplayBatch",
+    "ReplayDriver",
+    "SyntheticHistory",
+    "WarehouseHistory",
+    "run_live_reference",
+]

@@ -1,0 +1,234 @@
+"""The replay driver: virtual-clock max-speed backfill through the
+live serving path (docs/replay.md).
+
+One :class:`ReplayDriver` run is the whole story of the tentpole: read
+a history source round by round, coalesce each round into the existing
+columnar tick block (``stream/codec.pack_ticks`` — optionally
+round-tripped through the binary or JSON wire dialect, so a backfill
+exercises the exact bytes a fleet link would carry), feed it to the
+**unmodified** gateway ``submit``/``pump`` surface, and force-flush —
+no linger, no cadence, no wall-clock pacing.  The virtual clock is the
+rows' own timestamps; the host clock appears only at annotated
+telemetry sites (rows/s), never in pacing or ordering — the
+``virtual-clock`` lint rule checks exactly that.
+
+The driver speaks the same duck-typed gateway surface as
+:func:`fmda_tpu.runtime.loadgen.run_fleet_load`: a solo in-process
+:class:`~fmda_tpu.runtime.gateway.FleetGateway` (codec round-trip
+applied here, mirroring what a fleet worker decodes) or a
+:class:`~fmda_tpu.fleet.router.FleetRouter` fronting the spawned
+topology (the router coalesces into blocks itself — same path, one
+layer down).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from fmda_tpu.runtime.loadgen import FleetLoadConfig, assign_tenants
+from fmda_tpu.stream import codec
+
+
+def open_replay_sessions(
+    gateway,
+    source,
+    *,
+    tenant_classes: tuple = (),
+    tenant_weights: tuple = (),
+    seed: int = 0,
+) -> List[str]:
+    """Open one gateway session per source ticker — loadgen's naming
+    (``T0000``…) and, when a tenant mix is configured, loadgen's own
+    :func:`~fmda_tpu.runtime.loadgen.assign_tenants` over the ticker
+    universe, so QoS/capacity A/Bs run against replay load exactly as
+    they run against synthetic load.  Shared by the replay driver and
+    the cadence-paced live reference (identical admission is half of
+    the identity gate)."""
+    n = source.n_tickers
+    session_ids = [f"T{i:04d}" for i in range(n)]
+    tenants = assign_tenants(
+        FleetLoadConfig(
+            n_sessions=n, tenant_classes=tuple(tenant_classes),
+            tenant_weights=tuple(tenant_weights)),
+        np.random.default_rng(seed))
+    norms = getattr(source, "norms", None)
+    for i, sid in enumerate(session_ids):
+        norm = norms[i] if norms is not None else None
+        if tenants is None:
+            gateway.open_session(sid, norm)
+        else:
+            gateway.open_session(sid, norm, tenant=tenants[i])
+    return session_ids
+
+
+class ReplayDriver:
+    """Drive one backfill through a gateway at max speed.
+
+    ``wire_dialect`` (solo gateways only): ``None`` hands decoded
+    blocks straight over; ``"binary"``/``"json"`` round-trips every
+    block through that wire dialect first — the bit-identity tests run
+    both, because a backfill's bytes must decode to the same floats a
+    live fleet link delivers.  ``collect`` keeps every
+    :class:`~fmda_tpu.runtime.gateway.FleetResult` on ``.results`` for
+    identity comparison (off for long backfills — it is O(rows)
+    memory).
+    """
+
+    def __init__(
+        self,
+        gateway,
+        source,
+        *,
+        tenant_classes: tuple = (),
+        tenant_weights: tuple = (),
+        seed: int = 0,
+        wire_dialect: Optional[str] = None,
+        collect: bool = False,
+        on_round=None,
+    ) -> None:
+        if wire_dialect not in (None, "binary", "json"):
+            raise ValueError(
+                f"wire_dialect must be None, 'binary' or 'json', "
+                f"got {wire_dialect!r}")
+        self.gateway = gateway
+        self.source = source
+        self.tenant_classes = tuple(tenant_classes)
+        self.tenant_weights = tuple(tenant_weights)
+        self.seed = seed
+        self.wire_dialect = wire_dialect
+        self.collect = collect
+        self.on_round = on_round
+        self.results: List = []
+        #: per-ticker virtual timestamp of the last dispatched row
+        self._ticker_ts: Optional[np.ndarray] = None
+        self._watermark = 0.0
+
+    # -- progress observability (obs gauges; `status` renders these) -----
+
+    def _publish_progress(self, rows: int, wall_s: float) -> None:
+        m = self.gateway.metrics
+        m.gauge("replay_rows_per_s",
+                rows / wall_s if wall_s > 0 else 0.0)
+        m.gauge("replay_virtual_watermark", self._watermark)
+        if self._ticker_ts is not None:
+            seen = self._ticker_ts[self._ticker_ts > 0.0]
+            lag = (self._watermark - float(seen.min())) if seen.size else 0.0
+            m.gauge("replay_max_ticker_lag_s", lag)
+
+    # -- the backfill loop ----------------------------------------------
+
+    def run(self) -> Dict:
+        gateway = self.gateway
+        source = self.source
+        pool = getattr(gateway, "pool", None)
+        session_ids = open_replay_sessions(
+            gateway, source, tenant_classes=self.tenant_classes,
+            tenant_weights=self.tenant_weights, seed=self.seed)
+        self._ticker_ts = np.zeros(len(session_ids), np.float64)
+        seqs = [0] * len(session_ids)
+        binary = self.wire_dialect == "binary"
+
+        m = gateway.metrics
+        m.gauge("replay_active", 1.0)
+        submitted = 0
+        served = 0
+        rounds = 0
+        virtual_start: Optional[float] = None
+        # telemetry only — rows/s against the host clock; the virtual
+        # clock below never reads it
+        # lint: ignore[virtual-clock] wall time measures throughput telemetry, never pacing/ordering
+        t0 = time.perf_counter()
+        try:
+            for batch in source:
+                if virtual_start is None:
+                    virtual_start = batch.virtual_ts
+                self._watermark = max(self._watermark, batch.virtual_ts)
+                msgs = []
+                for k, ti in enumerate(batch.tickers):
+                    ti = int(ti)
+                    msgs.append({
+                        "kind": "tick",
+                        "session": session_ids[ti],
+                        "row": batch.rows[k],
+                        "seq": seqs[ti],
+                    })
+                    seqs[ti] += 1
+                    self._ticker_ts[ti] = batch.virtual_ts
+                if pool is not None and len(msgs) >= codec.MIN_BLOCK_TICKS:
+                    # solo gateway: coalesce the round into ONE columnar
+                    # block — the same bytes a fleet worker would decode
+                    wire_msgs = [codec.pack_ticks(msgs)]
+                else:
+                    wire_msgs = msgs
+                if self.wire_dialect is not None:
+                    wire_msgs = [
+                        codec.decode_payload(
+                            codec.encode_payload(w, binary=binary))[0]
+                        for w in wire_msgs]
+                for w in wire_msgs:
+                    if w.get("kind") == "tick_block":
+                        ticks = codec.iter_ticks(w)
+                    else:
+                        ticks = [(w["session"], w["row"], w["seq"], None)]
+                    for sid, row, _seq, _trace in ticks:
+                        while gateway.saturated:
+                            # well-behaved producer under backpressure:
+                            # drain instead of racing the shedder; the
+                            # yield lets a multi-host router's bus
+                            # threads run — backpressure, not pacing
+                            drained = gateway.pump(force=True)
+                            served += self._keep(drained)
+                            if not drained and gateway.saturated:
+                                # lint: ignore[virtual-clock] GIL yield under router backpressure — the virtual clock never reads it
+                                time.sleep(0.002)
+                        gateway.submit(sid, np.asarray(row))
+                        submitted += 1
+                served += self._keep(gateway.pump(force=True))
+                rounds += 1
+                m.count("replay_rows", len(msgs))
+                if rounds % 32 == 0:
+                    # lint: ignore[virtual-clock] telemetry read for the rows/s gauge only
+                    now = time.perf_counter()
+                    self._publish_progress(submitted, now - t0)
+                if self.on_round is not None:
+                    self.on_round(rounds - 1)
+            served += self._keep(gateway.drain())
+        finally:
+            m.gauge("replay_active", 0.0)
+        # lint: ignore[virtual-clock] telemetry read for the final throughput summary only
+        wall_s = time.perf_counter() - t0
+        self._publish_progress(submitted, wall_s)
+
+        summary = gateway.metrics.summary()
+        watermark = self._watermark
+        seen = self._ticker_ts[self._ticker_ts > 0.0]
+        out = {
+            "sessions": len(session_ids),
+            "rounds": rounds,
+            "rows_replayed": submitted,
+            "ticks_served": served,
+            "wall_s": round(wall_s, 3),
+            "rows_per_s": round(submitted / wall_s, 1) if wall_s > 0
+            else None,
+            "ticks_per_s": round(served / wall_s, 1) if wall_s > 0
+            else None,
+            "virtual_start_epoch": virtual_start,
+            "virtual_watermark_epoch": watermark,
+            "virtual_span_s": round(watermark - virtual_start, 3)
+            if virtual_start is not None else 0.0,
+            "max_ticker_lag_s": round(
+                watermark - float(seen.min()), 3) if seen.size else 0.0,
+            "compile_count": pool.compile_count if pool is not None
+            else None,
+            "wire_dialect": self.wire_dialect,
+            **summary,
+        }
+        return out
+
+    def _keep(self, results) -> int:
+        if self.collect and results:
+            self.results.extend(results)
+        return len(results)

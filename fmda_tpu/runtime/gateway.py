@@ -188,6 +188,16 @@ class FleetGateway:
         #: router has proven itself v2 (ISSUE 13; in-process consumers
         #: of the prediction topic keep the per-tick shape).
         self.result_blocks = False
+        #: checkpoint generation serving the pool — ``None`` until the
+        #: first :meth:`hot_swap` (results and reports stay byte-shaped
+        #: exactly as before any swap); stamped into every published
+        #: result and session report afterwards so mixed-version windows
+        #: are observable (docs/replay.md "Hot swap")
+        self.weights_version: Optional[int] = None
+        #: results completed by a hot-swap barrier outside pump — handed
+        #: to the caller on the next pump/drain so in-process consumers
+        #: (no bus) never lose the old-weights flush
+        self._barrier_results: List[FleetResult] = []
         self._flush_idx = 0
 
     # -- admission ----------------------------------------------------------
@@ -259,6 +269,30 @@ class FleetGateway:
                 self.batcher.config, max_linger_s=max_linger_ms / 1e3)
         self.batcher.bucket_cap = bucket_cap
         self.metrics.count("retunes_applied")
+
+    def hot_swap(self, params, *, version: Optional[int] = None) -> int:
+        """Land a new checkpoint into the live pool — zero dropped
+        sessions, zero recompiles (docs/replay.md "Hot swap").
+
+        The one ordering obligation is the **swap barrier**: a flush
+        dispatched under the old weights must publish before the version
+        flips, or an old-weights result would carry the new stamp.  So
+        the in-flight pipeline stage (if any) is completed here, its
+        results published under the old version; everything still queued
+        in the batcher dispatches after the rebind and is served by the
+        new weights.  Returns the new ``weights_version`` (caller-pinned
+        via ``version``, else monotonically bumped from 1).
+        """
+        if self._inflight is not None:
+            prev, self._inflight = self._inflight, None
+            self._barrier_results.extend(self._complete_counted(prev))
+        self.pool.swap_weights(params)
+        self.weights_version = (
+            int(version) if version is not None
+            else (self.weights_version or 0) + 1)
+        self.metrics.count("hot_swaps_applied")
+        self.metrics.gauge("weights_version", float(self.weights_version))
+        return self.weights_version
 
     def _sessions_changed(self) -> None:
         self.metrics.gauge("active_sessions", self.pool.n_active)
@@ -448,6 +482,10 @@ class FleetGateway:
         same-call contract (the bit-identical A/B reference).
         """
         results: List[FleetResult] = []
+        if self._barrier_results:
+            # old-weights results completed by a hot-swap barrier since
+            # the last pump — already published; hand them to the caller
+            results, self._barrier_results = self._barrier_results, []
         dispatched_any = False
         try:
             while True:
@@ -624,6 +662,8 @@ class FleetGateway:
                         "pred_labels": list(labels),
                         "prob_threshold": self.threshold,
                     }
+                    if self.weights_version is not None:
+                        msg["weights_version"] = self.weights_version
                     # the tick's context in-band, so downstream
                     # consumers stitch into the same trace; an incoming
                     # wire (multi-host router) is forwarded even when

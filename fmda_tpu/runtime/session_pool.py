@@ -475,6 +475,43 @@ class SessionPool:
         return (self._params, self._carry, self._ring, self._pos,
                 self._x_min, self._x_range)
 
+    def swap_weights(self, params) -> None:
+        """Land a new checkpoint into the live pool without touching a
+        single session.
+
+        ``params`` is the first argument of the jitted step and is *not*
+        donated, so the swap is a pure host-side rebind: cast the new
+        tree to the pool dtype, re-place it on the replicated sharding
+        when the pool is sharded, and point ``self._params`` at it.  The
+        next flush serves the new weights; carried state, rings, norms,
+        and slot bookkeeping are untouched, and because the tree
+        structure and every leaf shape are validated against the serving
+        tree the jit cache hits — zero recompiles, zero dropped
+        sessions.  Structure or shape drift raises ``ValueError`` (a
+        silent recompile storm is worse than a refused swap).
+        """
+        dtype = self._dtype
+        old_leaves, old_treedef = jax.tree.flatten(self._params)
+        raw_leaves, new_treedef = jax.tree.flatten(params)
+        # structure first, cast second: a malformed checkpoint must be
+        # refused as ValueError before any leaf touches the dtype lattice
+        if new_treedef != old_treedef:
+            raise ValueError(
+                "swap_weights: checkpoint tree structure differs from the "
+                f"serving tree ({new_treedef} vs {old_treedef})")
+        new_leaves = [jnp.asarray(a).astype(dtype) for a in raw_leaves]
+        new = jax.tree.unflatten(new_treedef, new_leaves)
+        for old, fresh in zip(old_leaves, new_leaves):
+            if old.shape != fresh.shape:
+                raise ValueError(
+                    "swap_weights: leaf shape mismatch "
+                    f"{fresh.shape} vs serving {old.shape} — a hot swap "
+                    "must not change the compiled program")
+        if self._repl_sharding is not None:
+            new = jax.tree.map(
+                lambda a: jax.device_put(a, self._repl_sharding), new)
+        self._params = new
+
     # -- the hot path -------------------------------------------------------
 
     def step_device(self, slots: np.ndarray, rows: np.ndarray):

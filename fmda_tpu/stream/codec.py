@@ -539,7 +539,11 @@ def pack_results(msgs: Sequence[dict], label_vocab: Sequence[str]) -> dict:
     result's label set as a bitmask over ``label_vocab`` — the
     gateway's ``y_fields``, whose order IS the per-tick label order, so
     decode reproduces the exact label lists.  The threshold is uniform
-    per flush and stored once."""
+    per flush and stored once, as is the optional ``weights_version``
+    a hot-swapping gateway stamps into its results — a run straddling
+    a swap barrier mixes versions and is *not* packable (the gateway
+    falls back to per-tick messages, which is exactly what bounds the
+    mixed-version window to one flush)."""
     probs = np.asarray(
         [m["probabilities"] for m in msgs], np.float32)
     vid = {lab: j for j, lab in enumerate(label_vocab)}
@@ -552,6 +556,7 @@ def pack_results(msgs: Sequence[dict], label_vocab: Sequence[str]) -> dict:
     seqs: List[int] = []
     masks: List[int] = []
     threshold = float(msgs[0]["prob_threshold"])
+    weights_version = msgs[0].get("weights_version")
     for m in msgs:
         s = m["session"]
         j = uniq.get(s)
@@ -563,6 +568,9 @@ def pack_results(msgs: Sequence[dict], label_vocab: Sequence[str]) -> dict:
         if float(m["prob_threshold"]) != threshold:
             raise CodecError(
                 "result run mixes prob_threshold values — not packable")
+        if m.get("weights_version") != weights_version:
+            raise CodecError(
+                "result run mixes weights_version values — not packable")
         mask = 0
         for lab in m["pred_labels"]:
             bit = vid.get(lab)
@@ -581,6 +589,8 @@ def pack_results(msgs: Sequence[dict], label_vocab: Sequence[str]) -> dict:
         "masks": np.asarray(masks, np.int64),
         "prob_threshold": threshold,
     }
+    if weights_version is not None:
+        block["weights_version"] = int(weights_version)
     traces = [m.get("trace") for m in msgs]
     if any(t is not None for t in traces):
         block["traces"] = traces
@@ -598,6 +608,7 @@ def iter_results(block: dict) -> Iterator[dict]:
     masks = np.asarray(block["masks"]).tolist()
     vocab = list(block["labels"])
     threshold = block["prob_threshold"]
+    weights_version = block.get("weights_version")
     traces = block.get("traces")
     for i, j in enumerate(idx):
         msg = {
@@ -608,6 +619,8 @@ def iter_results(block: dict) -> Iterator[dict]:
                 lab for b, lab in enumerate(vocab) if masks[i] >> b & 1],
             "prob_threshold": threshold,
         }
+        if weights_version is not None:
+            msg["weights_version"] = weights_version
         if traces is not None and traces[i] is not None:
             msg["trace"] = traces[i]
         yield msg

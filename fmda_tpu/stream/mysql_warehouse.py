@@ -344,6 +344,56 @@ class MySQLWarehouse:
             "ORDER BY ID DESC LIMIT %s;", (int(limit),))
         return [r[0] for r in self._cursor.fetchall()]
 
+    def iter_row_chunks(
+        self,
+        start_ts: Optional[str] = None,
+        end_ts: Optional[str] = None,
+        chunk: int = 4096,
+    ):
+        """Bulk history reader — the embedded backend's contract
+        (:meth:`fmda_tpu.stream.warehouse.Warehouse.iter_row_chunks`)
+        over a keyset-paginated MySQL ``SELECT``: ``WHERE ID > last``
+        + ``ORDER BY ID LIMIT chunk`` per page, so a backfill over a
+        large landed table never materialises an unbounded result set
+        and never re-scans from offset 0 (OFFSET pagination is O(n²)
+        over the scan).  Yields the raw landed columns as
+        ``(timestamps, (B, F) float64)`` — bit-for-bit what the
+        embedded backend yields for the same landed rows (tests
+        assert parity through the fake server)."""
+        import numpy as np
+
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        cols = self.features.table_columns()
+        col_list = ", ".join(f"`{c}`" for c in cols)
+        conds = ["ID > %s"]
+        bounds: list = []
+        if start_ts is not None:
+            conds.append("Timestamp >= %s")
+            bounds.append(start_ts)
+        if end_ts is not None:
+            conds.append("Timestamp <= %s")
+            bounds.append(end_ts)
+        where = " AND ".join(conds)
+        last_id = 0
+        while True:
+            self._cursor.execute(
+                f"SELECT ID, Timestamp, {col_list} "
+                f"FROM {self.config.table_name} "
+                f"WHERE {where} ORDER BY ID LIMIT %s;",
+                (last_id, *bounds, int(chunk)),
+            )
+            rows = self._cursor.fetchall()
+            if not rows:
+                return
+            last_id = int(rows[-1][0])
+            matrix = np.asarray(
+                [r[2:] for r in rows], np.float64
+            ).reshape(len(rows), len(cols))
+            yield [r[1] or "" for r in rows], matrix
+            if len(rows) < chunk:
+                return
+
     def healthy(self) -> bool:
         """Probe that the server still answers — the ``/healthz``
         warehouse check, same contract as the embedded backend."""

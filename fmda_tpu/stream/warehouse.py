@@ -19,7 +19,7 @@ from __future__ import annotations
 import sqlite3
 import threading
 import time as _time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -230,6 +230,57 @@ class Warehouse:
                 ts_list,
             ).fetchall()
         return {r[0]: tuple(r[1:]) for r in rows}
+
+    def iter_row_chunks(
+        self,
+        start_ts: Optional[str] = None,
+        end_ts: Optional[str] = None,
+        chunk: int = 4096,
+    ) -> Iterator[Tuple[List[str], np.ndarray]]:
+        """Bulk history reader: the landed table in ID order as
+        ``(timestamps, (B, F) float64 matrix)`` chunks — ONE keyset-
+        paginated range query per chunk, never a per-timestamp lookup.
+        The replay driver streams backfills through this, and the
+        trainer's chunked loading can ride the same reader.
+
+        Values are the raw landed columns (the same bit-identity
+        surface as :meth:`raw_rows_for`): both warehouse backends must
+        hand back identical bits for the same landed rows — tests
+        assert embedded-vs-MySQL chunk parity bit-for-bit.  ``start_ts``
+        / ``end_ts`` bound the scan by the lexicographic timestamp
+        column (inclusive both ends); the lock is held per chunk, not
+        across the whole scan, so ingest keeps landing while a backfill
+        reads.  Rows landing behind the cursor mid-scan are picked up;
+        this is a reader, not a snapshot."""
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        cols = ", ".join(_quote(c) for c in self._columns)
+        conds = ["ID > ?"]
+        bounds: List[Any] = []
+        if start_ts is not None:
+            conds.append("Timestamp >= ?")
+            bounds.append(start_ts)
+        if end_ts is not None:
+            conds.append("Timestamp <= ?")
+            bounds.append(end_ts)
+        where = " AND ".join(conds)
+        last_id = 0
+        while True:
+            with self._lock:
+                rows = self._conn.execute(
+                    f"SELECT ID, Timestamp, {cols} FROM {self.table} "
+                    f"WHERE {where} ORDER BY ID LIMIT ?",
+                    (last_id, *bounds, int(chunk)),
+                ).fetchall()
+            if not rows:
+                return
+            last_id = int(rows[-1][0])
+            matrix = np.asarray(
+                [r[2:] for r in rows], np.float64
+            ).reshape(len(rows), len(self._columns))
+            yield [r[1] or "" for r in rows], matrix
+            if len(rows) < chunk:
+                return
 
     def has_timestamp(self, ts: str) -> bool:
         """Point-indexed existence check — the engine's dedupe fallback

@@ -99,6 +99,34 @@ class _Cursor:
         if upper.startswith("SELECT COUNT(ID)"):
             self._result = [(len(s.join_rows),)]
             return
+        if upper.startswith("SELECT ID, TIMESTAMP"):
+            # iter_row_chunks keyset page: WHERE ID > %s [.. Timestamp
+            # bounds ..] ORDER BY ID LIMIT %s over the landed rows
+            # (IDs are autoincrement = arrival order, 1-based)
+            if "WHERE ID > %s" not in stmt:
+                raise AssertionError(
+                    f"chunk page without keyset predicate: {stmt[:80]}")
+            if "ORDER BY ID LIMIT %s" not in stmt:
+                raise AssertionError(
+                    f"chunk page without ORDER BY ID LIMIT: {stmt[:80]}")
+            params = list(params)
+            last_id = int(params.pop(0))
+            start_ts = params.pop(0) if "Timestamp >= %s" in stmt else None
+            end_ts = params.pop(0) if "Timestamp <= %s" in stmt else None
+            limit = int(params.pop(0))
+            page = []
+            for rid, (ts, values) in enumerate(s.landed, start=1):
+                if rid <= last_id:
+                    continue
+                if start_ts is not None and ts < start_ts:
+                    continue
+                if end_ts is not None and ts > end_ts:
+                    continue
+                page.append((rid, ts) + tuple(values))
+                if len(page) == limit:
+                    break
+            self._result = page
+            return
         if upper.startswith("SELECT SD.ID,"):
             self._serve(stmt, s.join_rows, "sd.ID")
             return

@@ -66,6 +66,7 @@ class TestPhaseRegistry:
             "multiticker", "serving", "torch",
             "tpu_export",
             "replay",
+            "replay_throughput",
             "runtime_fleet_smoke",
             "predictor_fleet_smoke",
             "runtime_multihost_smoke",
@@ -92,6 +93,16 @@ class TestPhaseRegistry:
             "counted-loss", "wire-protocol", "thread-lifecycle"}
         assert set(bench.NEVER_ABORT_RULES) <= set(
             rule_catalog(drift=False))
+
+    def test_replay_throughput_artifact_schema_pinned(self):
+        """ISSUE 18 phase-change pin: artifacts/replay_throughput.json
+        carries per-cell rows/s, the bit-identity verdict, and the
+        hot-swap zero-downtime accounting under exactly these keys —
+        downstream dashboards read the artifact, so a key rename must
+        update this pin (and the readers) in the same PR."""
+        assert tuple(sorted(bench.REPLAY_THROUGHPUT_SCHEMA)) == (
+            "buckets", "cadence_s", "cells", "hot_swap", "identity_ok",
+            "quiet_host", "rounds", "tickers")
 
     def test_kernel_sweep_and_fleet_ab_cover_the_ssm_family(self):
         """ISSUE 14 phase-change pin: the kernel sweep races the SSM

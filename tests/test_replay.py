@@ -1,0 +1,568 @@
+"""fmda_tpu.replay — virtual-clock backfill through the live serving
+path, and the zero-downtime checkpoint hot swap (ISSUE 18).
+
+The two headline contracts, each pinned bit-exactly:
+
+* **Replay identity** — a history replayed at max speed on the virtual
+  clock (no wall-clock pacing, rounds coalesced into columnar tick
+  blocks, optionally round-tripped through the binary/JSON wire
+  dialects) publishes byte-for-byte the probabilities the cadence-paced
+  live loop publishes over the same row sequence, for every carried-
+  state cell family.
+* **Hot swap** — landing a new checkpoint into a live gateway/fleet
+  drops zero sessions, recompiles nothing after warmup, and splits the
+  result stream exactly at the swap barrier: results published under
+  the old weights are never stamped with the new ``weights_version``,
+  and post-barrier results come from the new weights.
+
+Plus the bulk history readers (``Warehouse.iter_row_chunks`` keyset
+pagination, embedded vs MySQL bit-for-bit), the ``[replay]`` config
+section, tenant-labeled replay sessions, and the ``virtual-clock``
+analysis rule.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import fake_mysql
+from fmda_tpu.config import (
+    FeatureConfig,
+    ModelConfig,
+    ReplayConfig,
+    TOPIC_FLEET_PREDICTION,
+    WarehouseConfig,
+)
+from fmda_tpu.models import build_model
+from fmda_tpu.replay import (
+    ReplayDriver,
+    SyntheticHistory,
+    WarehouseHistory,
+    run_live_reference,
+)
+from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+from fmda_tpu.stream.bus import InProcessBus
+
+FEATS, WINDOW, HIDDEN = 6, 4, 5
+
+
+def _setup(feats=FEATS, hidden=HIDDEN, window=WINDOW, seed=0, cell="gru"):
+    cfg = ModelConfig(hidden_size=hidden, n_features=feats, output_size=4,
+                      dropout=0.0, bidirectional=False, use_pallas=False,
+                      cell=cell)
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(seed)},
+        jnp.zeros((1, window, feats)))["params"]
+    return cfg, params
+
+
+def _gateway(cfg, params, *, capacity=8, buckets=(8,), bus=None):
+    pool = SessionPool(cfg, params, capacity=capacity, window=WINDOW)
+    gateway = FleetGateway(
+        pool, bus,
+        batcher_config=BatcherConfig(bucket_sizes=buckets,
+                                     max_linger_s=0.001))
+    for b in buckets:
+        pool.step(np.full(b, pool.padding_slot, np.int32),
+                  np.zeros((b, cfg.n_features), np.float32))
+    assert pool.compile_count == len(buckets)
+    pool.mark_warm()
+    return gateway, pool
+
+
+def _sorted(results):
+    return sorted(results, key=lambda r: (r.session_id, r.seq))
+
+
+# ---------------------------------------------------------------------------
+# history sources
+# ---------------------------------------------------------------------------
+
+
+def test_synthetic_history_reiterates_bit_identical():
+    src = SyntheticHistory(4, 6, FEATS, seed=3, duty=0.6)
+    a, b = list(src), list(src)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.virtual_ts == y.virtual_ts
+        assert np.array_equal(x.tickers, y.tickers)
+        assert np.array_equal(x.rows, y.rows)
+
+
+def test_synthetic_history_virtual_clock_is_data_not_host_time():
+    src = SyntheticHistory(2, 3, FEATS, start_epoch=1000.0, step_s=60.0)
+    assert [b.virtual_ts for b in src] == [1060.0, 1120.0, 1180.0]
+
+
+def test_warehouse_history_groups_rounds_and_advances_virtual_clock():
+    from fmda_tpu.stream.warehouse import Warehouse
+
+    fc = FeatureConfig()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    width = len(fc.table_columns())
+    rng = np.random.default_rng(0)
+    wh.insert_rows([
+        {"Timestamp": f"2020-01-02 09:30:{i:02d}",
+         **{f: float(rng.normal()) for f in fc.table_columns()}}
+        for i in range(23)])
+    src = WarehouseHistory(wh, 4, n_features=width, chunk=5)
+    batches = list(src)
+    # 23 rows / 4 tickers -> 5 full rounds + a 3-row tail
+    assert [len(b.tickers) for b in batches] == [4, 4, 4, 4, 4, 3]
+    assert sum(len(b.tickers) for b in batches) == 23
+    # virtual time is the rows' own timestamps, monotone per round
+    ts = [b.virtual_ts for b in batches]
+    assert ts == sorted(ts)
+    # re-iteration replays the same rows bit-for-bit
+    again = list(src)
+    for x, y in zip(batches, again):
+        assert np.array_equal(x.rows, y.rows)
+
+
+def test_warehouse_history_width_mismatch_raises():
+    from fmda_tpu.stream.warehouse import Warehouse
+
+    fc = FeatureConfig()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    rng = np.random.default_rng(0)
+    wh.insert_rows([
+        {"Timestamp": "2020-01-02 09:30:00",
+         **{f: float(rng.normal()) for f in fc.table_columns()}}])
+    src = WarehouseHistory(wh, 2, n_features=3)
+    with pytest.raises(ValueError, match="row_transform"):
+        list(src)
+
+
+# ---------------------------------------------------------------------------
+# bulk chunked reads: keyset pagination, embedded vs MySQL bit-for-bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mysql_env(monkeypatch):
+    fake_mysql.SERVER = fake_mysql.FakeServer()
+    monkeypatch.setitem(sys.modules, "mysql", fake_mysql)
+    monkeypatch.setitem(sys.modules, "mysql.connector", fake_mysql.connector)
+    yield fake_mysql.SERVER
+
+
+def _both_warehouses(mysql_env):
+    from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse
+    from fmda_tpu.stream.warehouse import Warehouse
+
+    fc = FeatureConfig()
+    emb = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    myw = MySQLWarehouse(fc, WarehouseConfig(backend="mysql"))
+    rng = np.random.default_rng(11)
+    rows = [
+        {"Timestamp": f"2020-01-02 09:30:{i:02d}",
+         **{f: float(rng.normal()) for f in fc.table_columns()}}
+        for i in range(17)]
+    emb.insert_rows(rows)
+    myw.insert_rows(rows)
+    return emb, myw
+
+
+@pytest.mark.parametrize("chunk", [3, 7, 100])
+def test_iter_row_chunks_embedded_vs_mysql_bit_for_bit(mysql_env, chunk):
+    emb, myw = _both_warehouses(mysql_env)
+    a = list(emb.iter_row_chunks(chunk=chunk))
+    b = list(myw.iter_row_chunks(chunk=chunk))
+    assert len(a) == len(b) > 0
+    for (ts_a, rows_a), (ts_b, rows_b) in zip(a, b):
+        assert ts_a == ts_b
+        assert rows_a.dtype == rows_b.dtype == np.float64
+        assert np.array_equal(rows_a, rows_b)
+    # page sizes: every page full except possibly the last
+    sizes = [len(ts) for ts, _ in a]
+    assert all(s == chunk for s in sizes[:-1])
+    assert sum(sizes) == 17
+
+
+def test_iter_row_chunks_timestamp_bounds(mysql_env):
+    emb, myw = _both_warehouses(mysql_env)
+    lo, hi = "2020-01-02 09:30:05", "2020-01-02 09:30:11"
+    for wh in (emb, myw):
+        got = [t for ts, _ in wh.iter_row_chunks(
+            start_ts=lo, end_ts=hi, chunk=4) for t in ts]
+        assert got == [f"2020-01-02 09:30:{i:02d}" for i in range(5, 12)]
+
+
+def test_iter_row_chunks_rejects_bad_chunk():
+    from fmda_tpu.stream.warehouse import Warehouse
+
+    wh = Warehouse(FeatureConfig(), WarehouseConfig(path=":memory:"))
+    with pytest.raises(ValueError):
+        next(wh.iter_row_chunks(chunk=0))
+
+
+# ---------------------------------------------------------------------------
+# replay identity: max-speed backfill == cadence-paced live, bit for bit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cell", ["gru", "ssm"])
+@pytest.mark.parametrize("dialect", [None, "binary", "json"])
+def test_replay_bit_identical_to_live_serving(cell, dialect):
+    cfg, params = _setup(cell=cell)
+    source = SyntheticHistory(6, 10, FEATS, seed=2)
+
+    gw_r, _ = _gateway(cfg, params)
+    driver = ReplayDriver(gw_r, source, wire_dialect=dialect, collect=True)
+    summary = driver.run()
+
+    gw_l, _ = _gateway(cfg, params)
+    live = run_live_reference(gw_l, source, collect=True)
+
+    a, b = _sorted(driver.results), _sorted(live["results"])
+    assert len(a) == len(b) == 60
+    for x, y in zip(a, b):
+        assert (x.session_id, x.seq) == (y.session_id, y.seq)
+        assert x.probabilities.tobytes() == y.probabilities.tobytes()
+        assert x.labels == y.labels
+    assert summary["rows_replayed"] == 60
+    assert summary["ticks_served"] == 60
+    assert summary["compile_count"] == 1  # no replay-induced recompile
+
+
+def test_replay_driver_rejects_unknown_dialect():
+    cfg, params = _setup()
+    gw, _ = _gateway(cfg, params)
+    with pytest.raises(ValueError, match="wire_dialect"):
+        ReplayDriver(gw, SyntheticHistory(2, 2, FEATS), wire_dialect="xml")
+
+
+def test_replay_progress_series_and_virtual_watermark():
+    cfg, params = _setup()
+    source = SyntheticHistory(4, 40, FEATS, seed=0, duty=0.5,
+                              start_epoch=1000.0, step_s=60.0)
+    gw, _ = _gateway(cfg, params)
+    driver = ReplayDriver(gw, source, collect=True)
+    out = driver.run()
+    # the backfill announces itself while running, and clears the flag
+    assert gw.metrics.gauges["replay_active"] == 0.0
+    assert gw.metrics.counters["replay_rows"] == out["rows_replayed"]
+    assert gw.metrics.gauges["replay_virtual_watermark"] == \
+        out["virtual_watermark_epoch"]
+    # virtual clock: watermark is the data's last round, host-free
+    assert out["virtual_watermark_epoch"] == 1000.0 + 40 * 60.0
+    assert out["virtual_span_s"] > 0
+    # ragged duty leaves some tickers behind the watermark
+    assert out["max_ticker_lag_s"] >= 0.0
+
+
+def test_replay_sessions_reuse_tenant_assignment():
+    from fmda_tpu.runtime.loadgen import FleetLoadConfig, assign_tenants
+
+    cfg, params = _setup()
+    gw, _ = _gateway(cfg, params)
+    source = SyntheticHistory(6, 2, FEATS, seed=0)
+    driver = ReplayDriver(gw, source, tenant_classes=("gold", "std"),
+                          tenant_weights=(1.0, 2.0), seed=5, collect=True)
+    driver.run()
+    # the same assign_tenants draw loadgen uses, over the ticker universe
+    expected = assign_tenants(
+        FleetLoadConfig(n_sessions=6, tenant_classes=("gold", "std"),
+                        tenant_weights=(1.0, 2.0)),
+        np.random.default_rng(5))
+    for i in range(6):
+        state = gw.export_session(f"T{i:04d}")
+        assert state["tenant"] == expected[i]
+
+
+# ---------------------------------------------------------------------------
+# hot swap: solo gateway
+# ---------------------------------------------------------------------------
+
+
+def test_swap_weights_is_a_pure_rebind_with_zero_recompiles():
+    cfg, params = _setup(seed=0)
+    _, params2 = _setup(seed=9)
+    gw, pool = _gateway(cfg, params)
+    gw.open_session("S", None)
+    row = np.random.default_rng(0).normal(size=FEATS).astype(np.float32)
+    gw.submit("S", row)
+    before = gw.pump(force=True)[0]
+    version = gw.hot_swap(params2)
+    assert version == 1 and gw.weights_version == 1
+    gw.submit("S", row)
+    after = gw.pump(force=True)[0]
+    # same session, same row, new weights: the probabilities moved
+    assert not np.array_equal(before.probabilities, after.probabilities)
+    assert pool.recompiles_after_warmup == 0
+    assert pool.compile_count == 1
+
+
+def test_swap_weights_rejects_structure_and_shape_drift():
+    cfg, params = _setup()
+    gw, pool = _gateway(cfg, params)
+    with pytest.raises(ValueError):
+        pool.swap_weights({"not": {"the": "tree"}})
+    wide_cfg, wide_params = _setup(hidden=HIDDEN + 1)
+    with pytest.raises(ValueError, match="compiled program"):
+        pool.swap_weights(wide_params)
+
+
+def test_hot_swap_mid_replay_zero_drop_and_exact_seq_split():
+    """The swap barrier, seq-exact: results with seq < swap round are
+    byte-equal to a swap-free run and carry NO weights_version on the
+    wire; results with seq >= swap round are stamped version 1 and come
+    from the new weights.  No session drops, no tick is lost, nothing
+    recompiles."""
+    cfg, params = _setup()
+    _, params2 = _setup(seed=9)
+    tickers, rounds, swap_at = 6, 12, 6
+    source = SyntheticHistory(tickers, rounds, FEATS, seed=4)
+
+    # reference: the same backfill, never swapped
+    gw_ref, _ = _gateway(cfg, params)
+    ref = ReplayDriver(gw_ref, source, collect=True)
+    ref.run()
+
+    bus = InProcessBus((TOPIC_FLEET_PREDICTION,))
+    gw, pool = _gateway(cfg, params, bus=bus)
+    swapped = {}
+
+    def on_round(r):
+        if not swapped and r + 1 >= swap_at:
+            swapped["version"] = gw.hot_swap(params2)
+
+    driver = ReplayDriver(gw, source, collect=True, on_round=on_round)
+    out = driver.run()
+    assert swapped["version"] == 1
+
+    # zero drop: every (session, seq) served exactly once, contiguous
+    a, c = _sorted(ref.results), _sorted(driver.results)
+    assert len(c) == tickers * rounds
+    assert out["ticks_served"] == tickers * rounds
+    for i in range(tickers):
+        seqs = [r.seq for r in c if r.session_id == f"T{i:04d}"]
+        assert seqs == list(range(rounds))
+
+    # the barrier splits the stream exactly at the swap round (lockstep
+    # duty=1.0 makes seq == round index)
+    for x, y in zip(a, c):
+        if y.seq < swap_at:
+            assert x.probabilities.tobytes() == y.probabilities.tobytes()
+    assert any(not np.array_equal(x.probabilities, y.probabilities)
+               for x, y in zip(a, c) if y.seq >= swap_at)
+
+    # wire accounting: old-weights results are never stamped with the
+    # new version — version appears exactly from the swap barrier on
+    published = [m.value for m in bus.read(TOPIC_FLEET_PREDICTION, 0)]
+    assert len(published) == tickers * rounds
+    for msg in published:
+        if msg["seq"] < swap_at:
+            assert "weights_version" not in msg
+        else:
+            assert msg["weights_version"] == 1
+    assert pool.recompiles_after_warmup == 0
+
+
+def test_result_blocks_carry_weights_version_or_split():
+    from fmda_tpu.stream import codec
+
+    msgs = [{"session": f"T{i}", "seq": 0,
+             "probabilities": [0.1, 0.9, 0.2, 0.3],
+             "pred_labels": ["a"], "prob_threshold": 0.5,
+             "weights_version": 3} for i in range(4)]
+    block = codec.pack_results(msgs, ("a", "b", "c", "d"))
+    assert block["weights_version"] == 3
+    back = codec.iter_results(block)
+    assert all(m["weights_version"] == 3 for m in back)
+    # a run straddling the barrier mixes versions: not packable, the
+    # per-tick fallback bounds the mixed-version window
+    msgs[2]["weights_version"] = 4
+    with pytest.raises(codec.CodecError, match="weights_version"):
+        codec.pack_results(msgs, ("a", "b", "c", "d"))
+
+
+# ---------------------------------------------------------------------------
+# hot swap: fleet-wide broadcast
+# ---------------------------------------------------------------------------
+
+
+def _fleet_hot_swap_run(wire=None):
+    from test_fleet import _cycle, _setup as fleet_setup, _topology
+
+    router, workers, bus, clock, (cfg, params, rc) = _topology(
+        ["w0", "w1"], bucket_sizes=(1, 4), wire=wire)
+    rng = np.random.default_rng(0)
+    sids = [f"R{i}" for i in range(5)]
+    from fmda_tpu.data.normalize import NormParams
+
+    for sid in sids:
+        mn = rng.normal(size=6).astype(np.float32)
+        router.open_session(sid, NormParams(mn, mn + 1.0))
+    got = {}
+    for _ in range(2):
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+
+    _, params2 = fleet_setup(seed=9)
+    told = router.broadcast_hot_swap(
+        jax.tree.map(np.asarray, params2))
+    assert told == 2
+    for _ in range(3):
+        for sid in sids:
+            router.submit(sid, rng.normal(size=6).astype(np.float32))
+        _cycle(router, workers.values(), got)
+    for _ in range(3):
+        _cycle(router, workers.values(), got)
+    return router, workers, got, sids
+
+
+@pytest.mark.parametrize("wire", [None, "binary", "json"])
+def test_broadcast_hot_swap_lands_on_every_worker(wire):
+    router, workers, got, sids = _fleet_hot_swap_run(wire)
+    # every live worker applied and acked the same version
+    for w in workers.values():
+        assert w.gateway.weights_version == 1
+        assert w.metrics.counters.get("hot_swap_errors", 0) == 0
+        stats = w.stats()
+        assert stats["weights_version"] == 1
+    assert router._worker_weights == {"w0": 1, "w1": 1}
+    summary = router.summary()
+    assert summary["weights_versions"] == {"w0": 1, "w1": 1}
+    assert summary["weights_version_spread"] == 0
+    # zero dropped sessions: every stream stayed contiguous through the
+    # swap — 5 rounds served, seq 0..4 per session
+    for sid in sids:
+        assert [r.seq for r in got[sid]] == list(range(5))
+
+
+def test_worker_session_reports_carry_weights_version():
+    router, workers, _got, sids = _fleet_hot_swap_run()
+    for w in workers.values():
+        report = w.session_report()
+        owned = [sid for sid in sids if sid in report]
+        for sid in owned:
+            assert report[sid]["weights_version"] == 1
+
+
+def test_param_tree_codec_round_trips_bit_exact():
+    from fmda_tpu.fleet.state import (
+        decode_param_tree, encode_param_tree, to_legacy)
+
+    _, params = _setup(seed=3)
+    tree = encode_param_tree(params)
+    back = decode_param_tree(tree)
+    legacy_back = decode_param_tree(to_legacy(tree))
+    flat_p, _ = jax.tree.flatten(params)
+    for decoded in (back, legacy_back):
+        flat_d, _ = jax.tree.flatten(decoded)
+        assert len(flat_p) == len(flat_d)
+        for p, d in zip(flat_p, flat_d):
+            assert np.asarray(p).tobytes() == np.asarray(d).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# [replay] config section
+# ---------------------------------------------------------------------------
+
+
+def test_replay_config_validates():
+    assert ReplayConfig().source == "synthetic"
+    with pytest.raises(ValueError, match="source"):
+        ReplayConfig(source="tape")
+    with pytest.raises(ValueError, match="wire_dialect"):
+        ReplayConfig(wire_dialect="xml")
+    with pytest.raises(ValueError, match="duty"):
+        ReplayConfig(duty=0.0)
+    with pytest.raises(ValueError):
+        ReplayConfig(chunk=0)
+
+
+def test_replay_config_round_trips_through_the_config_file(tmp_path):
+    from fmda_tpu.config import (
+        FrameworkConfig, load_config, save_config)
+    import dataclasses
+
+    cfg = FrameworkConfig(replay=ReplayConfig(
+        source="warehouse", n_tickers=3, start_ts="2020-01-02 09:30:00",
+        wire_dialect="json"))
+    path = tmp_path / "deploy.json"
+    save_config(cfg, str(path))
+    back = load_config(str(path))
+    assert back.replay == cfg.replay
+
+
+# ---------------------------------------------------------------------------
+# the virtual-clock analysis rule
+# ---------------------------------------------------------------------------
+
+
+def test_virtual_clock_rule_bans_wall_clock_in_replay():
+    from fmda_tpu.analysis import VirtualClockRule
+    from test_analysis import run_on
+
+    src = (
+        "import time\n"
+        "from time import sleep as zzz\n"
+        "from datetime import datetime\n"
+        "def pace():\n"
+        "    t = time.time()\n"
+        "    time.perf_counter()\n"
+        "    zzz(0.1)\n"
+        "    datetime.now()\n"
+    )
+    findings, suppressed, _ = run_on(
+        VirtualClockRule(), {"replay/driver.py": src})
+    lines = sorted(f.line for f in findings
+                   if f.path == "replay/driver.py" and f.line)
+    assert lines == [5, 6, 7, 8]
+    assert suppressed == 0
+
+
+def test_virtual_clock_rule_honors_annotated_telemetry_sites():
+    from fmda_tpu.analysis import VirtualClockRule
+    from test_analysis import run_on
+
+    src = (
+        "import time\n"
+        "def progress():\n"
+        "    # lint: ignore[virtual-clock] rows/s telemetry only\n"
+        "    return time.perf_counter()\n"
+    )
+    findings, suppressed, _ = run_on(
+        VirtualClockRule(), {"replay/driver.py": src})
+    assert [f for f in findings if f.line] == []
+    assert suppressed == 1
+
+
+def test_virtual_clock_rule_ignores_modules_outside_replay():
+    from fmda_tpu.analysis import VirtualClockRule
+    from test_analysis import run_on
+
+    src = "import time\nt = time.time()\n"
+    findings, _, _ = run_on(
+        VirtualClockRule(),
+        {"runtime/other.py": src, "replay/__init__.py": "x = 1\n"})
+    assert findings == []
+
+
+def test_virtual_clock_rule_flags_stale_scope():
+    from fmda_tpu.analysis import VirtualClockRule
+    from test_analysis import run_on
+
+    findings, _, _ = run_on(
+        VirtualClockRule(), {"runtime/other.py": "x = 1\n"})
+    assert any("stale scope" in f.message for f in findings)
+
+
+def test_shipped_replay_package_is_clean_under_the_rule():
+    """The real fmda_tpu/replay/ modules pass the rule with every
+    wall-clock site hatched — the shipped-tree guarantee the lint gate
+    enforces, asserted here without the baseline in the way."""
+    from fmda_tpu.analysis import VirtualClockRule, collect_modules
+    from fmda_tpu.analysis.engine import run_rules
+
+    ctx = collect_modules()
+    findings, suppressed = run_rules([VirtualClockRule()], ctx)
+    assert findings == []
+    assert suppressed > 0  # the annotated telemetry sites exist
