@@ -2224,6 +2224,142 @@ def phase_trace_overhead() -> dict:
     }
 
 
+QUALITY_EVAL_SCHEMA = (
+    "sessions", "rounds", "reps", "disabled_wall_s", "enabled_wall_s",
+    "overhead_pct", "budget_pct", "quiet_host", "joined", "join_wall_s",
+    "conservation_ok", "ok",
+)
+
+
+def phase_quality_overhead() -> dict:
+    """Label-join evaluator cost on the replay serving loop (ISSUE 19):
+    the same warehoused backfill run with the quality plane off vs on,
+    interleaved, min-of-reps.  What rides the tick path is ONLY the
+    per-result capture (lock + bounded-ring insert); the label join is
+    cadence-gated onto the telemetry collection cadence, exactly like
+    SLO evaluation — so the <2% budget gates the capture overhead, and
+    the join round (one batched ``ids_for_timestamps`` +
+    ``fetch_targets`` query) is timed separately as ``join_wall_s``,
+    outside the serving loop it never runs on.  The enabled run must
+    also join predictions and close the capture conservation identity
+    (``captured == joined + expired + shed + pending``).  Artifact:
+    ``artifacts/quality_eval.json`` (``QUALITY_EVAL_SCHEMA`` top
+    level) — feed it to ``python -m fmda_tpu quality --artifact``."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from fmda_tpu.config import FeatureConfig, ModelConfig, QualityConfig
+    from fmda_tpu.data.synthetic import SyntheticMarketConfig, build_corpus
+    from fmda_tpu.models import build_model
+    from fmda_tpu.obs.quality import QualityEvaluator
+    from fmda_tpu.replay import ReplayDriver, WarehouseHistory
+    from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+
+    sessions, reps = 8, 9
+    fc = FeatureConfig()
+    wh, _ = build_corpus(fc, SyntheticMarketConfig(seed=2, n_days=3))
+    # landed table width (raw columns), not the derived x_fields view —
+    # WarehouseHistory streams raw landed rows
+    feats = len(fc.table_columns())
+    rounds = len(wh) // sessions
+    # flagship-ish serving dims: the budget is relative to a REAL tick's
+    # device+dispatch cost, not a toy cell that makes any fixed
+    # per-capture cost look enormous
+    cfg = ModelConfig(hidden_size=4 * HIDDEN, n_features=feats,
+                      output_size=CLASSES, dropout=0.0,
+                      bidirectional=False, use_pallas=False)
+    params = build_model(cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, WINDOW, feats)))["params"]
+    # the join NEVER fires inside the timed serving loop: production
+    # joins ride the telemetry collection cadence (a wall-clock
+    # interval the compressed virtual clock here would fire every
+    # round), so the loop pays only capture and the join round is
+    # priced separately below
+    qcfg = QualityConfig(join_interval_s=1e12)
+
+    state = {}
+
+    def run_once(with_quality: bool) -> float:
+        pool = SessionPool(cfg, params, capacity=sessions, window=WINDOW)
+        gateway = FleetGateway(
+            pool, None,
+            batcher_config=BatcherConfig(bucket_sizes=(sessions,),
+                                         max_linger_s=0.0))
+        pool.step(np.full(sessions, pool.padding_slot, np.int32),
+                  np.zeros((sessions, feats), np.float32))
+        pool.mark_warm()
+        quality = (QualityEvaluator(qcfg, warehouse=wh,
+                                    max_lead=fc.max_lead)
+                   if with_quality else None)
+        source = WarehouseHistory(wh, sessions, n_features=feats)
+        driver = ReplayDriver(gateway, source, seed=0, quality=quality)
+        t0 = _time.monotonic()
+        driver.run()
+        wall = _time.monotonic() - t0
+        if quality is not None:
+            t0 = _time.monotonic()
+            quality.join()  # the cadence path, timed on its own
+            state["join_wall_s"] = _time.monotonic() - t0
+            state["conservation"] = quality.conservation()
+        return wall
+
+    run_once(False)  # warm caches, both variants
+    run_once(True)
+    disabled, enabled = [], []
+    for _ in range(reps):
+        disabled.append(run_once(False))
+        enabled.append(run_once(True))
+    base, inst = min(disabled), min(enabled)
+    overhead_pct = (inst - base) / base * 100.0
+    cons = state["conservation"]
+    conservation_ok = (
+        cons["captured"]
+        == cons["joined"] + cons["expired"] + cons["shed"] + cons["pending"])
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = None
+    quiet = load1 is not None and load1 < 0.5 * (os.cpu_count() or 1)
+    result = {
+        "sessions": sessions,
+        "rounds": rounds,
+        "reps": reps,
+        "disabled_wall_s": round(base, 3),
+        "enabled_wall_s": round(inst, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "budget_pct": 2.0,
+        "quiet_host": quiet,
+        "joined": cons["joined"],
+        "join_wall_s": round(state["join_wall_s"], 4),
+        "conservation_ok": conservation_ok,
+        "ok": (conservation_ok and cons["joined"] > 0
+               and (overhead_pct < 2.0 or not quiet)),
+    }
+    assert tuple(sorted(result)) == tuple(sorted(QUALITY_EVAL_SCHEMA))
+    artifact_dir = os.path.join(_REPO_DIR, "artifacts")
+    os.makedirs(artifact_dir, exist_ok=True)
+    artifact = os.path.join(artifact_dir, "quality_eval.json")
+    with open(artifact, "w") as fh:
+        json.dump(result, fh, indent=2, default=str)
+    result["artifact"] = os.path.relpath(artifact, _REPO_DIR)
+    errors = []
+    if not conservation_ok:
+        errors.append(f"capture conservation identity broken: {cons}")
+    if cons["joined"] <= 0:
+        errors.append("label join produced zero joined predictions — "
+                      "the evaluator never scored anything")
+    if quiet and overhead_pct >= 2.0:
+        errors.append(
+            f"quality plane costs {overhead_pct:.2f}% of the replay "
+            "loop on a quiet host (budget 2%)")
+    if errors:
+        result["error"] = "; ".join(errors)
+    return result
+
+
 def phase_device_obs_overhead() -> dict:
     """Device-observability cost on the serving step seam (ISSUE 17):
     the same warmed SessionPool stepped with the whole device plane
@@ -2602,6 +2738,7 @@ _PHASES = {
     "obs_overhead": phase_obs_overhead,
     "obs_aggregate_overhead": phase_obs_aggregate_overhead,
     "trace_overhead": phase_trace_overhead,
+    "quality_overhead": phase_quality_overhead,
     "device_obs_overhead": phase_device_obs_overhead,
     "analysis_lint": phase_analysis_lint,
     "wire_codec_bench": phase_wire_codec,
@@ -3038,6 +3175,7 @@ def main() -> None:
         ("pipeline_chaos_soak", 420.0),
         ("obs_overhead", 300.0),
         ("trace_overhead", 300.0),
+        ("quality_overhead", 300.0),
         ("flagship_bf16", 300.0),
         ("flagship_wide", 300.0),
         ("train_e2e", 600.0),

@@ -26,14 +26,16 @@ one of:
 **Conservation vocabulary cross-check** (the ``topics.py`` move,
 applied to loss counters).  The gates declare which counters they sum —
 ``LOSS_COUNTERS`` in ``chaos/soak.py``, ``ROUTER_LOSS_COUNTERS`` /
-``GATEWAY_LOSS_COUNTERS`` in ``obs/aggregate.py`` — and this rule
-harvests those tuples (parsed, not imported) and checks both ways:
+``GATEWAY_LOSS_COUNTERS`` / ``QUALITY_LOSS_COUNTERS`` in
+``obs/aggregate.py`` — and this rule harvests those tuples (parsed,
+not imported) and checks both ways:
 
 - a vocabulary entry **no code ever counts** is a dead gate term (the
   identity silently weakens) — finding on the declaring line;
 - a **drop site** in a conservation-domain module (``fleet/router.py``
   for the fleet identity, ``runtime/gateway.py`` for the in-process
-  one) counting into a loss-shaped counter the gate never sums is a
+  one, ``obs/quality.py`` for the label-join capture ledger) counting
+  into a loss-shaped counter the gate never sums is a
   leak in the identity — finding at the increment, unless annotated
   (``# lint: ignore[counted-loss] reason``) for counters that are
   deliberately outside it (e.g. ``routed_ticks_lost`` pre-counts ticks
@@ -59,13 +61,14 @@ SCOPE_PREFIXES = ("fleet/", "runtime/", "stream/", "chaos/", "obs/")
 LOSS_FREE_RE = re.compile(r"loss-free:\s*(\S.*)")
 
 #: counter names that denote a discarded unit of work
-LOSS_NAME_RE = re.compile(r"lost|shed|missing|dropped")
+LOSS_NAME_RE = re.compile(r"lost|shed|missing|dropped|expired")
 
 #: modules declaring the gates' loss vocabularies: rel -> constant-name
 #: regex for the tuples to harvest there
 VOCABULARY_MODULES = {
     "chaos/soak.py": re.compile(r"^LOSS_COUNTERS$"),
-    "obs/aggregate.py": re.compile(r"^(ROUTER|GATEWAY)_LOSS_COUNTERS$"),
+    "obs/aggregate.py": re.compile(
+        r"^(ROUTER|GATEWAY|QUALITY)_LOSS_COUNTERS$"),
 }
 
 #: conservation domains: module whose counters a gate sums -> the
@@ -73,6 +76,7 @@ VOCABULARY_MODULES = {
 CONSERVATION_DOMAINS = {
     "fleet/router.py": ("LOSS_COUNTERS", "ROUTER_LOSS_COUNTERS"),
     "runtime/gateway.py": ("GATEWAY_LOSS_COUNTERS",),
+    "obs/quality.py": ("QUALITY_LOSS_COUNTERS",),
 }
 
 

@@ -211,6 +211,28 @@ def _replay_session(args, cfg, bus) -> int:
     return ticks
 
 
+def _save_quality_profile(wh, cfg, ckpt, *, max_rows: int = 4096) -> None:
+    """Persist the training-time reference profile beside the checkpoint
+    so the live drift monitor (fmda_tpu.obs.quality) has a baseline to
+    PSI-score production traffic against.  Best-effort: a profile that
+    cannot be built (degenerate data) must not fail training."""
+    from fmda_tpu.eval.drift import (
+        build_profile, profile_path_for, save_profile)
+
+    try:
+        n = len(wh)
+        ids = list(range(max(1, n - max_rows + 1), n + 1))
+        rows = wh.fetch(ids)
+        targets = wh.fetch_targets(ids) if n > cfg.features.max_lead else None
+        profile = build_profile(
+            rows, targets, bins=cfg.quality.drift_bins,
+            columns=list(wh.x_fields))
+        path = save_profile(profile_path_for(ckpt), profile)
+        print(f"drift reference profile: {path}")
+    except (ValueError, IndexError, OSError) as e:
+        print(f"drift reference profile not written: {e}", file=sys.stderr)
+
+
 def _train(wh, cfg, *, epochs, batch_size, checkpoint_dir, seed):
     """Shared by ``train`` and ``demo``; returns the checkpoint path, or
     None (after printing why) when training cannot run."""
@@ -238,6 +260,7 @@ def _train(wh, cfg, *, epochs, batch_size, checkpoint_dir, seed):
     state, history, dataset = trainer.fit(
         wh, bid_levels=fc.bid_levels, ask_levels=fc.ask_levels)
     ckpt = save_checkpoint(checkpoint_dir, state, dataset.final_norm_params)
+    _save_quality_profile(wh, cfg, ckpt)
     last = history["train"][-1]
     print(f"trained {len(history['train'])} epochs: "
           f"loss={last.loss:.4f} acc={last.accuracy:.4f} "
@@ -800,6 +823,17 @@ def cmd_chaos_pipeline(args) -> int:
     return 0 if out["gates_ok"] else 1
 
 
+def _replay_width(cfg) -> int:
+    """The feature width a replay run actually serves: a
+    warehouse-source backfill streams the RAW landed table
+    (``table_columns()`` wide, docs/replay.md), not the derived
+    x_fields view — the serving model must be sized to the rows it
+    will see."""
+    if cfg.replay.source == "warehouse":
+        return len(cfg.features.table_columns())
+    return cfg.features.n_features
+
+
 def _replay_swap_params(args, cfg):
     """The --hot-swap checkpoint: the worker-model stack re-initialised
     from a shifted seed — same tree structure and leaf shapes (a hot
@@ -814,7 +848,7 @@ def _replay_swap_params(args, cfg):
 
     model_cfg = dataclasses.replace(
         cfg.model, bidirectional=False, dropout=0.0,
-        hidden_size=args.hidden, n_features=cfg.features.n_features,
+        hidden_size=args.hidden, n_features=_replay_width(cfg),
         cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
     window = args.window if args.window is not None else cfg.runtime.window
     return build_model(model_cfg).init(
@@ -835,7 +869,7 @@ def _run_replay(target, cfg, args, *, warehouse=None, swap_params=None,
     )
 
     rc = cfg.replay
-    n_features = cfg.features.n_features
+    n_features = _replay_width(cfg)
     if rc.source == "warehouse":
         if warehouse is None:
             from fmda_tpu.stream.warehouse import Warehouse
@@ -848,6 +882,16 @@ def _run_replay(target, cfg, args, *, warehouse=None, swap_params=None,
         source = SyntheticHistory(
             rc.n_tickers, rc.n_rounds, n_features,
             seed=rc.seed, duty=rc.duty, step_s=rc.step_s)
+    quality = None
+    if cfg.quality.enabled and rc.source == "warehouse":
+        # warehoused backfills have joinable labels: ride the replay
+        # through the label-join evaluator so the run reports live
+        # per-version quality alongside throughput
+        from fmda_tpu.obs.quality import QualityEvaluator
+
+        quality = QualityEvaluator(
+            cfg.quality, warehouse=warehouse,
+            max_lead=cfg.features.max_lead)
     # halfway for the synthetic source; best effort for a warehouse
     # backfill (its round count is only known once the rows stream)
     swap_at = max(1, rc.n_rounds // 2)
@@ -873,11 +917,19 @@ def _run_replay(target, cfg, args, *, warehouse=None, swap_params=None,
         # a router encodes per link itself; the dialect round-trip is
         # the solo gateway's stand-in for those bytes
         wire_dialect=(None if is_router else rc.wire_dialect),
-        on_round=on_round)
+        on_round=on_round, quality=quality)
     out = driver.run()
     out["replay"] = {"source": rc.source, "n_tickers": rc.n_tickers}
     if swapped:
         out["hot_swap"] = swapped
+    if quality is not None:
+        quality.join()  # final join: drain whatever already has labels
+        q = quality.summary()
+        out["quality"] = {
+            "conservation": q["conservation"],
+            "overall": q["overall"],
+            "versions": q["versions"],
+        }
     return out
 
 
@@ -1028,6 +1080,14 @@ def cmd_serve_fleet(args) -> int:
         print("--replay drives a solo gateway or the local topology; "
               "use --role solo or --role local", file=sys.stderr)
         return 2
+    if args.replay and args.role == "local" and _config(
+            args).replay.source == "warehouse":
+        # spawned workers size their models from the live feature
+        # schema; a warehouse backfill streams raw landed rows
+        # (narrower) — only the solo gateway sizes itself to them
+        print("[replay] source=warehouse backfills run solo "
+              "(landed-row width); drop --role local", file=sys.stderr)
+        return 2
     if args.hot_swap and not args.replay:
         print("--hot-swap lands mid-backfill; it needs --replay",
               file=sys.stderr)
@@ -1145,7 +1205,9 @@ def cmd_serve_fleet(args) -> int:
         # sizes it)
         model_cfg = dataclasses.replace(
             cfg.model, bidirectional=False, dropout=0.0,
-            hidden_size=args.hidden, n_features=cfg.features.n_features,
+            hidden_size=args.hidden,
+            n_features=(_replay_width(cfg) if args.replay
+                        else cfg.features.n_features),
             cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
         model = build_model(model_cfg)
 
@@ -1281,6 +1343,9 @@ def _print_status(snapshot: dict, health: dict,
     replay = _replay_summary(snapshot)
     if replay:
         _print_replay_summary(replay)
+    quality = _quality_summary(snapshot)
+    if quality:
+        _print_quality_summary(quality)
     for kind in ("counters", "gauges"):
         samples = sorted(snapshot.get(kind, []), key=key)
         if samples:
@@ -1403,6 +1468,55 @@ def _print_replay_summary(replay: dict) -> None:
         parts.append(
             f"max ticker lag {replay['replay_max_ticker_lag_s']:.0f}s")
     print("replay: " + " | ".join(parts))
+
+
+def _quality_summary(snapshot: dict) -> dict:
+    """The model-quality section of ``status`` — present once the
+    label-join evaluator has published at least one joined window
+    (docs/observability.md "Model quality")."""
+    out: dict = {"versions": {}}
+    for s in snapshot.get("gauges", []):
+        name, labels = s["name"], s.get("labels", {})
+        if name == "quality_subset_accuracy":
+            v = labels.get("version", "?")
+            out["versions"].setdefault(v, {})["accuracy"] = float(s["value"])
+        elif name == "quality_hamming_loss":
+            v = labels.get("version", "?")
+            out["versions"].setdefault(v, {})["hamming"] = float(s["value"])
+        elif name == "quality_pending":
+            out["pending"] = float(s["value"])
+        elif name == "quality_drift_score":
+            out["drift"] = float(s["value"])
+    for s in snapshot.get("counters", []):
+        if s["name"] in ("quality_joined_total", "quality_join_expired_total",
+                         "quality_captures_shed_total"):
+            out[s["name"]] = out.get(s["name"], 0.0) + float(s["value"])
+    if not out["versions"] and "quality_joined_total" not in out:
+        return {}
+    return out
+
+
+def _print_quality_summary(quality: dict) -> None:
+    parts = []
+    joined = quality.get("quality_joined_total")
+    if joined is not None:
+        parts.append(f"joined {int(joined)}")
+    for v, m in sorted(quality.get("versions", {}).items()):
+        acc = m.get("accuracy")
+        ham = m.get("hamming")
+        seg = f"v{v} acc {acc:.3f}" if acc is not None else f"v{v}"
+        if ham is not None:
+            seg += f" hamming {ham:.3f}"
+        parts.append(seg)
+    if "drift" in quality:
+        parts.append(f"drift psi {quality['drift']:.3f}")
+    if quality.get("pending"):
+        parts.append(f"pending {int(quality['pending'])}")
+    expired = quality.get("quality_join_expired_total", 0.0)
+    shed = quality.get("quality_captures_shed_total", 0.0)
+    if expired or shed:
+        parts.append(f"lost {int(expired)} expired / {int(shed)} shed")
+    print("quality: " + " | ".join(parts))
 
 
 def _print_control(control: dict) -> None:
@@ -1735,6 +1849,97 @@ def cmd_perf(args) -> int:
         return 0
     _print_perf_report(doc, profile_text, top=args.top)
     return 0
+
+
+def cmd_quality(args) -> int:
+    """The model-quality report (docs/observability.md "Model
+    quality"): per-weights-version live accuracy/F-beta off the
+    label-join evaluator, drift scores vs the training-time reference
+    profile, and the capture/join conservation ledger.  Input is a
+    running endpoint's ``/quality``, a flight-recorder bundle
+    directory (its ``quality.json``), or the bench
+    ``quality_overhead`` artifact."""
+    if args.endpoint:
+        import urllib.error
+        import urllib.request
+
+        base = (args.endpoint if "://" in args.endpoint
+                else f"http://{args.endpoint}").rstrip("/")
+        try:
+            with urllib.request.urlopen(base + "/quality", timeout=10) as r:
+                doc = json.loads(r.read())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            print(f"cannot scrape {base}/quality: {e}", file=sys.stderr)
+            return 2
+    elif args.bundle:
+        path = os.path.join(args.bundle, "quality.json")
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
+    elif args.artifact:
+        try:
+            with open(args.artifact) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read {args.artifact}: {e}", file=sys.stderr)
+            return 2
+    else:
+        print("pass --endpoint HOST:PORT (a running /quality endpoint), "
+              "--bundle DIR (a flight-recorder postmortem bundle), or "
+              "--artifact FILE (the bench quality_overhead artifact)",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    _print_quality_report(doc)
+    return 0
+
+
+def _print_quality_report(doc: dict) -> None:
+    if "overhead_pct" in doc:
+        # the bench quality_overhead artifact, not an evaluator document
+        print(f"quality_overhead bench: overhead {doc['overhead_pct']:.2f}% "
+              f"(budget {doc.get('budget_pct')}%, "
+              f"quiet_host={doc.get('quiet_host')}, ok={doc.get('ok')})")
+        print(f"  joined {doc.get('joined')} over {doc.get('rounds')} rounds "
+              f"x {doc.get('sessions')} sessions")
+        return
+    if not doc.get("enabled", True):
+        print("quality evaluation disabled ([quality] enabled=false "
+              "or no evaluator attached)")
+        return
+    labels = doc.get("labels") or []
+    overall = doc.get("overall") or {}
+    beta = doc.get("beta", 0.5)
+    print(f"model quality (threshold {doc.get('threshold')}, "
+          f"F-beta beta={beta:g}, label lag {doc.get('max_lead')} rows):")
+    cons = doc.get("conservation") or {}
+    print(f"  captured {cons.get('captured', 0)} = "
+          f"joined {cons.get('joined', 0)} + expired {cons.get('expired', 0)}"
+          f" + shed {cons.get('shed', 0)} + pending {cons.get('pending', 0)}"
+          f" (join errors: {doc.get('join_errors', 0)})")
+    rows = [("overall", overall)]
+    rows += [(f"v{v}", s) for v, s in sorted(
+        (doc.get("versions") or {}).items())]
+    print(f"  {'version':<10} {'n':>7} {'accuracy':>9} {'hamming':>9} "
+          + " ".join(f"F:{label}" for label in labels))
+    for name, s in rows:
+        if not s or not s.get("n"):
+            print(f"  {name:<10} {'0':>7} {'-':>9} {'-':>9}")
+            continue
+        fbeta = " ".join(
+            f"{f:>8.3f}" for f in (s.get("fbeta") or []))
+        print(f"  {name:<10} {s['n']:>7} {s['subset_accuracy']:>9.4f} "
+              f"{s['hamming_loss']:>9.4f} {fbeta}")
+    drift = doc.get("drift")
+    if drift:
+        print(f"  drift: max PSI {drift.get('max_psi', 0.0):.4f} over "
+              f"{drift.get('rows', 0)} sampled rows "
+              f"(prediction PSI {drift.get('prediction_psi')})")
 
 
 def _print_perf_report(doc: dict, profile_text, *, top: int) -> None:
@@ -2225,6 +2430,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="machine-readable report (the device report "
                         "document, plus profile_folded when present)")
     p.set_defaults(fn=cmd_perf)
+
+    p = sub.add_parser(
+        "quality", parents=[common],
+        help="model-quality report: per-weights-version live "
+             "accuracy/F-beta, drift vs the training profile, "
+             "capture/join conservation")
+    p.add_argument("--endpoint", default=None, metavar="HOST:PORT",
+                   help="scrape a running endpoint's /quality (the "
+                        "fleet telemetry endpoint)")
+    p.add_argument("--bundle", default=None, metavar="DIR",
+                   help="read a flight-recorder postmortem bundle's "
+                        "quality.json instead")
+    p.add_argument("--artifact", default=None, metavar="FILE",
+                   help="read a bench quality_overhead artifact "
+                        "(artifacts/quality_eval.json) instead")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (the /quality "
+                        "document verbatim)")
+    p.set_defaults(fn=cmd_quality)
 
     p = sub.add_parser(
         "chaos-pipeline", parents=[common],

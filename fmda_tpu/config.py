@@ -721,12 +721,101 @@ class SLOConfig:
     #: Memory-leak objective: fraction of samples the device memory
     #: monitor's monotonic-growth heuristic may be raised.
     memory_leak_budget: float = 0.05
+    #: Quality objectives (fmda_tpu.obs.quality's label-join evaluator
+    #: writes the series; None-until-reported — a fleet without the
+    #: quality plane never fires these).  Accuracy: exact-match misses
+    #: over joined predictions stay under this fraction.
+    quality_accuracy_budget: float = 0.35
+    #: Per-label F-beta floor: fraction of sampled intervals where ANY
+    #: (version, label) F-beta gauge sits below ``quality_fbeta_floor``.
+    quality_fbeta_floor: float = 0.05
+    quality_fbeta_budget: float = 0.25
+    #: Drift: fraction of sampled intervals where the worst PSI
+    #: (feature or prediction) exceeds ``quality_drift_psi`` (0.25 is
+    #: the classic "action required" PSI threshold).
+    quality_drift_psi: float = 0.25
+    quality_drift_budget: float = 0.1
     #: Flight-recorder bundle directory; None disables postmortems.
     postmortem_dir: Optional[str] = None
     #: Rotated bundle count (oldest deleted past this).
     postmortem_keep: int = 4
     #: Debounce between bundles for one trigger reason (seconds).
     postmortem_min_interval_s: float = 60.0
+
+
+@dataclass(frozen=True)
+class QualityConfig:
+    """Online model-quality plane knobs (fmda_tpu.obs.quality;
+    docs/observability.md "Model quality").
+
+    The label-join evaluator captures published predictions into a
+    bounded ring and joins them — on a cadence, off the tick path —
+    against warehouse targets once enough future rows have landed
+    (``FeatureConfig.max_lead`` rows after a prediction's own row).
+    Streaming subset-accuracy / Hamming / per-label F-beta accumulate
+    per ``weights_version``; a PSI drift monitor scores live features
+    and predictions against the training-time reference profile saved
+    beside the checkpoint (``quality_profile.json``).
+    """
+
+    #: Master switch for the quality plane (capture + join + drift).
+    enabled: bool = True
+    #: Capture-ring capacity; overflow evicts the oldest prediction as
+    #: a counted ``quality_captures_shed`` loss, never unbounded.
+    capture_capacity: int = 4096
+    #: Label-join cadence (seconds; virtual seconds under replay).
+    join_interval_s: float = 5.0
+    #: Probability threshold for label decisions (predictions arrive as
+    #: probabilities — sigmoid already applied by the serving pool).
+    prob_threshold: float = 0.5
+    #: F-beta beta (0.5 = precision-weighted, the trainer's choice).
+    fbeta: float = 0.5
+    #: A capture still unjoinable after this many consecutive join
+    #: rounds (row shed, session gone, beyond retention) ages out as a
+    #: counted ``quality_join_expired`` loss — round-counted, so replay
+    #: runs expire deterministically with no wall clock involved.
+    max_join_attempts: int = 8
+    #: Reference-profile quantile bins (built at train time).
+    drift_bins: int = 10
+    #: Drift scores stay None (never reported) below this many observed
+    #: rows — PSI over a handful of rows is noise, not signal.
+    drift_min_samples: int = 64
+    #: Reference-profile path; None = ``quality_profile.json`` beside
+    #: the checkpoint in use.
+    profile_path: Optional[str] = None
+    #: Hot-swap guardrail (fmda_tpu.eval.shadow): a candidate may score
+    #: at most this much *below* the incumbent's shadow accuracy.
+    swap_margin: float = 0.02
+    #: Shadow-scoring replay size: rounds x sessions of recent
+    #: warehoused history per side.
+    swap_eval_rounds: int = 48
+    swap_eval_sessions: int = 4
+
+    def __post_init__(self) -> None:
+        if self.capture_capacity < 1:
+            raise ValueError(
+                f"capture_capacity must be >= 1, got {self.capture_capacity}")
+        if self.join_interval_s <= 0:
+            raise ValueError(
+                f"join_interval_s must be > 0, got {self.join_interval_s}")
+        if not 0.0 < self.prob_threshold < 1.0:
+            raise ValueError(
+                f"prob_threshold must be in (0, 1), got "
+                f"{self.prob_threshold}")
+        if self.max_join_attempts < 1:
+            raise ValueError(
+                f"max_join_attempts must be >= 1, got "
+                f"{self.max_join_attempts}")
+        if self.drift_bins < 2:
+            raise ValueError(
+                f"drift_bins must be >= 2, got {self.drift_bins}")
+        if self.swap_margin < 0:
+            raise ValueError(
+                f"swap_margin must be >= 0, got {self.swap_margin}")
+        if self.swap_eval_rounds < 1 or self.swap_eval_sessions < 1:
+            raise ValueError(
+                "swap_eval_rounds and swap_eval_sessions must be >= 1, "
+                f"got {self.swap_eval_rounds} x {self.swap_eval_sessions}")
 
 
 @dataclass(frozen=True)
@@ -1017,6 +1106,7 @@ class FrameworkConfig:
     observability: ObservabilityConfig = field(
         default_factory=ObservabilityConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
+    quality: QualityConfig = field(default_factory=QualityConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     profiling: ProfilingConfig = field(default_factory=ProfilingConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
@@ -1054,6 +1144,7 @@ _SECTIONS = {
     "fleet": FleetTopologyConfig,
     "observability": ObservabilityConfig,
     "slo": SLOConfig,
+    "quality": QualityConfig,
     "tracing": TracingConfig,
     "profiling": ProfilingConfig,
     "chaos": ChaosConfig,

@@ -1,0 +1,40 @@
+"""Model evaluation: the offline/online metric seam + drift + shadow.
+
+One numpy metric vocabulary (:mod:`fmda_tpu.eval.metrics`) shared by
+the offline trainer reports and the online label-join evaluator
+(:class:`fmda_tpu.obs.quality.QualityEvaluator`), a PSI drift monitor
+against training-time reference profiles (:mod:`fmda_tpu.eval.drift`),
+and the hot-swap quality guardrail (:mod:`fmda_tpu.eval.shadow`) that
+shadow-scores a candidate checkpoint against the incumbent over recent
+warehoused history before `broadcast_hot_swap` will land it.
+
+``metrics`` and ``drift`` are numpy-only (importable from jax-free
+router/CLI roles); ``shadow`` imports jax at use time (it builds a
+serving stack).
+"""
+
+from fmda_tpu.eval.drift import (
+    DriftMonitor,
+    build_profile,
+    load_profile,
+    profile_path_for,
+    psi,
+    save_profile,
+)
+from fmda_tpu.eval.metrics import (
+    StreamingCounts,
+    batch_counts,
+    threshold_probs,
+)
+
+__all__ = [
+    "DriftMonitor",
+    "StreamingCounts",
+    "batch_counts",
+    "build_profile",
+    "load_profile",
+    "profile_path_for",
+    "psi",
+    "save_profile",
+    "threshold_probs",
+]

@@ -1,0 +1,133 @@
+"""Hot-swap quality guardrail: shadow-score a candidate checkpoint.
+
+``FleetRouter.broadcast_hot_swap(require_eval=...)`` refuses a
+checkpoint that would regress serving quality — but the router is a
+jax-free role, so the scoring lives here: :class:`ShadowEvaluator`
+replays **recent warehoused history** (the PR-17 replay plumbing:
+:class:`~fmda_tpu.replay.WarehouseHistory` through an unmodified solo
+:class:`~fmda_tpu.runtime.gateway.FleetGateway`) under the incumbent
+and the candidate parameter trees, label-joins both prediction streams
+against the warehouse's materialized targets with the shared eval
+vocabulary, and passes the candidate iff
+
+    candidate_accuracy + swap_margin >= incumbent_accuracy
+
+Both sides replay the *same* deterministic source with the same
+sessions, so the joinable subset is identical — the comparison is
+apples to apples by construction.  A warehouse with no joinable
+history (too young, targets not yet final) cannot refuse: the verdict
+is a pass with ``"scored": false`` — blocking every swap on an empty
+warehouse would deadlock a fresh deployment.
+
+Imports jax at construction time (it builds serving stacks); construct
+it in a worker-side or CLI role and hand the router only the callable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+__all__ = ["ShadowEvaluator"]
+
+
+class ShadowEvaluator:
+    """Callable guardrail for ``broadcast_hot_swap(require_eval=...)``.
+
+    ``gate(params)`` (also ``__call__``) returns ``(ok, detail)``;
+    the incumbent's score is computed once, lazily, and reused across
+    candidate evaluations (the incumbent does not change between
+    refusals).
+    """
+
+    def __init__(
+        self,
+        incumbent_params,
+        *,
+        model_config,
+        warehouse,
+        quality_config=None,
+        max_lead: Optional[int] = None,
+        window: int = 30,
+        n_tickers: Optional[int] = None,
+        seed: int = 0,
+    ) -> None:
+        from fmda_tpu.config import FeatureConfig, QualityConfig
+
+        self.incumbent_params = incumbent_params
+        self.model_config = model_config
+        self.warehouse = warehouse
+        self.cfg = quality_config or QualityConfig()
+        self.max_lead = (int(max_lead) if max_lead is not None
+                         else FeatureConfig().max_lead)
+        self.window = int(window)
+        self.n_tickers = int(n_tickers if n_tickers is not None
+                             else self.cfg.swap_eval_sessions)
+        self.seed = int(seed)
+        self._incumbent_score: Optional[Dict] = None
+
+    # -- one side's replay + join -------------------------------------------
+
+    def score(self, params) -> Dict:
+        """Replay recent history under ``params``; return the joined
+        streaming-metric summary (``{"joined": 0}`` when no history has
+        materialized targets yet)."""
+        import dataclasses
+
+        from fmda_tpu.obs.quality import QualityEvaluator
+        from fmda_tpu.replay import ReplayDriver, WarehouseHistory
+        from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+
+        model_cfg = dataclasses.replace(
+            self.model_config, dropout=0.0, use_pallas=False)
+        rows_wanted = (self.cfg.swap_eval_rounds * self.n_tickers
+                       + self.max_lead)
+        recent = self.warehouse.recent_timestamps(rows_wanted)
+        start_ts = recent[-1] if recent else None
+        source = WarehouseHistory(
+            self.warehouse, self.n_tickers,
+            n_features=model_cfg.n_features, start_ts=start_ts)
+        pool = SessionPool(model_cfg, params, capacity=self.n_tickers,
+                           window=self.window)
+        gateway = FleetGateway(
+            pool, None,
+            batcher_config=BatcherConfig(
+                bucket_sizes=(self.n_tickers,), max_linger_s=0.0))
+        # the shadow run must expire nothing: one final join settles
+        # every capture whose targets are final, the rest stay pending
+        eval_cfg = dataclasses.replace(
+            self.cfg, capture_capacity=max(
+                self.cfg.capture_capacity,
+                self.cfg.swap_eval_rounds * self.n_tickers + 1))
+        evaluator = QualityEvaluator(
+            eval_cfg, warehouse=self.warehouse, max_lead=self.max_lead)
+        driver = ReplayDriver(
+            gateway, source, seed=self.seed, quality=evaluator)
+        driver.run()
+        evaluator.join()
+        summary = evaluator.summary()
+        out = dict(summary["overall"])
+        out["joined"] = summary["conservation"]["joined"]
+        return out
+
+    # -- the gate ------------------------------------------------------------
+
+    def gate(self, params) -> Tuple[bool, Dict]:
+        if self._incumbent_score is None:
+            self._incumbent_score = self.score(self.incumbent_params)
+        incumbent = self._incumbent_score
+        candidate = self.score(params)
+        detail: Dict = {
+            "margin": self.cfg.swap_margin,
+            "joined": candidate["joined"],
+            "incumbent_accuracy": incumbent["subset_accuracy"],
+            "candidate_accuracy": candidate["subset_accuracy"],
+        }
+        if not candidate["joined"] or not incumbent["joined"]:
+            detail["scored"] = False
+            return True, detail
+        detail["scored"] = True
+        ok = (candidate["subset_accuracy"] + self.cfg.swap_margin
+              >= incumbent["subset_accuracy"])
+        return ok, detail
+
+    __call__ = gate

@@ -108,6 +108,9 @@ class FleetResult:
     seq: int
     probabilities: np.ndarray
     labels: Tuple[str, ...]
+    #: the serving weights that produced it (None before any hot swap
+    #: — docs/replay.md "Hot swap"); the quality plane's join key.
+    weights_version: Optional[int] = None
 
 
 @dataclass
@@ -869,10 +872,12 @@ class FleetRouter:
                 # a result this router never routed (restart, foreign
                 # producer, tick that aged out) — visible, not fatal
                 self.metrics.count("results_unmatched")
+            version = v.get("weights_version")
             results.append(FleetResult(
                 sid, seq,
                 np.asarray(v.get("probabilities", ()), np.float32),
                 tuple(v.get("pred_labels", ())),
+                int(version) if version is not None else None,
             ))
         self.metrics.count("results_received", len(results))
         return results
@@ -1102,6 +1107,7 @@ class FleetRouter:
 
     def broadcast_hot_swap(
         self, params, *, version: Optional[int] = None,
+        require_eval=None,
     ) -> int:
         """Land a new checkpoint into every live worker's gateway —
         zero dropped sessions fleet-wide (docs/replay.md "Hot swap").
@@ -1113,7 +1119,35 @@ class FleetRouter:
         window to the one flush in flight when the swap message lands,
         and each acks with a ``weights_swapped`` control message the
         fleet summary aggregates.  Returns how many workers were told.
+
+        ``require_eval`` is the quality guardrail: a callable
+        ``params -> (ok, detail)`` — typically a
+        :class:`fmda_tpu.eval.shadow.ShadowEvaluator`, injected so this
+        jax-free role never builds a serving stack itself.  A candidate
+        it rejects is **refused**: counted (``hot_swaps_refused``),
+        announced on the control topic for operators, zero workers
+        told, the fleet keeps serving the incumbent.
         """
+        if require_eval is not None:
+            ok, detail = require_eval(params)
+            if not ok:
+                self.metrics.count("hot_swaps_refused")
+                try:
+                    # lint: ignore[wire-protocol] deliberately consumer-less: the refusal announcement is observability for operators tailing the control topic, not protocol (workers never branch on it)
+                    self.bus.publish(self.control_topic, {
+                        "kind": "hot_swap_refused",
+                        "detail": dict(detail or {}),
+                    })
+                except (ConnectionError, OSError) as e:
+                    # the announcement is observability, not protocol —
+                    # a down control bus must not turn a refusal (local
+                    # state only) into a crash
+                    self.metrics.count("bus_errors")
+                    log.warning("hot-swap refusal announcement "
+                                "failed: %s", e)
+                log.warning("hot swap REFUSED by quality guardrail: %s",
+                            detail)
+                return 0
         tree = encode_param_tree(params)
         self._swap_version = (version if version is not None
                               else self._swap_version + 1)

@@ -288,6 +288,13 @@ class FleetWorker:
             # the router-side summary can report the fleet's version
             # spread without an extra round trip
             out["weights_version"] = self.gateway.weights_version
+        version_ticks = self.gateway.version_ticks
+        if version_ticks:
+            # per-checkpoint serving attribution (quality plane): which
+            # version served how many of this worker's ticks — keys as
+            # strings so the stats dict stays JSON/wire-clean
+            out["version_ticks"] = {
+                str(v): n for v, n in sorted(version_ticks.items())}
         # per-class admit/shed attribution (fmda_tpu.control QoS): the
         # gateway counts these in this process; the beat carries them so
         # the control plane can fold fleet-wide per-tenant rates

@@ -73,6 +73,15 @@ GATEWAY_LOSS_COUNTERS = (
     "quota_shed",
 )
 
+#: quality-plane counters whose sum closes the label-join conservation
+#: identity (fmda_tpu.obs.quality: captured == joined + expired + shed
+#: + pending) — a prediction leaves the capture ring exactly one way:
+#: joined, aged out counted, or evicted counted
+QUALITY_LOSS_COUNTERS = (
+    "quality_captures_shed",
+    "quality_join_expired",
+)
+
 #: heartbeat-stats fields folded per worker: stat key -> (series, kind)
 WORKER_STAT_SERIES = {
     "ticks_served": ("worker_ticks_served_total", "counter"),
@@ -157,6 +166,14 @@ class FleetAggregator:
                 else:
                     self.store.record_gauge(
                         series, float(value), t=now, process=wid)
+            # per-checkpoint serving attribution: the beat carries each
+            # worker's {weights_version: ticks} breakdown so the quality
+            # plane can show which version served what share of traffic
+            for version, ticks in (stats.get("version_ticks")
+                                   or {}).items():
+                self.store.record_counter(
+                    "worker_version_ticks_total", float(ticks),
+                    t=now, process=wid, version=str(version))
 
     def observe_snapshot(
         self,
@@ -271,6 +288,9 @@ class FleetTelemetry:
                 # ledger + memory watermarks) alongside traces/tsdb
                 profile_fn=lambda: default_profiler().folded(),
                 device_fn=device_report,
+                # self.quality answers {"enabled": False} until an
+                # evaluator is attached — the bundle always has the file
+                quality_fn=self.quality,
             )
         self.slo = SLOEngine(
             self.cfg, self.store, events=self.events, clock=clock,
@@ -279,6 +299,9 @@ class FleetTelemetry:
         self._registry: Optional[MetricsRegistry] = None
         #: attached ControlPlane (fmda_tpu.control) — powers /control
         self._controller = None
+        #: attached QualityEvaluator (fmda_tpu.obs.quality) — powers
+        #: /quality and the quality SLO series
+        self._quality = None
         self._last_collect: Optional[float] = None
         self._last_scrape: Optional[float] = None
         #: the in-flight background scrape round (HTTP must never run
@@ -317,6 +340,8 @@ class FleetTelemetry:
                 or now - self._last_scrape >= self.cfg.scrape_interval_s):
             self._last_scrape = now
             self._scrape_workers(router, now)
+        if self._quality is not None:
+            self._quality.maybe_join(now=now)
         self.slo.evaluate(now)
 
     def _scrape_workers(self, router, now: float) -> None:
@@ -371,6 +396,8 @@ class FleetTelemetry:
         now = self.clock() if now is None else now
         self._last_collect = now
         self.aggregator.observe_runtime(gateway.metrics, now=now)
+        if self._quality is not None:
+            self._quality.maybe_join(now=now)
         self.slo.evaluate(now)
 
     # -- alert / chaos hooks ------------------------------------------------
@@ -423,12 +450,17 @@ class FleetTelemetry:
         ]
 
     def families(self) -> Snapshot:
-        """Registry collector: fleet gauges + SLO burn gauges + (when a
-        router has been observed) its RuntimeMetrics families."""
+        """Registry collector: fleet gauges + SLO burn gauges + quality
+        families (when attached) + (when a router has been observed)
+        its RuntimeMetrics families."""
         out: Snapshot = {"counters": [], "gauges": [], "histograms": []}
         out["gauges"].extend(self.fleet_gauges())
         slo_part = self.slo.families()
         out["gauges"].extend(slo_part.get("gauges", ()))
+        if self._quality is not None:
+            quality_part = self._quality.families()
+            for kind in out:
+                out[kind].extend(quality_part.get(kind, ()))
         router = self._router
         if router is not None:
             from fmda_tpu.obs.observability import runtime_families
@@ -508,11 +540,26 @@ class FleetTelemetry:
             return {"enabled": False}
         return self._controller.status()
 
+    def attach_quality(self, evaluator) -> None:
+        """Attach a :class:`~fmda_tpu.obs.quality.QualityEvaluator`: it
+        records into this telemetry's store (so the quality SLO
+        objectives see its series), joins on the collection cadence,
+        exports through :meth:`families`, and serves ``/quality``."""
+        evaluator.store = self.store
+        self._quality = evaluator
+
+    def quality(self) -> dict:
+        """The ``/quality`` document: the attached evaluator's summary,
+        or an explicit disabled stub when none is attached."""
+        if self._quality is None:
+            return {"enabled": False}
+        return self._quality.summary()
+
     def start_server(self, *, host: str = "127.0.0.1", port: int = 0):
         """A MetricsServer over this telemetry: ``/metrics``,
         ``/healthz`` (SLO-aware), ``/snapshot``, ``/events``, ``/trace``
-        plus the range endpoints ``/query``, ``/alerts``,
-        ``/control``, ``/profile``, and ``/device``."""
+        plus the range endpoints ``/query``, ``/alerts``, ``/control``,
+        ``/quality``, ``/profile``, and ``/device``."""
         from fmda_tpu.obs.device import device_report
         from fmda_tpu.obs.pyprof import default_profiler
         from fmda_tpu.obs.server import MetricsServer
@@ -531,6 +578,7 @@ class FleetTelemetry:
             query_fn=self.query,
             alerts_fn=self.alerts,
             control_fn=self.control,
+            quality_fn=self.quality,
             profile_fn=lambda: default_profiler().folded(),
             device_fn=device_report,
         ).start()

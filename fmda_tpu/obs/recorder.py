@@ -21,7 +21,10 @@ moment the trigger fires:
     - ``profile.folded`` — the host profiler's flamegraph-collapsed
       stacks (where the host was when the breach fired);
     - ``device.json``   — the compile ledger + device memory report
-      (fmda_tpu.obs.device: programs, recompiles, MFU, watermarks).
+      (fmda_tpu.obs.device: programs, recompiles, MFU, watermarks);
+    - ``quality.json``  — the model-quality window (fmda_tpu.obs.quality:
+      per-version accuracy/F-beta, drift scores, the capture/join
+      conservation ledger) when an evaluator is attached.
 
 Bundles are **bounded and rotated**: at most ``keep`` on disk (oldest
 deleted), with a per-reason debounce so a flapping alert cannot write
@@ -66,6 +69,7 @@ class FlightRecorder:
         workers_fn: Optional[Callable[[], dict]] = None,
         profile_fn: Optional[Callable[[], str]] = None,
         device_fn: Optional[Callable[[], dict]] = None,
+        quality_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
         if keep < 1:
             raise ValueError(f"keep must be >= 1, got {keep}")
@@ -81,6 +85,7 @@ class FlightRecorder:
         self.workers_fn = workers_fn
         self.profile_fn = profile_fn
         self.device_fn = device_fn
+        self.quality_fn = quality_fn
         #: reason -> clock stamp of its last bundle (the debounce)
         self._last: Dict[str, float] = {}
         self._seq = 0
@@ -159,6 +164,13 @@ class FlightRecorder:
             self._guarded(path, "device.json",
                           lambda: self._dump_json(
                               path, "device.json", self.device_fn()))
+        if self.quality_fn is not None:
+            # the model-quality window (per-version accuracy, drift,
+            # conservation ledger) at trigger time — the evidence a
+            # quality-SLO postmortem is about
+            self._guarded(path, "quality.json",
+                          lambda: self._dump_json(
+                              path, "quality.json", self.quality_fn()))
 
     def _guarded(self, path: str, name: str, fn) -> None:
         try:

@@ -65,6 +65,7 @@ class MetricsServer:
         query_fn: Optional[Callable[..., dict]] = None,
         alerts_fn: Optional[Callable[[], dict]] = None,
         control_fn: Optional[Callable[[], dict]] = None,
+        quality_fn: Optional[Callable[[], dict]] = None,
         profile_fn: Optional[Callable[[], str]] = None,
         device_fn: Optional[Callable[[], dict]] = None,
     ) -> None:
@@ -75,6 +76,7 @@ class MetricsServer:
         self.query_fn = query_fn
         self.alerts_fn = alerts_fn
         self.control_fn = control_fn
+        self.quality_fn = quality_fn
         self.profile_fn = profile_fn
         self.device_fn = device_fn
         server = self
@@ -167,6 +169,13 @@ class MetricsServer:
                         self._send(
                             200,
                             json.dumps(server.control_fn(),
+                                       indent=2).encode(),
+                            "application/json")
+                    elif path == "/quality" \
+                            and server.quality_fn is not None:
+                        self._send(
+                            200,
+                            json.dumps(server.quality_fn(),
                                        indent=2).encode(),
                             "application/json")
                     elif path == "/profile" \

@@ -30,6 +30,14 @@ Objectives ship with the framework (the ``[slo]`` config section —
 ``memory_leak``           fraction of samples with the device memory
                           monitor's monotonic-growth heuristic raised vs
                           ``memory_leak_budget``
+``quality_accuracy``      exact-match misses / label-joined predictions
+                          (fmda_tpu.obs.quality's evaluator) vs
+                          ``quality_accuracy_budget``
+``quality_fbeta``         fraction of samples where any (version, label)
+                          F-beta gauge sits below ``quality_fbeta_floor``
+                          vs ``quality_fbeta_budget``
+``quality_drift``         fraction of samples where the worst PSI exceeds
+                          ``quality_drift_psi`` vs ``quality_drift_budget``
 ========================  ===================================================
 
 Firing and resolving are **events** (the EventLog records both), the
@@ -59,6 +67,13 @@ SERIES_JOURNAL = "warehouse_journal_pending"
 SERIES_DEGRADED = "engine_degraded_streams"
 SERIES_RECOMPILES = "worker_recompiles_total"
 SERIES_LEAK = "worker_memory_leak_suspected"
+#: quality-plane series (fmda_tpu.obs.quality writes them; all three
+#: quality objectives are None-until-reported, so fleets without the
+#: quality plane neither alert nor read healthy-by-omission)
+SERIES_QUALITY_JOINED = "quality_joined_total"
+SERIES_QUALITY_EXACT = "quality_exact_total"
+SERIES_QUALITY_FBETA = "quality_fbeta"
+SERIES_QUALITY_DRIFT = "quality_drift_score"
 
 
 def bad_fraction_above(hist: LatencyHistogram, bound_s: float) -> float:
@@ -151,7 +166,64 @@ class SLOEngine:
             "bad": lambda w, now: self._gauge_bad(
                 SERIES_LEAK, w, now, 0.0),
         })
+        out.append({
+            "objective": "quality_accuracy",
+            "budget": cfg.quality_accuracy_budget,
+            "detail": "exact-match misses / label-joined predictions",
+            "bad": lambda w, now: self._quality_accuracy_bad(w, now),
+        })
+        out.append({
+            "objective": "quality_fbeta",
+            "budget": cfg.quality_fbeta_budget,
+            "detail": (f"any per-label F-beta under "
+                       f"{cfg.quality_fbeta_floor:g}"),
+            "bad": lambda w, now: self._gauge_below_bad(
+                SERIES_QUALITY_FBETA, w, now, cfg.quality_fbeta_floor),
+        })
+        out.append({
+            "objective": "quality_drift",
+            "budget": cfg.quality_drift_budget,
+            "detail": f"feature/prediction PSI over "
+                      f"{cfg.quality_drift_psi:g}",
+            "bad": lambda w, now: self._gauge_bad(
+                SERIES_QUALITY_DRIFT, w, now, cfg.quality_drift_psi),
+        })
         return out
+
+    def _quality_accuracy_bad(self, window_s: float, now: float
+                              ) -> Optional[float]:
+        """Window miss rate of the label-join evaluator: (joined -
+        exact) / joined over the window's counter deltas.  None until
+        the quality plane has reported — and None for windows where
+        nothing joined (no evidence is not good OR bad evidence)."""
+        if not self.store.query(SERIES_QUALITY_JOINED, window_s=window_s,
+                                now=now)["points"]:
+            return None
+        joined = self.store.window_total(
+            SERIES_QUALITY_JOINED, window_s=window_s, now=now)
+        if joined <= 0:
+            return None
+        exact = self.store.window_total(
+            SERIES_QUALITY_EXACT, window_s=window_s, now=now)
+        return max(0.0, (joined - exact) / joined)
+
+    def _gauge_below_bad(self, name: str, window_s: float, now: float,
+                         floor: float) -> Optional[float]:
+        """Mirror of :meth:`_gauge_bad` with an inverted bound: the
+        fraction of sampled intervals where ANY label variant sits
+        *below* ``floor`` (one collapsed label is the fleet's problem,
+        whichever version serves it)."""
+        bad_bins: set = set()
+        all_bins: set = set()
+        for point_set in self.store.query(
+                name, window_s=window_s, now=now)["points"]:
+            for t, v in point_set["values"]:
+                all_bins.add(t)
+                if v < floor:
+                    bad_bins.add(t)
+        if not all_bins:
+            return None
+        return len(bad_bins) / len(all_bins)
 
     def _recompile_bad(self, window_s: float, now: float
                        ) -> Optional[float]:

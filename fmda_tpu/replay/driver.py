@@ -24,6 +24,7 @@ layer down).
 from __future__ import annotations
 
 import time
+from datetime import datetime, timezone
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -88,6 +89,7 @@ class ReplayDriver:
         wire_dialect: Optional[str] = None,
         collect: bool = False,
         on_round=None,
+        quality=None,
     ) -> None:
         if wire_dialect not in (None, "binary", "json"):
             raise ValueError(
@@ -101,9 +103,17 @@ class ReplayDriver:
         self.wire_dialect = wire_dialect
         self.collect = collect
         self.on_round = on_round
+        #: optional fmda_tpu.obs.quality.QualityEvaluator: every served
+        #: result is captured for label join (keyed by its row's
+        #: warehouse timestamp), and the join runs on the VIRTUAL clock
+        #: — cadence-gated off the tick path, deterministic in replay
+        self.quality = quality
         self.results: List = []
         #: per-ticker virtual timestamp of the last dispatched row
         self._ticker_ts: Optional[np.ndarray] = None
+        #: (session, seq) -> (timestamp string, feature row) for results
+        #: still in flight; popped as results land (bounded by inflight)
+        self._quality_keys: Dict = {}
         self._watermark = 0.0
 
     # -- progress observability (obs gauges; `status` renders these) -----
@@ -155,6 +165,12 @@ class ReplayDriver:
                         "row": batch.rows[k],
                         "seq": seqs[ti],
                     })
+                    if self.quality is not None:
+                        ts = (batch.timestamps[k] if batch.timestamps
+                              else _virtual_ts_str(batch.virtual_ts))
+                        self._quality_keys[
+                            (session_ids[ti], seqs[ti])] = (
+                                ts, batch.rows[k])
                     seqs[ti] += 1
                     self._ticker_ts[ti] = batch.virtual_ts
                 if pool is not None and len(msgs) >= codec.MIN_BLOCK_TICKS:
@@ -195,6 +211,11 @@ class ReplayDriver:
                     self._publish_progress(submitted, now - t0)
                 if self.on_round is not None:
                     self.on_round(rounds - 1)
+                if self.quality is not None:
+                    # the join cadence rides the VIRTUAL clock — the
+                    # same rows produce the same join/expiry schedule
+                    # on every replay, no wall-clock involved
+                    self.quality.maybe_join(now=batch.virtual_ts)
             served += self._keep(gateway.drain())
         finally:
             m.gauge("replay_active", 0.0)
@@ -231,4 +252,22 @@ class ReplayDriver:
     def _keep(self, results) -> int:
         if self.collect and results:
             self.results.extend(results)
+        if self.quality is not None and results:
+            for r in results:
+                key = self._quality_keys.pop((r.session_id, r.seq), None)
+                if key is None:
+                    continue  # pre-attach or replayed-duplicate result
+                ts, row = key
+                self.quality.capture(
+                    r.session_id, ts, r.probabilities,
+                    weights_version=getattr(r, "weights_version", None),
+                    features=row)
         return len(results)
+
+
+def _virtual_ts_str(virtual_ts: float) -> str:
+    """Virtual epoch -> warehouse-format timestamp string (a pure
+    conversion of replay data, not a clock read) — synthetic sources
+    get join keys in the same space warehouse rows use."""
+    return datetime.fromtimestamp(
+        virtual_ts, tz=timezone.utc).strftime("%Y-%m-%d %H:%M:%S")

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from datetime import datetime, timezone
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -45,6 +45,10 @@ class ReplayBatch:
     tickers: np.ndarray
     #: (B, F) float32 feature rows, parallel to ``tickers``.
     rows: np.ndarray
+    #: Warehouse timestamp strings parallel to ``rows`` (the label-join
+    #: key the quality evaluator resolves through ids_for_timestamps);
+    #: None for sources without warehouse identity (synthetic).
+    timestamps: Optional[Tuple[str, ...]] = None
 
 
 def parse_epoch(ts: str, fallback: float = 0.0) -> float:
@@ -174,6 +178,7 @@ class WarehouseHistory:
         n = self.n_tickers
         pending_rows: List[np.ndarray] = []
         pending_ts: List[float] = []
+        pending_raw: List[str] = []
         last_epoch = 0.0
         for ts_list, matrix in self.warehouse.iter_row_chunks(
                 self.start_ts, self.end_ts, self.chunk):
@@ -193,6 +198,7 @@ class WarehouseHistory:
                 last_epoch = parse_epoch(ts_list[i], last_epoch)
                 pending_rows.append(feats[i])
                 pending_ts.append(last_epoch)
+                pending_raw.append(str(ts_list[i]))
                 if len(pending_rows) == n:
                     # row j drives ticker j % n, and full rounds consume
                     # exactly n rows — every round is tickers 0..n-1
@@ -200,11 +206,13 @@ class WarehouseHistory:
                         virtual_ts=max(pending_ts),
                         tickers=np.arange(n, dtype=np.int32),
                         rows=np.stack(pending_rows),
+                        timestamps=tuple(pending_raw),
                     )
-                    pending_rows, pending_ts = [], []
+                    pending_rows, pending_ts, pending_raw = [], [], []
         if pending_rows:
             yield ReplayBatch(
                 virtual_ts=max(pending_ts),
                 tickers=np.arange(len(pending_rows), dtype=np.int32),
                 rows=np.stack(pending_rows),
+                timestamps=tuple(pending_raw),
             )

@@ -86,6 +86,10 @@ class FleetResult:
     seq: int
     probabilities: np.ndarray
     labels: Tuple[str, ...]
+    #: checkpoint generation that served this tick — None before the
+    #: first hot swap (the pre-swap result shape, unchanged); the
+    #: quality plane keys its per-version metrics on this stamp
+    weights_version: Optional[int] = None
 
 
 @dataclass
@@ -198,6 +202,10 @@ class FleetGateway:
         #: to the caller on the next pump/drain so in-process consumers
         #: (no bus) never lose the old-weights flush
         self._barrier_results: List[FleetResult] = []
+        #: served-tick counts keyed by the weights_version that served
+        #: them (0 = pre-swap) — heartbeats carry this so the router's
+        #: quality plane attributes traffic share per checkpoint
+        self._version_ticks: Dict[int, int] = {}
         self._flush_idx = 0
 
     # -- admission ----------------------------------------------------------
@@ -269,6 +277,12 @@ class FleetGateway:
                 self.batcher.config, max_linger_s=max_linger_ms / 1e3)
         self.batcher.bucket_cap = bucket_cap
         self.metrics.count("retunes_applied")
+
+    @property
+    def version_ticks(self) -> Dict[int, int]:
+        """Served ticks per weights_version (0 = pre-swap) — heartbeat
+        stats carry a copy for router-side per-checkpoint attribution."""
+        return dict(self._version_ticks)
 
     def hot_swap(self, params, *, version: Optional[int] = None) -> int:
         """Land a new checkpoint into the live pool — zero dropped
@@ -653,7 +667,8 @@ class FleetGateway:
                 _, labels = labels_over_threshold(
                     p, self.threshold, self.y_fields)
                 results.append(FleetResult(
-                    tick.handle.session_id, tick.seq, p, labels))
+                    tick.handle.session_id, tick.seq, p, labels,
+                    self.weights_version))
                 if messages is not None:
                     msg = {
                         "session": tick.handle.session_id,
@@ -715,6 +730,11 @@ class FleetGateway:
 
         m = self.metrics
         m.count("ticks_served", len(results))
+        if results:
+            v = (self.weights_version
+                 if self.weights_version is not None else 0)
+            self._version_ticks[v] = (
+                self._version_ticks.get(v, 0) + len(results))
         m.observe("device", t_device - t_synced)
         m.observe("publish", t_publish - t_device)
         for tick in inflight.live:

@@ -344,6 +344,26 @@ class MySQLWarehouse:
             "ORDER BY ID DESC LIMIT %s;", (int(limit),))
         return [r[0] for r in self._cursor.fetchall()]
 
+    def ids_for_timestamps(
+        self, timestamps: Sequence[str],
+    ) -> List[Optional[int]]:
+        """1-based landed positions for each timestamp (``None`` when it
+        never landed) — same contract as the embedded Warehouse's.  IDs
+        double as positions under the table's append-only AUTO_INCREMENT
+        assumption (the same one :meth:`fetch` leans on); duplicate
+        landings resolve to the newest row, like the embedded backend.
+        """
+        ts_list = [str(t) for t in timestamps]
+        if not ts_list:
+            return []
+        placeholders = ", ".join(["%s"] * len(set(ts_list)))
+        self._cursor.execute(
+            f"SELECT Timestamp, MAX(ID) FROM {self.config.table_name} "
+            f"WHERE Timestamp IN ({placeholders}) GROUP BY Timestamp;",
+            sorted(set(ts_list)))
+        by_ts = {str(r[0]): int(r[1]) for r in self._cursor.fetchall()}
+        return [by_ts.get(t) for t in ts_list]
+
     def iter_row_chunks(
         self,
         start_ts: Optional[str] = None,

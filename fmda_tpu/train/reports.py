@@ -12,11 +12,12 @@ host-side report artifacts, nothing device-side.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from fmda_tpu.config import TARGET_COLUMNS
+from fmda_tpu.eval.metrics import StreamingCounts, batch_counts
 
 
 def history_table(history: Dict[str, List]) -> str:
@@ -29,6 +30,55 @@ def history_table(history: Dict[str, List]) -> str:
         lines.append(
             f"| {i + 1} | {tr.loss:.4f} | {tr.accuracy:.4f} | "
             f"{tr.hamming:.4f} | {va.accuracy:.4f} | {va.hamming:.4f} |"
+        )
+    return "\n".join(lines)
+
+
+def offline_quality(
+    probabilities: np.ndarray,
+    targets: np.ndarray,
+    *,
+    threshold: float = 0.5,
+) -> StreamingCounts:
+    """Fold a whole offline evaluation split into the SAME sufficient
+    statistics the live label-join evaluator accumulates
+    (:class:`fmda_tpu.eval.metrics.StreamingCounts`), so an offline
+    report and the ``/quality`` endpoint can never disagree on metric
+    definitions — one numpy vocabulary, two call sites."""
+    return batch_counts(probabilities, targets, threshold=threshold)
+
+
+def quality_table(
+    counts: StreamingCounts,
+    labels: Sequence[str] = TARGET_COLUMNS,
+    *,
+    beta: float = 0.5,
+    title: Optional[str] = None,
+) -> str:
+    """Markdown quality report over shared streaming counts.
+
+    Renders whatever a :class:`StreamingCounts` holds — an offline split
+    folded by :func:`offline_quality` or a snapshot pulled from the live
+    evaluator's per-version accumulators — so the offline and online
+    reports are the same table over the same arithmetic.
+    """
+    summary = counts.summary(beta)
+    confusion = counts.confusion()
+    lines = []
+    if title:
+        lines.append(f"**{title}** — n={summary['n']}, "
+                     f"subset accuracy {summary['subset_accuracy']:.4f}, "
+                     f"Hamming loss {summary['hamming_loss']:.4f}")
+        lines.append("")
+    lines += [
+        f"| label | F{beta:g} | tp | fp | fn | tn |",
+        "|---|---|---|---|---|---|",
+    ]
+    for i, label in enumerate(labels):
+        (tn, fp), (fn, tp) = confusion[i]
+        lines.append(
+            f"| {label} | {summary['fbeta'][i]:.4f} | {int(tp)} | "
+            f"{int(fp)} | {int(fn)} | {int(tn)} |"
         )
     return "\n".join(lines)
 

@@ -73,6 +73,19 @@ class _Cursor:
         if upper == "SELECT 1;":  # health probe
             self._result = [(1,)]
             return
+        if upper.startswith("SELECT TIMESTAMP, MAX(ID)"):
+            # ids_for_timestamps: landed 1-based positions per requested
+            # timestamp (GROUP BY resolves duplicate landings to newest)
+            if "GROUP BY TIMESTAMP" not in upper:
+                raise AssertionError(
+                    f"ids_for_timestamps without GROUP BY: {stmt[:80]}")
+            wanted = {str(p) for p in params}
+            by_ts: Dict[str, int] = {}
+            for rid, (ts, _) in enumerate(s.landed, start=1):
+                if ts in wanted:
+                    by_ts[ts] = rid  # later landings overwrite: MAX(ID)
+            self._result = sorted(by_ts.items())
+            return
         if upper.startswith("SELECT TIMESTAMP FROM"):  # recent tail
             if "ORDER BY ID DESC" not in stmt:
                 raise AssertionError("recent_timestamps without ORDER BY")

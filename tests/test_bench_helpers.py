@@ -76,6 +76,7 @@ class TestPhaseRegistry:
             "obs_overhead",
             "obs_aggregate_overhead",
             "trace_overhead",
+            "quality_overhead",
             "device_obs_overhead",
             "analysis_lint",
             "wire_codec_bench",
@@ -103,6 +104,18 @@ class TestPhaseRegistry:
         assert tuple(sorted(bench.REPLAY_THROUGHPUT_SCHEMA)) == (
             "buckets", "cadence_s", "cells", "hot_swap", "identity_ok",
             "quiet_host", "rounds", "tickers")
+
+    def test_quality_eval_artifact_schema_pinned(self):
+        """ISSUE 19 phase-change pin: artifacts/quality_eval.json
+        carries the quality-plane overhead A/B plus the capture
+        conservation verdict under exactly these keys —
+        ``python -m fmda_tpu quality --artifact`` and CI dashboards
+        read it, so a key rename must update this pin (and the
+        readers) in the same PR."""
+        assert tuple(sorted(bench.QUALITY_EVAL_SCHEMA)) == (
+            "budget_pct", "conservation_ok", "disabled_wall_s",
+            "enabled_wall_s", "join_wall_s", "joined", "ok",
+            "overhead_pct", "quiet_host", "reps", "rounds", "sessions")
 
     def test_kernel_sweep_and_fleet_ab_cover_the_ssm_family(self):
         """ISSUE 14 phase-change pin: the kernel sweep races the SSM

@@ -1,0 +1,225 @@
+"""The offline/online metric seam (ISSUE 19 satellite 1).
+
+One numpy vocabulary (fmda_tpu.eval.metrics) feeds both the trainer's
+end-of-run report and the live label-join evaluator, so the parity
+contract here is the whole point: **streaming == batch == the jnp
+reference** on identical inputs — the StreamingCounts decomposition is
+exact (every metric is a ratio of sums), not approximate.  Alongside:
+the drift profile's build/save/load round trip, PSI's fixed-point and
+sensitivity properties, and the markdown renderer that makes an
+offline split comparable line-for-line with a /quality scrape.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fmda_tpu.eval.drift import (
+    PROFILE_FILENAME,
+    DriftMonitor,
+    build_profile,
+    load_profile,
+    profile_path_for,
+    psi,
+    save_profile,
+)
+from fmda_tpu.eval.metrics import StreamingCounts, batch_counts, threshold_probs
+
+
+def _random_case(seed, n=64, labels=4):
+    rng = np.random.default_rng(seed)
+    probs = rng.uniform(size=(n, labels)).astype(np.float32)
+    target = rng.uniform(size=(n, labels)) > 0.6
+    return probs, target
+
+
+# ---------------------------------------------------------------------------
+# streaming == batch == jnp reference
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 7])
+@pytest.mark.parametrize("chunk", [1, 5, 64])
+def test_streaming_equals_batch(seed, chunk):
+    probs, target = _random_case(seed)
+    streaming = StreamingCounts(4)
+    for lo in range(0, len(probs), chunk):
+        streaming.update(threshold_probs(probs[lo:lo + chunk]),
+                         target[lo:lo + chunk])
+    batch = batch_counts(probs, target)
+    assert streaming.n == batch.n == len(probs)
+    assert streaming.subset_accuracy == batch.subset_accuracy
+    assert streaming.hamming_loss == batch.hamming_loss
+    np.testing.assert_array_equal(streaming.fbeta(0.5), batch.fbeta(0.5))
+    np.testing.assert_array_equal(streaming.confusion(), batch.confusion())
+
+
+def test_parity_with_jnp_reference():
+    """The online vocabulary and fmda_tpu.ops.metrics agree on the same
+    data.  ops.metrics takes LOGITS (it applies the sigmoid itself);
+    the serving tier publishes probabilities — so the bridge is
+    ``probs = sigmoid(logits)``, and both thresholdings then agree
+    because sigmoid is monotonic."""
+    import jax.nn
+    import jax.numpy as jnp
+
+    from fmda_tpu.ops import metrics as jm
+
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(48, 4)).astype(np.float32)
+    target = rng.uniform(size=(48, 4)) > 0.5
+    probs = np.asarray(jax.nn.sigmoid(jnp.asarray(logits)))
+
+    pred_j = jm.threshold_predictions(jnp.asarray(logits))
+    counts = batch_counts(probs, target)
+    np.testing.assert_array_equal(
+        np.asarray(pred_j), threshold_probs(probs))
+    assert counts.subset_accuracy == pytest.approx(
+        float(jm.subset_accuracy(pred_j, jnp.asarray(target))), abs=1e-6)
+    assert counts.hamming_loss == pytest.approx(
+        float(jm.hamming_loss(pred_j, jnp.asarray(target))), abs=1e-6)
+    np.testing.assert_allclose(
+        counts.fbeta(0.5),
+        np.asarray(jm.fbeta_score(pred_j, jnp.asarray(target), 0.5)),
+        atol=1e-6)
+    np.testing.assert_array_equal(
+        counts.confusion(),
+        np.asarray(jm.multilabel_confusion(pred_j, jnp.asarray(target))))
+
+
+def test_fbeta_zero_over_zero_is_zero():
+    counts = StreamingCounts(2)
+    # no positives predicted, none present: precision/recall/F all 0/0
+    counts.update(np.zeros((5, 2), bool), np.zeros((5, 2), bool))
+    assert counts.subset_accuracy == 1.0
+    np.testing.assert_array_equal(counts.fbeta(0.5), [0.0, 0.0])
+
+
+def test_confusion_layout_matches_sklearn_convention():
+    counts = StreamingCounts(1)
+    counts.update(np.array([[1], [1], [0], [0]], bool),
+                  np.array([[1], [0], [1], [0]], bool))
+    # [[tn, fp], [fn, tp]]
+    np.testing.assert_array_equal(counts.confusion()[0], [[1, 1], [1, 1]])
+
+
+def test_merge_is_exact_concatenation():
+    a_probs, a_t = _random_case(1, n=13)
+    b_probs, b_t = _random_case(2, n=29)
+    a = batch_counts(a_probs, a_t)
+    a.merge(batch_counts(b_probs, b_t))
+    both = batch_counts(np.concatenate([a_probs, b_probs]),
+                        np.concatenate([a_t, b_t]))
+    assert a.summary() == both.summary()
+    with pytest.raises(ValueError):
+        a.merge(StreamingCounts(7))
+
+
+def test_update_rejects_mislabeled_width():
+    counts = StreamingCounts(4)
+    with pytest.raises(ValueError):
+        counts.update(np.zeros((2, 3), bool), np.zeros((2, 3), bool))
+
+
+def test_offline_report_reuses_the_online_counts():
+    from fmda_tpu.train.reports import offline_quality, quality_table
+
+    probs, target = _random_case(5, n=32)
+    counts = offline_quality(probs, target)
+    assert counts.summary() == batch_counts(probs, target).summary()
+    table = quality_table(counts, ("up1", "up2", "down1", "down2"),
+                          title="eval split")
+    assert "eval split" in table and "| up1 " in table
+    assert f"n={counts.n}" in table
+
+
+# ---------------------------------------------------------------------------
+# drift: profile round trip + PSI properties
+# ---------------------------------------------------------------------------
+
+
+def _profile(seed=0, rows=256, feats=3, bins=8):
+    rng = np.random.default_rng(seed)
+    data = rng.normal(size=(rows, feats))
+    targets = rng.uniform(size=(rows, 4)) > 0.7
+    return data, build_profile(data, targets, bins=bins,
+                               columns=[f"f{j}" for j in range(feats)])
+
+
+def test_profile_round_trips_through_json(tmp_path):
+    _, profile = _profile()
+    path = save_profile(str(tmp_path / "ck" / PROFILE_FILENAME), profile)
+    assert path == profile_path_for(str(tmp_path / "ck"))
+    assert load_profile(path) == profile
+
+
+def test_profile_version_mismatch_raises(tmp_path):
+    _, profile = _profile()
+    profile["profile_version"] = 99
+    path = save_profile(str(tmp_path / PROFILE_FILENAME), profile)
+    with pytest.raises(ValueError, match="profile version"):
+        load_profile(path)
+
+
+def test_build_profile_input_validation():
+    with pytest.raises(ValueError, match="reference rows"):
+        build_profile(np.zeros((1, 3)))
+    with pytest.raises(ValueError, match="bins"):
+        build_profile(np.zeros((10, 3)), bins=1)
+
+
+def test_psi_zero_on_identical_and_grows_with_shift():
+    ref = np.array([0.25, 0.25, 0.25, 0.25])
+    assert psi(ref, ref) == pytest.approx(0.0, abs=1e-9)
+    shifted = np.array([0.7, 0.1, 0.1, 0.1])
+    assert psi(ref, shifted) > 0.25  # action-required territory
+
+
+def test_monitor_in_distribution_scores_stable():
+    data, profile = _profile(seed=11, rows=512)
+    mon = DriftMonitor(profile, min_samples=64)
+    mon.observe_features(data)  # the training distribution itself
+    scores = mon.scores()
+    assert scores is not None and scores["rows"] == 512
+    assert scores["max_psi"] < 0.1  # "stable" by the PSI convention
+
+
+def test_monitor_flags_a_shifted_distribution():
+    data, profile = _profile(seed=12, rows=512)
+    mon = DriftMonitor(profile, min_samples=64)
+    mon.observe_features(data + 3.0)  # gross covariate shift
+    scores = mon.scores()
+    assert scores is not None
+    assert scores["max_psi"] > 0.25
+    assert len(scores["feature_psi"]) == data.shape[1]
+
+
+def test_monitor_gates_on_min_samples():
+    data, profile = _profile(rows=128)
+    mon = DriftMonitor(profile, min_samples=64)
+    mon.observe_features(data[:63])
+    assert mon.scores() is None  # noise, not signal, below the floor
+    mon.observe_features(data[63:64])
+    assert mon.scores() is not None
+
+
+def test_monitor_prediction_psi_against_label_rates():
+    data, profile = _profile(rows=256)
+    mon = DriftMonitor(profile, min_samples=16)
+    mon.observe_features(data[:32])
+    # all-positive predictions vs ~30% training positive rate
+    mon.observe_predictions(np.ones((32, 4), bool))
+    scores = mon.scores()
+    assert scores is not None and scores["prediction_psi"] is not None
+    assert max(scores["prediction_psi"]) > 0.25
+
+
+def test_monitor_rejects_wrong_width():
+    data, profile = _profile(feats=3)
+    mon = DriftMonitor(profile)
+    with pytest.raises(ValueError, match="row width"):
+        mon.observe_features(np.zeros((4, 5)))
