@@ -7,7 +7,8 @@ engine alerts on.  That visibility erodes one convenient ``jax.jit`` at
 a time — a helper jitted in a refactor here, an experiment left in
 there — and every untracked site is a program whose recompiles the
 fleet cannot see.  This rule is the ratchet: inside the serving scope —
-``runtime/`` and the kernel dispatch seam — any direct
+``runtime/``, ``train/`` (the continuous fine-tuning loop pins
+zero-recompile as a contract), and the kernel dispatch seam — any direct
 ``jax.jit``/``jax.pjit`` call is a finding unless the site routes
 through :func:`tracked_jit` or carries the standard in-place hatch
 (``# lint: ignore[tracked-jit] reason``) naming why the program is
@@ -24,8 +25,11 @@ from typing import Dict, List, Set
 
 from fmda_tpu.analysis.engine import Finding, LintContext, ParsedModule, Rule
 
-#: directory prefixes inside the package that ARE the serving stack
-SCOPE_PREFIXES = ("runtime/",)
+#: directory prefixes inside the package that ARE the serving stack —
+#: ``train/`` joined the scope when the continuous fine-tuning loop
+#: pinned zero-recompile as a contract (its step programs sit on the
+#: same ledger the SLO engine watches)
+SCOPE_PREFIXES = ("runtime/", "train/")
 
 #: single modules on the same compile path
 SCOPE_MODULES = ("ops/dispatch.py",)
@@ -43,9 +47,10 @@ JIT_MODULES = ("jax", "jax.experimental.pjit")
 class TrackedJitRule(Rule):
     id = "tracked-jit"
     severity = "error"
-    description = ("serving-stack modules (runtime/, ops/dispatch.py) "
-                   "compile through obs.device.tracked_jit, never raw "
-                   "jax.jit/pjit, except at annotated off-ledger sites")
+    description = ("serving-stack modules (runtime/, train/, "
+                   "ops/dispatch.py) compile through "
+                   "obs.device.tracked_jit, never raw jax.jit/pjit, "
+                   "except at annotated off-ledger sites")
 
     def check(self, module: ParsedModule, ctx: LintContext) -> List[Finding]:
         rel = module.rel

@@ -269,9 +269,49 @@ def _train(wh, cfg, *, epochs, batch_size, checkpoint_dir, seed):
     return ckpt
 
 
+def _continuous_train(wh, cfg, *, checkpoint_dir, max_rounds, seed):
+    """``train --continuous``: the standalone continuous fine-tuning
+    loop — tail the warehouse, fine-tune on the sliding window, write
+    versioned checkpoints (+ drift profiles).  No fleet attached here;
+    ``serve-fleet --continuous-train`` is the in-process serving
+    variant that also hot-swaps."""
+    import dataclasses
+
+    from fmda_tpu.train.continuous import ContinuousTrainer
+
+    if len(wh) == 0:
+        print("warehouse is empty — run ingest first", file=sys.stderr)
+        return None
+    fc = cfg.features
+    model_cfg = dataclasses.replace(cfg.model, n_features=len(wh.x_fields))
+    train_cfg = (dataclasses.replace(cfg.train, seed=seed)
+                 if seed is not None else cfg.train)
+    ct = ContinuousTrainer(
+        wh, model_cfg, train_cfg,
+        checkpoint_dir=checkpoint_dir,
+        bid_levels=fc.bid_levels, ask_levels=fc.ask_levels,
+        drift_bins=cfg.quality.drift_bins, target_lead=fc.max_lead,
+    )
+    out = ct.run(max_rounds=max_rounds)
+    print(f"continuous train: {out['rounds']} round(s), "
+          f"{out['rows_seen']} rows seen, "
+          f"{len(out['checkpoints'])} checkpoint(s), "
+          f"recompiles={out['trainer_unexpected_recompiles']}")
+    for ckpt in out["checkpoints"]:
+        print(f"checkpoint: {ckpt}")
+    return out
+
+
 def cmd_train(args) -> int:
     _ensure_backend(args)
     cfg = _config(args)
+    if args.continuous:
+        out = _continuous_train(
+            _warehouse(args.warehouse, cfg), cfg,
+            checkpoint_dir=_ckpt_dir(args, cfg),
+            max_rounds=args.max_rounds, seed=args.seed,
+        )
+        return 0 if out and out["rounds"] > 0 else 2
     ckpt = _train(
         _warehouse(args.warehouse, cfg), cfg, epochs=args.epochs,
         batch_size=args.batch_size, checkpoint_dir=_ckpt_dir(args, cfg),
@@ -1096,6 +1136,20 @@ def cmd_serve_fleet(args) -> int:
         print("--replay serves carried-state sessions; it composes "
               "with --cell, not --predictor", file=sys.stderr)
         return 2
+    if args.continuous_train and args.role != "solo":
+        print("--continuous-train runs beside the solo gateway; "
+              "use --role solo (fleet-wide: run `train --continuous` "
+              "against the shared warehouse and let the router "
+              "broadcast)", file=sys.stderr)
+        return 2
+    if args.continuous_train and (args.replay or args.predictor):
+        print("--continuous-train is its own load shape; drop "
+              "--replay/--predictor", file=sys.stderr)
+        return 2
+    if args.swap_guard and not args.continuous_train:
+        print("--swap-guard gates --continuous-train swaps; add "
+              "--continuous-train", file=sys.stderr)
+        return 2
     if args.role == "worker":
         return _cmd_fleet_worker(args)
     if args.role == "broker":
@@ -1198,7 +1252,23 @@ def cmd_serve_fleet(args) -> int:
         def run_load():
             return run_predictor_load(gateway, timestamps, load_cfg)
     else:
-        app = Application(cfg)
+        if args.continuous_train:
+            # the continuous-train proof run tails a real warehouse:
+            # build the synthetic corpus through the production
+            # streaming stack and size the serving model to its joined
+            # feature width (the trainer must train the SAME param tree
+            # the pool serves, or the hot swap would rebind wrong)
+            from fmda_tpu.data.synthetic import (
+                SyntheticMarketConfig, build_corpus,
+            )
+
+            wh, _ = build_corpus(
+                cfg.features,
+                SyntheticMarketConfig(seed=args.seed,
+                                      n_days=args.continuous_days))
+            app = Application(cfg, warehouse=wh)
+        else:
+            app = Application(cfg)
 
         # synthetic proof run: a randomly-initialised unidirectional
         # carrier (the serving math is checkpoint-independent; --hidden
@@ -1206,7 +1276,9 @@ def cmd_serve_fleet(args) -> int:
         model_cfg = dataclasses.replace(
             cfg.model, bidirectional=False, dropout=0.0,
             hidden_size=args.hidden,
-            n_features=(_replay_width(cfg) if args.replay
+            n_features=(len(app.warehouse.x_fields)
+                        if args.continuous_train
+                        else _replay_width(cfg) if args.replay
                         else cfg.features.n_features),
             cell=cfg.model.cell if cfg.model.cell != "attn" else "gru")
         model = build_model(model_cfg)
@@ -1238,6 +1310,42 @@ def cmd_serve_fleet(args) -> int:
 
             def run_load():
                 return run_fleet_load(gateway, load_cfg)
+    continuous = None
+    continuous_thread = None
+    if args.continuous_train:
+        # the trainer tails the corpus warehouse beside the serving
+        # load; every accepted round hot-swaps the live pool (host-side
+        # rebind — serving never recompiles; docs/training.md)
+        import threading
+
+        from fmda_tpu.train.continuous import (
+            ContinuousTrainer, gateway_publisher)
+
+        require_eval = None
+        if args.swap_guard:
+            from fmda_tpu.eval.shadow import ShadowEvaluator
+
+            require_eval = ShadowEvaluator(
+                params, model_config=model_cfg, warehouse=app.warehouse,
+                quality_config=cfg.quality, max_lead=cfg.features.max_lead,
+                window=cfg.runtime.window,
+                # the model is sized to the joined x_fields view; the
+                # shadow replay streams raw landed chunks and must map
+                # them through the derived views
+                row_transform=app.warehouse.joined_row_transform)
+        continuous = ContinuousTrainer(
+            app.warehouse, model_cfg, cfg.train,
+            checkpoint_dir=(args.train_checkpoint_dir
+                            or cfg.train.checkpoint_dir),
+            publish=gateway_publisher(gateway, require_eval=require_eval),
+            bid_levels=cfg.features.bid_levels,
+            ask_levels=cfg.features.ask_levels,
+            drift_bins=cfg.quality.drift_bins,
+            target_lead=cfg.features.max_lead)
+        continuous_thread = threading.Thread(
+            target=lambda: continuous.run(max_rounds=args.train_rounds),
+            daemon=True, name="fmda-continuous-train")
+        continuous_thread.start()
     if args.metrics_port is not None:
         server = app.observability.start_server(port=args.metrics_port)
         print(f"metrics endpoint: {server.url}/metrics "
@@ -1260,6 +1368,18 @@ def cmd_serve_fleet(args) -> int:
         out["ring"] = gateway.pool.use_ring
     else:
         out["cell"] = model_cfg.cell
+    if continuous is not None:
+        # let the tail quiesce on its own (bounded follow: at most
+        # continuous_follow_polls empty polls) so the backlog's drain
+        # round lands; stop() is the backstop, not the happy path
+        continuous_thread.join(timeout=120.0)
+        if continuous_thread.is_alive():
+            continuous.stop()
+            continuous_thread.join(timeout=120.0)
+        summary = continuous.summary()
+        summary["weights_version"] = gateway.weights_version
+        summary["pool_compile_count"] = gateway.pool.compile_count
+        out["continuous_train"] = summary
     out["backend"] = jax.default_backend()
     if args.trace or args.trace_out:
         from fmda_tpu.obs.trace import default_tracer
@@ -2141,6 +2261,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=None,
                    help="override config train.batch_size (default 2)")
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--continuous", action="store_true",
+                   help="tail the warehouse and fine-tune continuously "
+                        "([train] continuous_* knobs; versioned "
+                        "checkpoints + drift profiles per round)")
+    p.add_argument("--max-rounds", type=int, default=None,
+                   help="bound --continuous fine-tune rounds "
+                        "(default: until the warehouse quiesces)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("backtest", parents=[common], help="score a checkpoint over history")
@@ -2255,6 +2382,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "backfill — zero dropped sessions, zero "
                         "recompiles; results carry weights_version "
                         "from the swap barrier on")
+    p.add_argument("--continuous-train", action="store_true",
+                   help="--role solo: run the continuous fine-tuning "
+                        "loop beside the serving gateway — a synthetic "
+                        "corpus warehouse is tailed, fine-tuned on a "
+                        "sliding window, and every round's checkpoint "
+                        "hot-swaps into the live pool (zero serving "
+                        "recompiles; [train] continuous_* knobs, "
+                        "docs/training.md)")
+    p.add_argument("--swap-guard", action="store_true",
+                   help="with --continuous-train: shadow-score every "
+                        "candidate against the incumbent before the "
+                        "swap (fmda_tpu.eval.shadow; refusals keep the "
+                        "incumbent serving and are counted)")
+    p.add_argument("--continuous-days", type=int, default=2,
+                   help="synthetic corpus size (trading days) for the "
+                        "--continuous-train warehouse")
+    p.add_argument("--train-rounds", type=int, default=None,
+                   help="bound --continuous-train fine-tune rounds "
+                        "(default: until the backlog quiesces)")
+    p.add_argument("--train-checkpoint-dir", default=None,
+                   help="--continuous-train checkpoint directory "
+                        "(default: config train.checkpoint_dir)")
     p.add_argument("--chaos-plan", default=None, metavar="FILE",
                    help="--role local: run the chaos soak under this "
                         "fault-plan JSON (fmda_tpu.chaos.FaultPlan; "

@@ -443,6 +443,63 @@ class TrainConfig:
     prob_threshold: float = 0.5
     seed: int = 0
     checkpoint_dir: str = "checkpoints"
+    #: Microbatch gradient-accumulation factor K.  The batch is split
+    #: into K microbatches scanned into one donated optimizer update —
+    #: the same algebra as the full batch (per-microbatch loss *sums*
+    #: and mask counts are accumulated and normalized once at the end),
+    #: equal up to float32 re-association (docs/training.md).  Must
+    #: divide ``batch_size``.  1 = the seed step, bit-identical.
+    accum_steps: int = 1
+    #: Input-pipeline prefetch depth: how many composed+transferred
+    #: batches may be in flight ahead of the device step.  Host window
+    #: gather/normalization of chunk k+1 overlaps device compute of
+    #: chunk k behind a bounded queue; stalls surface as the
+    #: ``train_input_stall_seconds`` histogram.  1 still overlaps by a
+    #: single batch; 0 disables the background thread (synchronous).
+    prefetch_depth: int = 2
+    #: Per-chunk normalized-window cache capacity in chunks (LRU).
+    #: Epochs >= 2 reuse the gathered windows instead of re-fetching,
+    #: re-normalizing and re-gathering every pass.  Host RAM bound is
+    #: ``cache_chunks * chunk_size * window * n_features * 4`` bytes.
+    #: 0 disables caching (the seed behavior).
+    cache_chunks: int = 64
+    #: Continuous fine-tuning (``ContinuousTrainer``): fresh rows that
+    #: must land in the warehouse before a fine-tune round fires.
+    continuous_min_rows: int = 256
+    #: Sliding history window (rows) each round trains over.
+    continuous_window_rows: int = 2048
+    #: Epochs per fine-tune round (warm-started from the last round).
+    continuous_epochs: int = 1
+    #: Consecutive empty tail polls before the follow reader concludes
+    #: the warehouse has quiesced and the loop drains and exits.
+    continuous_follow_polls: int = 8
+    #: Wall seconds between empty tail polls (tests inject a waiter
+    #: instead — no wall sleeps in tier-1).
+    continuous_poll_s: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.accum_steps < 1:
+            raise ValueError(
+                f"train.accum_steps must be >= 1, got {self.accum_steps}")
+        if self.batch_size % self.accum_steps != 0:
+            raise ValueError(
+                f"train.accum_steps ({self.accum_steps}) must divide "
+                f"train.batch_size ({self.batch_size}): microbatches are "
+                f"equal fixed-shape slices")
+        if self.prefetch_depth < 0 or self.cache_chunks < 0:
+            raise ValueError(
+                f"train.prefetch_depth/cache_chunks must be >= 0, got "
+                f"{self.prefetch_depth}/{self.cache_chunks}")
+        if (self.continuous_min_rows < 1 or self.continuous_window_rows < 1
+                or self.continuous_epochs < 1
+                or self.continuous_follow_polls < 1):
+            raise ValueError(
+                "train.continuous_min_rows/continuous_window_rows/"
+                "continuous_epochs/continuous_follow_polls must be >= 1")
+        if self.continuous_poll_s <= 0:
+            raise ValueError(
+                f"train.continuous_poll_s must be > 0, got "
+                f"{self.continuous_poll_s}")
 
 
 @dataclass(frozen=True)

@@ -11,6 +11,7 @@ from fmda_tpu.data.pipeline import (
     ChunkDataset,
     WindowBatches,
     background_compose,
+    prefetch_batches,
     prefetch_to_device,
 )
 
@@ -28,5 +29,6 @@ __all__ = [
     "ChunkDataset",
     "WindowBatches",
     "background_compose",
+    "prefetch_batches",
     "prefetch_to_device",
 ]

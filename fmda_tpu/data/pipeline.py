@@ -16,7 +16,16 @@ The TPU-first re-design of the reference's SQL dataloader stack
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, List, NamedTuple, Optional, Sequence, Tuple
+from typing import (
+    Callable,
+    Iterable,
+    Iterator,
+    List,
+    NamedTuple,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -44,11 +53,17 @@ class ChunkDataset:
         *,
         bid_levels: int = 0,
         ask_levels: int = 0,
+        cache_chunks: int = 0,
     ) -> None:
         self.source = source
         self.window = window
         self.chunk_size = chunk_size
+        self.cache_chunks = cache_chunks
         self.ranges = chunk_ranges(len(source), chunk_size, window)
+        # per-chunk min-max stats: computed exactly once, here — every
+        # epoch pass reuses them (they also ride into the compiled step
+        # only through the already-normalized host batches, never
+        # recomputed per pass)
         self.norm_params: List[NormParams] = [
             chunk_norm_params(
                 source.fetch(r),
@@ -58,12 +73,49 @@ class ChunkDataset:
             )
             for r in self.ranges
         ]
+        from collections import OrderedDict
+
+        self._window_cache: "OrderedDict[int, Tuple[np.ndarray, np.ndarray]]" = (
+            OrderedDict()
+        )
 
     def __len__(self) -> int:
         return len(self.ranges)
 
     def __getitem__(self, idx: int) -> Tuple[range, NormParams]:
         return self.ranges[idx], self.norm_params[idx]
+
+    def windows(
+        self, chunk_idx: int, norm_params: Optional[NormParams] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Normalized stride-1 windows of one chunk: ``(x_windows,
+        y_windows)``.
+
+        The gather (source fetch + normalize + fancy-index copy) is the
+        dominant host cost of an epoch; with ``cache_chunks > 0`` the
+        result is kept in an LRU keyed on chunk index, so every pass
+        after the first reuses it instead of redoing the work (host RAM
+        bound: ``cache_chunks * chunk_size * window * F * 4`` bytes).
+        Cached arrays are aliased, not copied — callers must treat them
+        as read-only.  An explicit ``norm_params`` override (stats from
+        a different chunk) bypasses the cache.
+        """
+        cacheable = norm_params is None and self.cache_chunks > 0
+        if cacheable and chunk_idx in self._window_cache:
+            self._window_cache.move_to_end(chunk_idx)
+            return self._window_cache[chunk_idx]
+        ids, chunk_params = self[chunk_idx]
+        params = norm_params if norm_params is not None else chunk_params
+        x = normalize(self.source.fetch(ids), params)
+        y = np.asarray(self.source.fetch_targets(ids), np.float32)
+        widx = window_index_matrix(len(x), self.window)
+        x_windows = x[widx]  # (n_windows, window, F)
+        y_windows = y[widx[:, -1]] if len(widx) else y[:0]
+        if cacheable:
+            self._window_cache[chunk_idx] = (x_windows, y_windows)
+            while len(self._window_cache) > self.cache_chunks:
+                self._window_cache.popitem(last=False)
+        return x_windows, y_windows
 
     @property
     def final_norm_params(self) -> NormParams:
@@ -89,13 +141,8 @@ class WindowBatches:
         norm_params: Optional[NormParams] = None,
         drop_remainder: bool = False,
     ) -> None:
-        ids, chunk_params = dataset[chunk_idx]
-        params = norm_params if norm_params is not None else chunk_params
-        x = normalize(dataset.source.fetch(ids), params)
-        y = np.asarray(dataset.source.fetch_targets(ids), np.float32)
-        widx = window_index_matrix(len(x), dataset.window)
-        self.x_windows = x[widx]  # (n_windows, window, F)
-        self.y_windows = y[widx[:, -1]] if len(widx) else y[:0]
+        self.x_windows, self.y_windows = dataset.windows(
+            chunk_idx, norm_params)
         self.batch_size = batch_size
         self.drop_remainder = drop_remainder
 
@@ -150,6 +197,66 @@ def prefetch_to_device(
         except StopIteration:
             pass
         yield out
+
+
+def prefetch_batches(
+    batches: Iterable[Batch],
+    place: Callable[[Batch], Batch],
+    *,
+    depth: int = 2,
+    stall_observer: Optional[Callable[[float], None]] = None,
+) -> Iterator[Batch]:
+    """Depth-N double-buffered input pipeline.
+
+    Host composition runs in a daemon thread (:func:`background_compose`
+    — so WindowBatches gathers for chunk k+1 overlap the device steps of
+    chunk k), each composed batch is handed to ``place`` immediately
+    (``jax.device_put`` dispatches async — the transfer also overlaps),
+    and up to ``depth`` placed batches ride ahead of the consumer.
+
+    ``stall_observer(seconds)`` is called with the host-side wait per
+    pull — the time the step loop would have spent blocked on input
+    (exported as the ``train_input_stall_seconds`` histogram).  The
+    first ``depth`` pulls include pipeline warm-up by design, the same
+    way the first ``train_step_seconds`` bin carries the compile.
+
+    ``depth=0`` degrades to a synchronous place-per-batch loop with no
+    background thread (still observed) — the seed behavior.
+    """
+    import time as _time
+
+    if depth <= 0:
+        def sync() -> Iterator[Batch]:
+            for b in batches:
+                t0 = _time.perf_counter()
+                out = place(b)
+                if stall_observer is not None:
+                    stall_observer(_time.perf_counter() - t0)
+                yield out
+        return sync()
+
+    import collections
+
+    def run() -> Iterator[Batch]:
+        queue: collections.deque = collections.deque()
+        it = iter(background_compose(batches, depth=depth))
+        exhausted = False
+        while True:
+            while not exhausted and len(queue) < depth:
+                t0 = _time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                queue.append(place(b))
+                if stall_observer is not None:
+                    stall_observer(_time.perf_counter() - t0)
+            if not queue:
+                return
+            yield queue.popleft()
+
+    return run()
 
 
 def background_compose(

@@ -50,6 +50,7 @@ class ShadowEvaluator:
         window: int = 30,
         n_tickers: Optional[int] = None,
         seed: int = 0,
+        row_transform=None,
     ) -> None:
         from fmda_tpu.config import FeatureConfig, QualityConfig
 
@@ -63,6 +64,10 @@ class ShadowEvaluator:
         self.n_tickers = int(n_tickers if n_tickers is not None
                              else self.cfg.swap_eval_sessions)
         self.seed = int(seed)
+        # zero-arg FACTORY (e.g. the bound warehouse.joined_row_transform
+        # method): each replay needs a fresh stateful mapper, and gate()
+        # replays twice (incumbent + candidate)
+        self.row_transform = row_transform
         self._incumbent_score: Optional[Dict] = None
 
     # -- one side's replay + join -------------------------------------------
@@ -85,7 +90,9 @@ class ShadowEvaluator:
         start_ts = recent[-1] if recent else None
         source = WarehouseHistory(
             self.warehouse, self.n_tickers,
-            n_features=model_cfg.n_features, start_ts=start_ts)
+            n_features=model_cfg.n_features, start_ts=start_ts,
+            row_transform=(self.row_transform()
+                           if self.row_transform is not None else None))
         pool = SessionPool(model_cfg, params, capacity=self.n_tickers,
                            window=self.window)
         gateway = FleetGateway(

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -309,7 +310,10 @@ class CompileLedger:
     """Process-wide compile/cost accounting over tracked functions.
 
     Thread-safe; zero-cost when ``enabled`` is False (tracked calls
-    skip straight to the jit).  ``events`` is an optional
+    skip straight to the jit).  Registration is *weak*: the owning
+    pool/trainer holds the strong reference, and programs whose owner
+    has been dropped leave the ledger with it.  ``events`` is an
+    optional
     :class:`fmda_tpu.obs.events.EventLog` attached by the
     Observability plane (latest instance wins, the chaos-hook
     discipline)."""
@@ -320,7 +324,11 @@ class CompileLedger:
         self.cost_analysis = cost_analysis
         self.events = None
         self._lock = threading.Lock()
-        self._functions: List[TrackedFunction] = []
+        # weak registrations: the owner (pool, trainer) keeps the strong
+        # reference; a dropped owner's programs fall off the ledger
+        # instead of rooting the owner — and everything its jit closure
+        # captures (device caches, parameter trees) — for process life
+        self._functions: List["weakref.ref[TrackedFunction]"] = []
         self._backend: Optional[str] = None
         self._cost_probe_failures = 0
         self._mfu_prev: Optional[Tuple[float, float, float]] = None
@@ -331,11 +339,15 @@ class CompileLedger:
 
     def track(self, fn: TrackedFunction) -> None:
         with self._lock:
-            self._functions.append(fn)
+            self._functions.append(weakref.ref(fn))
 
     def functions(self) -> List[TrackedFunction]:
         with self._lock:
-            return list(self._functions)
+            live = [(ref, fn) for ref in self._functions
+                    if (fn := ref()) is not None]
+            if len(live) != len(self._functions):
+                self._functions = [ref for ref, _ in live]
+            return [fn for _, fn in live]
 
     def mark_warm(self) -> None:
         for fn in self.functions():

@@ -369,6 +369,9 @@ class MySQLWarehouse:
         start_ts: Optional[str] = None,
         end_ts: Optional[str] = None,
         chunk: int = 4096,
+        *,
+        follow: int = 0,
+        poll_wait=None,
     ):
         """Bulk history reader — the embedded backend's contract
         (:meth:`fmda_tpu.stream.warehouse.Warehouse.iter_row_chunks`)
@@ -379,7 +382,14 @@ class MySQLWarehouse:
         over the scan).  Yields the raw landed columns as
         ``(timestamps, (B, F) float64)`` — bit-for-bit what the
         embedded backend yields for the same landed rows (tests
-        assert parity through the fake server)."""
+        assert parity through the fake server).
+
+        ``follow > 0`` is the bounded tail-follow of the embedded
+        contract: short pages keep scanning, empty pages wait
+        (``poll_wait()``, injectable; default 50 ms sleep) and re-poll
+        the same keyset cursor, and ``follow`` consecutive empty polls
+        end the scan — identical stop/resume semantics on both
+        backends, parity-tested."""
         import numpy as np
 
         if chunk < 1:
@@ -396,6 +406,7 @@ class MySQLWarehouse:
             bounds.append(end_ts)
         where = " AND ".join(conds)
         last_id = 0
+        idle = 0
         while True:
             self._cursor.execute(
                 f"SELECT ID, Timestamp, {col_list} "
@@ -405,14 +416,33 @@ class MySQLWarehouse:
             )
             rows = self._cursor.fetchall()
             if not rows:
-                return
+                if follow <= 0 or idle >= int(follow):
+                    return
+                idle += 1
+                if poll_wait is not None:
+                    poll_wait()
+                else:
+                    import time as _time
+
+                    _time.sleep(0.05)
+                continue
+            idle = 0
             last_id = int(rows[-1][0])
             matrix = np.asarray(
                 [r[2:] for r in rows], np.float64
             ).reshape(len(rows), len(cols))
             yield [r[1] or "" for r in rows], matrix
-            if len(rows) < chunk:
+            if len(rows) < chunk and follow <= 0:
                 return
+
+    def joined_row_transform(self):
+        """Fresh stateful mapper from :meth:`iter_row_chunks`' raw landed
+        chunks to the joined ``x_fields`` rows :meth:`fetch` serves —
+        same contract as the embedded backend's method of the same name."""
+        from fmda_tpu.ops.indicators import landed_row_transform
+
+        return landed_row_transform(
+            self.features.table_columns(), self.features)
 
     def healthy(self) -> bool:
         """Probe that the server still answers — the ``/healthz``

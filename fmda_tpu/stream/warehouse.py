@@ -236,6 +236,9 @@ class Warehouse:
         start_ts: Optional[str] = None,
         end_ts: Optional[str] = None,
         chunk: int = 4096,
+        *,
+        follow: int = 0,
+        poll_wait: Optional[Any] = None,
     ) -> Iterator[Tuple[List[str], np.ndarray]]:
         """Bulk history reader: the landed table in ID order as
         ``(timestamps, (B, F) float64 matrix)`` chunks — ONE keyset-
@@ -251,7 +254,18 @@ class Warehouse:
         column (inclusive both ends); the lock is held per chunk, not
         across the whole scan, so ingest keeps landing while a backfill
         reads.  Rows landing behind the cursor mid-scan are picked up;
-        this is a reader, not a snapshot."""
+        this is a reader, not a snapshot.
+
+        ``follow > 0`` turns the scan into a *bounded tail-follow* (the
+        continuous trainer's change-data-capture feed): a short page no
+        longer ends the scan; on an empty page the reader waits
+        (``poll_wait()`` — injectable, so tests never wall-sleep; the
+        default sleeps 50 ms) and re-issues the same keyset query, and
+        only ``follow`` *consecutive* empty polls conclude the writer
+        has quiesced.  The cursor survives the waits — rows landed
+        between polls resume exactly after the last yielded ID, never
+        re-reading or skipping a row.  ``follow=0`` is the seed
+        behavior, bit-for-bit."""
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
         cols = ", ".join(_quote(c) for c in self._columns)
@@ -265,6 +279,7 @@ class Warehouse:
             bounds.append(end_ts)
         where = " AND ".join(conds)
         last_id = 0
+        idle = 0
         while True:
             with self._lock:
                 rows = self._conn.execute(
@@ -273,14 +288,32 @@ class Warehouse:
                     (last_id, *bounds, int(chunk)),
                 ).fetchall()
             if not rows:
-                return
+                if follow <= 0 or idle >= int(follow):
+                    return
+                idle += 1
+                if poll_wait is not None:
+                    poll_wait()
+                else:
+                    _time.sleep(0.05)
+                continue
+            idle = 0
             last_id = int(rows[-1][0])
             matrix = np.asarray(
                 [r[2:] for r in rows], np.float64
             ).reshape(len(rows), len(self._columns))
             yield [r[1] or "" for r in rows], matrix
-            if len(rows) < chunk:
+            if len(rows) < chunk and follow <= 0:
                 return
+
+    def joined_row_transform(self):
+        """Fresh stateful mapper from :meth:`iter_row_chunks`' raw landed
+        chunks to the joined ``x_fields`` rows :meth:`fetch` serves —
+        pass (the bound method, as a factory) wherever a replay over
+        this warehouse must feed a model sized to the joined view (e.g.
+        ``ShadowEvaluator(row_transform=wh.joined_row_transform)``)."""
+        from fmda_tpu.ops.indicators import landed_row_transform
+
+        return landed_row_transform(self._columns, self.features)
 
     def has_timestamp(self, ts: str) -> bool:
         """Point-indexed existence check — the engine's dedupe fallback

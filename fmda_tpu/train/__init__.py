@@ -1,20 +1,35 @@
-from fmda_tpu.train.losses import class_weights, weighted_bce_with_logits
+from fmda_tpu.train.losses import (
+    class_weights,
+    weighted_bce_sums,
+    weighted_bce_with_logits,
+)
 from fmda_tpu.train.trainer import (
     EpochMetrics,
     Trainer,
     TrainState,
     imbalance_weights_from_source,
 )
+from fmda_tpu.train.continuous import (
+    ContinuousTrainer,
+    TailSource,
+    gateway_publisher,
+    router_publisher,
+)
 from fmda_tpu.train.multiticker import MultiTickerDataset
 from fmda_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
 
 __all__ = [
     "class_weights",
+    "weighted_bce_sums",
     "weighted_bce_with_logits",
     "Trainer",
     "TrainState",
     "EpochMetrics",
     "imbalance_weights_from_source",
+    "ContinuousTrainer",
+    "TailSource",
+    "gateway_publisher",
+    "router_publisher",
     "MultiTickerDataset",
     "save_checkpoint",
     "restore_checkpoint",

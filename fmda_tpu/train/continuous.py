@@ -1,0 +1,342 @@
+"""Continuous fine-tuning: tail the warehouse, fine-tune, hot-swap.
+
+The loop the PR 17–19 plumbing was built for, closed.  A
+:class:`ContinuousTrainer` tails fresh rows through the warehouse
+reader's bounded follow mode (``Warehouse.iter_row_chunks(follow=...)``
+— the change-data-capture feed, keyset-resumed across polls), and every
+time ``train.continuous_min_rows`` fresh rows have landed it
+
+1. fine-tunes on a sliding window of the newest
+   ``train.continuous_window_rows`` rows (warm-started from the previous
+   round's state — one compiled step for the whole loop's lifetime:
+   every round's batches are the same padded shapes, so after the first
+   round's warm-up ``recompiles == 0`` is a pinned contract);
+2. writes a versioned checkpoint (``step_NNNNNNNN``) plus the
+   ``quality_profile.json`` drift baseline beside it;
+3. publishes the new params through an injected ``publish`` callable —
+   :func:`router_publisher` (``FleetRouter.broadcast_hot_swap`` with the
+   shadow-eval guardrail via ``require_eval``) or
+   :func:`gateway_publisher` (solo ``FleetGateway.hot_swap``).  Refused
+   candidates are counted, never retried blindly — the incumbent keeps
+   serving, the next round gets another shot.
+
+Serving never stops, never recompiles: a hot swap is a host-side weight
+rebind on the pool (docs/replay.md "Hot swap"), and the trainer runs
+beside it — same process (``serve-fleet --continuous-train``) or a
+separate one pointed at the same warehouse (``python -m fmda_tpu train
+--continuous``).
+
+Everything time-shaped is injected (``wait_fn``), so tests drive the
+loop to quiescence with zero wall sleeps; the CLI passes nothing and
+gets the ``train.continuous_poll_s`` wall-clock poll.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fmda_tpu.config import ModelConfig, TrainConfig
+from fmda_tpu.train.trainer import (
+    Trainer,
+    TrainState,
+    imbalance_weights_from_source,
+)
+
+log = logging.getLogger("fmda_tpu.train.continuous")
+
+
+class _Stopped(Exception):
+    """Raised out of the injected waiter to abort the tail promptly."""
+
+
+class TailSource:
+    """A :class:`FeatureSource` view of the newest rows of another
+    source: positions ``1..n`` map to base positions
+    ``offset+1..offset+n`` (the 1-based dense position space every
+    source speaks).  The sliding fine-tune window, without copying."""
+
+    def __init__(self, base, offset: int, n: int) -> None:
+        self._base = base
+        self._offset = int(offset)
+        self._n = int(n)
+
+    @property
+    def x_fields(self) -> Tuple[str, ...]:
+        return tuple(self._base.x_fields)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def fetch(self, ids: Sequence[int]) -> np.ndarray:
+        return self._base.fetch([self._offset + int(i) for i in ids])
+
+    def fetch_targets(self, ids: Sequence[int]) -> np.ndarray:
+        return self._base.fetch_targets([self._offset + int(i) for i in ids])
+
+
+def gateway_publisher(
+    gateway, *, require_eval: Optional[Callable[[Any], Tuple[bool, dict]]] = None
+) -> Callable[[Any], Tuple[bool, Dict[str, Any]]]:
+    """Publish rounds into a solo :class:`FleetGateway`.
+
+    ``require_eval`` is the same guardrail contract
+    ``FleetRouter.broadcast_hot_swap`` takes (e.g.
+    :class:`fmda_tpu.eval.shadow.ShadowEvaluator`): candidate params in,
+    ``(ok, detail)`` out — a refusal keeps the incumbent serving."""
+
+    def publish(params) -> Tuple[bool, Dict[str, Any]]:
+        if require_eval is not None:
+            ok, detail = require_eval(params)
+            if not ok:
+                return False, dict(detail)
+        version = gateway.hot_swap(params)
+        return True, {"version": int(version)}
+
+    return publish
+
+
+def router_publisher(
+    router, *, require_eval: Optional[Callable[[Any], Tuple[bool, dict]]] = None
+) -> Callable[[Any], Tuple[bool, Dict[str, Any]]]:
+    """Publish rounds fleet-wide via ``broadcast_hot_swap`` (the router
+    runs the guardrail itself and counts/publishes refusals)."""
+
+    def publish(params) -> Tuple[bool, Dict[str, Any]]:
+        told = router.broadcast_hot_swap(params, require_eval=require_eval)
+        return told > 0, {"workers_told": int(told)}
+
+    return publish
+
+
+class ContinuousTrainer:
+    """Sliding-window fine-tuning over a live warehouse.
+
+    Parameters
+    ----------
+    warehouse:
+        Any warehouse speaking the :class:`FeatureSource` protocol plus
+        ``iter_row_chunks(follow=...)`` (both backends do).
+    model_cfg / train_cfg:
+        The serving model family (the param tree MUST match what the
+        serving pool was built with, or the hot swap would rebind to a
+        mismatched tree) and the ``[train]`` knobs — the
+        ``continuous_*`` fields drive this loop.
+    publish:
+        ``params -> (accepted, detail)``; see :func:`gateway_publisher`
+        / :func:`router_publisher`.  None = checkpoints only.
+    wait_fn:
+        Called between empty tail polls (default: wall sleep of
+        ``train.continuous_poll_s``).  Tests inject the row generator
+        here and never sleep.
+    """
+
+    def __init__(
+        self,
+        warehouse,
+        model_cfg: ModelConfig,
+        train_cfg: TrainConfig,
+        *,
+        checkpoint_dir: str,
+        publish: Optional[Callable[[Any], Tuple[bool, Dict[str, Any]]]] = None,
+        bid_levels: int = 0,
+        ask_levels: int = 0,
+        drift_bins: int = 16,
+        target_lead: int = 0,
+        mesh=None,
+        dp_axis: str = "dp",
+        wait_fn: Optional[Callable[[], None]] = None,
+        chunk: int = 1024,
+    ) -> None:
+        self.warehouse = warehouse
+        self.train_cfg = train_cfg
+        self.checkpoint_dir = checkpoint_dir
+        self.publish = publish
+        self.bid_levels = bid_levels
+        self.ask_levels = ask_levels
+        self.drift_bins = drift_bins
+        self.target_lead = target_lead
+        self.chunk = int(chunk)
+        self._wait_fn = wait_fn
+        self._stop = threading.Event()
+        # class-imbalance weights are computed ONCE, from the history
+        # available at loop start: they are closed-over constants of the
+        # compiled step, and re-deriving them per round would mean a new
+        # program (a recompile) every round — the loop pins zero
+        weight, pos_weight = (None, None)
+        if len(warehouse) > 0:
+            try:
+                weight, pos_weight = imbalance_weights_from_source(warehouse)
+            except (ValueError, ZeroDivisionError):
+                log.warning("imbalance weights unavailable — unweighted BCE")
+        self.trainer = Trainer(
+            model_cfg, train_cfg,
+            weight=weight, pos_weight=pos_weight,
+            mesh=mesh, dp_axis=dp_axis,
+        )
+        self._state: Optional[TrainState] = None
+        self.checkpoints: List[str] = []
+        self.rounds = 0
+        self.rows_seen = 0
+        self.swaps_accepted = 0
+        self.swaps_refused = 0
+        self.last_metrics: Optional[Dict[str, float]] = None
+
+    # -- control ------------------------------------------------------------
+
+    def stop(self) -> None:
+        """Ask a running :meth:`run` to come home: the tail aborts at
+        the next poll, a round in flight completes (a half-applied
+        optimizer step is worse than a late stop), then run() returns."""
+        self._stop.set()
+
+    def _wait(self) -> None:
+        if self._stop.is_set():
+            raise _Stopped()
+        if self._wait_fn is not None:
+            self._wait_fn()
+        else:
+            import time as _time
+
+            _time.sleep(self.train_cfg.continuous_poll_s)
+        if self._stop.is_set():
+            raise _Stopped()
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(
+        self,
+        *,
+        max_rounds: Optional[int] = None,
+        initial_state: Optional[TrainState] = None,
+    ) -> Dict[str, Any]:
+        """Tail → fine-tune → checkpoint → publish, until the warehouse
+        quiesces (``continuous_follow_polls`` consecutive empty polls),
+        ``max_rounds`` rounds have run, or :meth:`stop` is called.
+        Returns the loop summary (also the shape ``serve-fleet
+        --continuous-train`` reports)."""
+        tc = self.train_cfg
+        self._state = initial_state
+        budget = max_rounds if max_rounds is not None else 0
+        fresh = 0
+        tail = self.warehouse.iter_row_chunks(
+            chunk=self.chunk,
+            follow=tc.continuous_follow_polls,
+            poll_wait=self._wait,
+        )
+        try:
+            for _ts, rows in tail:
+                fresh += len(rows)
+                self.rows_seen += len(rows)
+                if fresh < tc.continuous_min_rows:
+                    continue
+                if self._round():
+                    fresh = 0
+                if self._stop.is_set():
+                    break
+                if budget and self.rounds >= budget:
+                    break
+        except _Stopped:
+            pass
+        finally:
+            tail.close()
+        # the tail quiesced (or the budget hit) with fresh rows still
+        # untrained: drain them into one final round so a bounded run
+        # always covers every row it saw
+        if fresh >= 1 and not self._stop.is_set() \
+                and not (budget and self.rounds >= budget):
+            self._round()
+        return self.summary()
+
+    def _round(self) -> bool:
+        """One fine-tune round over the sliding tail window.  False =
+        skipped (window still too short to window/chunk)."""
+        tc = self.train_cfg
+        n = len(self.warehouse)
+        lo = max(0, n - tc.continuous_window_rows)
+        source = TailSource(self.warehouse, lo, n - lo)
+        # a round needs at least one full chunk of windows
+        if len(source) < tc.chunk_size + tc.window:
+            log.info(
+                "round skipped: window has %d rows, need >= %d",
+                len(source), tc.chunk_size + tc.window)
+            return False
+        from fmda_tpu.obs.registry import default_registry
+
+        import time as _time
+
+        reg = default_registry()
+        t0 = _time.perf_counter()
+        state, history, dataset = self.trainer.fit(
+            source,
+            epochs=tc.continuous_epochs,
+            bid_levels=self.bid_levels,
+            ask_levels=self.ask_levels,
+            initial_state=self._state,
+        )
+        self._state = state
+        if self.rounds == 0:
+            # round 1 carried the compiles; from here every compile is a
+            # contract violation the ledger counts
+            self.trainer.mark_warm()
+        self.rounds += 1
+        reg.counter("continuous_rounds_total").inc()
+        reg.histogram("continuous_round_seconds").observe(
+            _time.perf_counter() - t0)
+        last = history["train"][-1]
+        self.last_metrics = {
+            "loss": float(last.loss), "accuracy": float(last.accuracy)}
+        from fmda_tpu.train.checkpoint import save_checkpoint
+
+        ckpt = save_checkpoint(
+            self.checkpoint_dir, state, dataset.final_norm_params)
+        self.checkpoints.append(ckpt)
+        self._write_profile(ckpt)
+        if self.publish is not None:
+            import jax
+
+            accepted, detail = self.publish(jax.device_get(state.params))
+            outcome = "accepted" if accepted else "refused"
+            reg.counter("continuous_swaps_total", outcome=outcome).inc()
+            if accepted:
+                self.swaps_accepted += 1
+            else:
+                self.swaps_refused += 1
+            log.info("round %d: swap %s %s", self.rounds, outcome, detail)
+        return True
+
+    def _write_profile(self, ckpt: str) -> None:
+        """The drift-monitor baseline beside the checkpoint — same
+        best-effort contract as the one-shot ``train`` command (a
+        degenerate window must not kill the loop)."""
+        from fmda_tpu.eval.drift import (
+            build_profile, profile_path_for, save_profile)
+
+        try:
+            wh = self.warehouse
+            n = len(wh)
+            ids = list(range(max(1, n - 4096 + 1), n + 1))
+            rows = wh.fetch(ids)
+            targets = (
+                wh.fetch_targets(ids) if n > self.target_lead else None)
+            profile = build_profile(
+                rows, targets, bins=self.drift_bins,
+                columns=list(wh.x_fields))
+            save_profile(profile_path_for(ckpt), profile)
+        except (ValueError, IndexError, OSError) as e:
+            log.warning("quality profile not written beside %s: %s", ckpt, e)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "rounds": self.rounds,
+            "rows_seen": self.rows_seen,
+            "checkpoints": list(self.checkpoints),
+            "swaps_accepted": self.swaps_accepted,
+            "swaps_refused": self.swaps_refused,
+            "trainer_unexpected_recompiles":
+                self.trainer.unexpected_recompiles,
+            "last_metrics": self.last_metrics,
+        }

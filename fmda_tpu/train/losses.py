@@ -52,3 +52,35 @@ def weighted_bce_with_logits(
     m = example_mask.astype(per_elem.dtype)[:, None]
     denom = jnp.maximum(jnp.sum(m) * per_elem.shape[-1], 1.0)
     return jnp.sum(per_elem * m) / denom
+
+
+def weighted_bce_sums(
+    logits: jax.Array,
+    targets: jax.Array,
+    *,
+    weight: Optional[jax.Array] = None,
+    pos_weight: Optional[jax.Array] = None,
+    example_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Unnormalized (loss_sum, element_count) for gradient accumulation.
+
+    The masked mean above is ``sum / max(valid_rows * n_classes, 1)`` — a
+    *global* normalizer, so a K-way microbatch split cannot just average
+    per-microbatch means (partial tail masks would skew it).  Accumulating
+    these sums and counts across microbatches and dividing once recovers
+    the full-batch loss (and, by linearity of the gradient, the
+    full-batch gradient) exactly up to float re-association
+    (docs/training.md "Accumulation math").
+    """
+    targets = targets.astype(logits.dtype)
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    pw = pos_weight if pos_weight is not None else 1.0
+    per_elem = -(pw * targets * log_p + (1.0 - targets) * log_not_p)
+    if weight is not None:
+        per_elem = per_elem * weight
+    if example_mask is None:
+        n = float(per_elem.shape[0] * per_elem.shape[-1])
+        return jnp.sum(per_elem), jnp.asarray(n, per_elem.dtype)
+    m = example_mask.astype(per_elem.dtype)[:, None]
+    return jnp.sum(per_elem * m), jnp.sum(m) * per_elem.shape[-1]

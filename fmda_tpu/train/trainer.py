@@ -30,13 +30,17 @@ from fmda_tpu.data.pipeline import (
     Batch,
     ChunkDataset,
     WindowBatches,
-    background_compose,
-    prefetch_to_device,
+    prefetch_batches,
 )
 from fmda_tpu.data.source import FeatureSource
 from fmda_tpu.models import build_model
+from fmda_tpu.obs.device import tracked_jit
 from fmda_tpu.ops.metrics import multilabel_metrics
-from fmda_tpu.train.losses import class_weights, weighted_bce_with_logits
+from fmda_tpu.train.losses import (
+    class_weights,
+    weighted_bce_sums,
+    weighted_bce_with_logits,
+)
 
 log = logging.getLogger("fmda_tpu.train")
 
@@ -81,6 +85,9 @@ class Trainer:
         self.dp_axis = dp_axis
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
+        # placed-batch cache: (id(dataset), chunk tuple) -> (dataset,
+        # [Batch]) — see _run_chunks; the dataset ref pins id() validity
+        self._placed_cache: Dict[Any, Tuple[Any, List[Batch]]] = {}
 
     # -- state ---------------------------------------------------------------
 
@@ -151,13 +158,30 @@ class Trainer:
 
         return batch_sharding(self.mesh, self.dp_axis)
 
+    def _step_shardings(self):
+        """(replicated, batch-dp) NamedShardings under a mesh, else None.
+
+        With a mesh the compiled steps carry explicit in/out shardings:
+        params/optimizer state replicated over every device, the batch
+        split along the dp axis (XLA inserts the gradient all-reduce).
+        A 1-device mesh lowers to the identical program as the meshless
+        jit — bit-identity is test-pinned (tests/test_train_parallel.py).
+        """
+        if self.mesh is None:
+            return None
+        from fmda_tpu.parallel.mesh import batch_sharding, replicated_sharding
+
+        return (
+            replicated_sharding(self.mesh),
+            batch_sharding(self.mesh, self.dp_axis),
+        )
+
     def _build_train_step(self):
         model, tc = self.model, self.train_cfg
         weight, pos_weight = self.weight, self.pos_weight
+        accum = tc.accum_steps
 
-        def step_fn(state: TrainState, batch: Batch, rng: jax.Array):
-            dropout_rng = jax.random.fold_in(rng, state.step)
-
+        def grads_full(params, batch: Batch, dropout_rng):
             def loss_fn(params):
                 logits = model.apply(
                     {"params": params},
@@ -175,8 +199,77 @@ class Trainer:
                 return loss, logits
 
             (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
+                params
             )
+            return loss, logits, grads
+
+        def grads_accum(params, batch: Batch, dropout_rng):
+            # (B, ...) -> (K, B/K, ...): equal fixed-shape microbatches
+            # scanned into summed gradients.  The masked loss is a global
+            # mean (sum / valid-element count), so the scan accumulates
+            # the *unnormalized* loss sum, gradient-of-sum, and element
+            # count, and normalizes once at the end — the full-batch
+            # gradient exactly, up to float re-association
+            # (docs/training.md "Accumulation math").  Peak activation
+            # memory is one microbatch instead of the full batch.
+            micro = jax.tree.map(
+                lambda a: a.reshape((accum, a.shape[0] // accum)
+                                    + a.shape[1:]),
+                batch,
+            )
+
+            def sum_loss_fn(params, mb: Batch, mb_rng):
+                logits = model.apply(
+                    {"params": params},
+                    mb.x,
+                    deterministic=False,
+                    rngs={"dropout": mb_rng},
+                )
+                s, count = weighted_bce_sums(
+                    logits,
+                    mb.y,
+                    weight=weight,
+                    pos_weight=pos_weight,
+                    example_mask=mb.mask,
+                )
+                return s, (count, logits)
+
+            def body(carry, xs):
+                grad_sum, loss_sum, count_sum = carry
+                mb, k = xs
+                # each microbatch gets its own dropout stream (folded on
+                # the microbatch index) — full/accumulated equivalence is
+                # stated at dropout 0.0
+                (s, (count, logits)), g = jax.value_and_grad(
+                    sum_loss_fn, has_aux=True
+                )(params, mb, jax.random.fold_in(dropout_rng, k))
+                carry = (
+                    jax.tree.map(jnp.add, grad_sum, g),
+                    loss_sum + s,
+                    count_sum + count,
+                )
+                return carry, logits
+
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            init = (zeros, jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32))
+            (grad_sum, loss_sum, count_sum), logits_k = jax.lax.scan(
+                body, init, (micro, jnp.arange(accum))
+            )
+            denom = jnp.maximum(count_sum, 1.0)
+            grads = jax.tree.map(lambda g: g / denom, grad_sum)
+            # metrics run on the full-batch logits, same as the K=1 path
+            logits = logits_k.reshape((-1,) + logits_k.shape[2:])
+            return loss_sum / denom, logits, grads
+
+        def step_fn(state: TrainState, batch: Batch, rng: jax.Array):
+            dropout_rng = jax.random.fold_in(rng, state.step)
+            if accum == 1:
+                loss, logits, grads = grads_full(
+                    state.params, batch, dropout_rng)
+            else:
+                loss, logits, grads = grads_accum(
+                    state.params, batch, dropout_rng)
             updates, opt_state = self.optimizer.update(
                 grads, state.opt_state, state.params
             )
@@ -193,7 +286,14 @@ class Trainer:
             )
             return new_state, loss, metrics
 
-        return jax.jit(step_fn, donate_argnums=(0,))
+        jit_kwargs: Dict[str, Any] = {"donate_argnums": (0,)}
+        shardings = self._step_shardings()
+        if shardings is not None:
+            replicated, batched = shardings
+            jit_kwargs["in_shardings"] = (
+                replicated, Batch(batched, batched, batched), replicated)
+            jit_kwargs["out_shardings"] = (replicated, replicated, replicated)
+        return tracked_jit(step_fn, name="train_step", **jit_kwargs)
 
     def _build_eval_step(self):
         model, tc = self.model, self.train_cfg
@@ -216,31 +316,71 @@ class Trainer:
             )
             return loss, metrics
 
-        return jax.jit(eval_fn)
+        jit_kwargs: Dict[str, Any] = {}
+        shardings = self._step_shardings()
+        if shardings is not None:
+            replicated, batched = shardings
+            jit_kwargs["in_shardings"] = (
+                replicated, Batch(batched, batched, batched))
+            jit_kwargs["out_shardings"] = (replicated, replicated)
+        return tracked_jit(eval_fn, name="eval_step", **jit_kwargs)
+
+    # -- compile accounting ---------------------------------------------------
+
+    def mark_warm(self) -> None:
+        """Declare step warm-up over: any compile after this is counted
+        as *unexpected* on the compile ledger (the contract the
+        ``train_throughput`` bench phase and the continuous loop pin)."""
+        self._train_step.mark_warm()
+        self._eval_step.mark_warm()
+
+    @property
+    def unexpected_recompiles(self) -> int:
+        return (self._train_step.unexpected_recompiles
+                + self._eval_step.unexpected_recompiles)
+
+    @property
+    def compile_counts(self) -> Dict[str, Optional[int]]:
+        """Distinct compiled programs per step — the pin the
+        ``train_throughput`` bench asserts (batches are always padded to
+        ``batch_size``, so each step compiles exactly once).  None when
+        jax's (private) cache probe is unavailable."""
+        return {"train_step": self._train_step.cache_size(),
+                "eval_step": self._eval_step.cache_size()}
 
     # -- batch plumbing ------------------------------------------------------
 
     def _place_batches(self, batches: Iterable[Batch]) -> Iterable[Batch]:
-        """Move host batches to the device(s): simple prefetch without a
-        mesh, dp batch sharding with one.  When the job spans processes
-        (multi-host DCN), each process's batches are its *local* shard of
-        the global batch and are assembled in place."""
+        """The overlapped input pipeline: host composition runs in a
+        background thread, composed batches are transferred immediately
+        (dp batch sharding under a mesh; when the job spans processes
+        each process's batches are its *local* shard of the global batch
+        and are assembled in place), and up to ``train.prefetch_depth``
+        placed batches ride ahead of the step loop.  Host-side waits
+        surface as ``train_input_stall_seconds``."""
+        from fmda_tpu.obs.registry import default_registry
+
+        stall = default_registry().histogram("train_input_stall_seconds")
         sharding = self._batch_sharding()
         if sharding is None:
-            return prefetch_to_device(batches)
-        if jax.process_count() > 1:
+            place = jax.device_put
+        elif jax.process_count() > 1:
             from fmda_tpu.parallel.distributed import place_local_batch
 
-            return (
-                place_local_batch(self.mesh, b, self.dp_axis) for b in batches
-            )
-        return (
-            Batch(
-                jax.device_put(b.x, sharding),
-                jax.device_put(b.y, sharding),
-                jax.device_put(b.mask, sharding),
-            )
-            for b in batches
+            def place(b: Batch) -> Batch:
+                return place_local_batch(self.mesh, b, self.dp_axis)
+        else:
+            def place(b: Batch) -> Batch:
+                return Batch(
+                    jax.device_put(b.x, sharding),
+                    jax.device_put(b.y, sharding),
+                    jax.device_put(b.mask, sharding),
+                )
+        return prefetch_batches(
+            batches,
+            place,
+            depth=self.train_cfg.prefetch_depth,
+            stall_observer=stall.observe,
         )
 
     def _chunk_batches(
@@ -260,10 +400,47 @@ class Trainer:
         rng: Optional[jax.Array],
         train: bool,
     ) -> Tuple[TrainState, EpochMetrics, np.ndarray]:
-        batch_iters = (
-            self._chunk_batches(dataset, idx) for idx in chunk_indices
-        )
-        return self._run_batches(state, batch_iters, rng, train)
+        # one flat host generator over every chunk, behind one pipeline:
+        # the window gather/normalization of chunk k+1 (cached after the
+        # first epoch — ChunkDataset.windows) happens in the composer
+        # thread while the device computes on chunk k's batches.
+        #
+        # With ``cache_chunks`` set, the PLACED batches of the first
+        # pass are kept and later epochs replay the device-side buffers
+        # directly — no re-gather, no re-pad, no re-transfer (batches
+        # are never donated, so reuse is safe; same arrays -> bit-
+        # identical epochs).  RAM bound: cache_chunks chunks of windows
+        # on the host (ChunkDataset) plus their placed batches.
+        cache_on = (self.train_cfg.cache_chunks > 0
+                    and len(chunk_indices) <= self.train_cfg.cache_chunks)
+        key = (id(dataset), tuple(chunk_indices))
+        if cache_on:
+            entry = self._placed_cache.get(key)
+            # the entry pins its dataset, so a live hit can never be an
+            # id()-reuse collision from a collected dataset
+            if entry is not None and entry[0] is dataset:
+                return self._run_batches(state, (entry[1],), rng, train)
+
+        def host_batches() -> Iterable[Batch]:
+            for idx in chunk_indices:
+                yield from WindowBatches(
+                    dataset, idx, self.train_cfg.batch_size)
+
+        placed = self._place_batches(host_batches())
+        if not cache_on:
+            return self._run_batches(state, (placed,), rng, train)
+        sink: List[Batch] = []
+
+        def capturing() -> Iterable[Batch]:
+            for b in placed:
+                sink.append(b)
+                yield b
+
+        out = self._run_batches(state, (capturing(),), rng, train)
+        self._placed_cache[key] = (dataset, sink)
+        while len(self._placed_cache) > 4:  # train + val + headroom
+            self._placed_cache.pop(next(iter(self._placed_cache)))
+        return out
 
     def _run_batches(
         self,
@@ -360,23 +537,30 @@ class Trainer:
         bid_levels: int = 0,
         ask_levels: int = 0,
         initial_state: Optional[TrainState] = None,
+        dataset: Optional[ChunkDataset] = None,
     ) -> Tuple[TrainState, Dict[str, List[EpochMetrics]], ChunkDataset]:
         """Train over a feature source; returns (state, history, dataset).
 
         ``initial_state`` (e.g. from :meth:`restore_state`) resumes
         mid-training instead of initialising fresh; ``epochs`` then means
-        *additional* epochs to run.
+        *additional* epochs to run.  ``dataset`` reuses a previously
+        returned :class:`ChunkDataset` (it must wrap ``source``) instead
+        of re-materializing it — a resumed fit then keeps every warm
+        cache tier: host window gathers AND the placed device batches,
+        which are keyed on dataset identity.
         """
         tc = self.train_cfg
         rng = jax.random.PRNGKey(tc.seed) if rng is None else rng
         init_rng, step_rng = jax.random.split(rng)
-        dataset = ChunkDataset(
-            source,
-            tc.chunk_size,
-            tc.window,
-            bid_levels=bid_levels,
-            ask_levels=ask_levels,
-        )
+        if dataset is None:
+            dataset = ChunkDataset(
+                source,
+                tc.chunk_size,
+                tc.window,
+                bid_levels=bid_levels,
+                ask_levels=ask_levels,
+                cache_chunks=tc.cache_chunks,
+            )
         train_chunks, val_chunks, _ = dataset.split(tc.val_size, tc.test_size)
         state = (
             initial_state if initial_state is not None
@@ -398,9 +582,17 @@ class Trainer:
                 state, dataset, train_chunks, step_rng, train=True
             )
             history["train"].append(train_metrics)
-            _, val_metrics, _ = self._run_chunks(
-                state, dataset, val_chunks, None, train=False
-            )
+            if val_chunks:
+                _, val_metrics, _ = self._run_chunks(
+                    state, dataset, val_chunks, None, train=False
+                )
+            else:
+                # continuous fine-tune rounds run val_size=0 (quality is
+                # judged by the shadow gate, not a holdout) — NaN metrics
+                # without the empty-pass warning
+                nan = float("nan")
+                val_metrics = EpochMetrics(
+                    nan, nan, nan, np.zeros(self.model_cfg.output_size))
             history["val"].append(val_metrics)
             epoch_hist.observe(_time.perf_counter() - t_epoch)
             epoch_counter.inc()
@@ -451,12 +643,10 @@ class Trainer:
 
             def iters(chunks):
                 # mixed composition is the expensive host stage (~12 ms
-                # per 800-row batch): run it in a background thread so it
-                # overlaps with the device step, then double-buffer the
-                # transfer (prefetch inside _place_batches)
+                # per 800-row batch): _place_batches runs it in the
+                # composer thread and double-buffers the transfer
                 return (
-                    self._place_batches(
-                        background_compose(mtd.mixed_batches(rc, k)))
+                    self._place_batches(mtd.mixed_batches(rc, k))
                     for rc in mtd.rounds(chunks)
                 )
         else:

@@ -80,6 +80,7 @@ class TestPhaseRegistry:
             "device_obs_overhead",
             "analysis_lint",
             "wire_codec_bench",
+            "train_throughput",
         }
         assert expected == set(bench._PHASES)
 
@@ -116,6 +117,18 @@ class TestPhaseRegistry:
             "budget_pct", "conservation_ok", "disabled_wall_s",
             "enabled_wall_s", "join_wall_s", "joined", "ok",
             "overhead_pct", "quiet_host", "reps", "rounds", "sessions")
+
+    def test_train_throughput_artifact_schema_pinned(self):
+        """ISSUE 20 phase-change pin: artifacts/train_throughput.json
+        carries the input-pipeline A/B (seed-sync vs pipelined vs
+        pipelined+accum samples/s), the compile pins, and the continuous
+        fine-tune/hot-swap cell under exactly these keys — the driver
+        reads the artifact as the tentpole's evidence, so a key rename
+        must update this pin (and the readers) in the same PR."""
+        assert tuple(sorted(bench.TRAIN_THROUGHPUT_SCHEMA)) == (
+            "accum_speed_ratio", "backend", "batch_size", "cells",
+            "compile_ok", "continuous", "epochs", "features",
+            "quiet_host", "rows", "speedup_vs_seed", "window")
 
     def test_kernel_sweep_and_fleet_ab_cover_the_ssm_family(self):
         """ISSUE 14 phase-change pin: the kernel sweep races the SSM

@@ -1,0 +1,360 @@
+"""Continuous fine-tuning (ISSUE 20): the warehouse tail-follow feed,
+the landed->joined row transform, and the fine-tune -> checkpoint ->
+guardrailed hot-swap loop, driven to quiescence with zero wall sleeps
+(everything time-shaped is the injected ``wait_fn`` / ``poll_wait``).
+
+Contracts pinned here:
+
+* ``iter_row_chunks(follow=N)`` is exactly-once change-data-capture:
+  rows landed between polls resume after the last yielded ID, N
+  consecutive empty polls conclude, and both warehouse backends yield
+  bit-identical chunk streams under the same arrival schedule;
+* ``joined_row_transform()`` maps streamed landed chunks to the joined
+  x_fields view bit-for-bit equal to ``fetch()`` at every chunk size
+  (the rolling-indicator context survives chunk boundaries), which is
+  what lets ``ShadowEvaluator`` replay a landed-width warehouse;
+* the :class:`ContinuousTrainer` loop fine-tunes on fresh rows, writes
+  versioned checkpoints with the drift baseline beside each, hot-swaps
+  accepted rounds into a live pool without a single serving recompile,
+  and a refused candidate leaves the incumbent serving.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import fake_mysql
+from fmda_tpu.config import (
+    DEFAULT_TOPICS,
+    FeatureConfig,
+    ModelConfig,
+    TrainConfig,
+    WarehouseConfig,
+)
+from fmda_tpu.data.synthetic import (
+    SyntheticMarketConfig,
+    synthetic_session_messages,
+)
+from fmda_tpu.eval.drift import profile_path_for
+from fmda_tpu.models import build_model
+from fmda_tpu.runtime import BatcherConfig, FleetGateway, SessionPool
+from fmda_tpu.stream import InProcessBus, StreamEngine, Warehouse
+from fmda_tpu.train.continuous import ContinuousTrainer, gateway_publisher
+
+CLASSES = 4
+
+
+# ---------------------------------------------------------------------------
+# tail-follow: bounded change-data-capture over the landed table
+# ---------------------------------------------------------------------------
+
+
+def _landed_rows(fc, n, *, seed=0, start=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"Timestamp": f"2020-01-02 09:{30 + (start + i) // 60:02d}:"
+                      f"{(start + i) % 60:02d}",
+         **{f: float(rng.normal()) for f in fc.table_columns()}}
+        for i in range(n)]
+
+
+def test_follow_tails_rows_landed_between_polls():
+    fc = FeatureConfig()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    wh.insert_rows(_landed_rows(fc, 5, seed=1))
+    script = [_landed_rows(fc, 4, seed=2, start=5),
+              _landed_rows(fc, 3, seed=3, start=9)]
+    polls = []
+
+    def poll_wait():
+        polls.append(None)
+        if script:
+            wh.insert_rows(script.pop(0))
+
+    chunks = list(wh.iter_row_chunks(chunk=2, follow=3, poll_wait=poll_wait))
+    ts = [t for tss, _ in chunks for t in tss]
+    # every row exactly once, in landed order, across the waits
+    assert len(ts) == 12
+    assert ts == sorted(ts)
+    assert len(set(ts)) == 12
+    # two productive polls + the three consecutive empties that conclude
+    assert len(polls) == 5
+
+
+def test_follow_zero_is_the_seed_scan():
+    fc = FeatureConfig()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    wh.insert_rows(_landed_rows(fc, 7, seed=1))
+    called = []
+    chunks = list(wh.iter_row_chunks(
+        chunk=3, follow=0, poll_wait=lambda: called.append(None)))
+    assert sum(len(t) for t, _ in chunks) == 7
+    assert called == []  # no follow -> never waits
+
+
+@pytest.fixture
+def mysql_env(monkeypatch):
+    fake_mysql.SERVER = fake_mysql.FakeServer()
+    monkeypatch.setitem(sys.modules, "mysql", fake_mysql)
+    monkeypatch.setitem(sys.modules, "mysql.connector", fake_mysql.connector)
+    yield fake_mysql.SERVER
+
+
+def test_follow_embedded_vs_mysql_bit_for_bit(mysql_env):
+    """Same arrival schedule into both backends -> identical chunk
+    streams, pages and bits (the parity surface the replay reader
+    already pins, extended to the tail-follow mode)."""
+    from fmda_tpu.stream.mysql_warehouse import MySQLWarehouse
+
+    fc = FeatureConfig()
+    emb = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    myw = MySQLWarehouse(fc, WarehouseConfig(backend="mysql"))
+    seed_rows = _landed_rows(fc, 5, seed=4)
+    arrivals = [_landed_rows(fc, 7, seed=5, start=5),
+                _landed_rows(fc, 2, seed=6, start=12)]
+
+    def run(wh):
+        script = [list(batch) for batch in arrivals]
+
+        def poll_wait():
+            if script:
+                wh.insert_rows(script.pop(0))
+
+        return list(wh.iter_row_chunks(
+            chunk=3, follow=2, poll_wait=poll_wait))
+
+    emb.insert_rows(seed_rows)
+    myw.insert_rows(seed_rows)
+    a, b = run(emb), run(myw)
+    assert len(a) == len(b) > 0
+    for (ts_a, rows_a), (ts_b, rows_b) in zip(a, b):
+        assert ts_a == ts_b
+        assert rows_a.dtype == rows_b.dtype == np.float64
+        assert np.array_equal(rows_a, rows_b)
+    assert sum(len(t) for t, _ in a) == 14
+
+
+# ---------------------------------------------------------------------------
+# landed -> joined row transform
+# ---------------------------------------------------------------------------
+
+
+def _ingested_warehouse(n_days=4):
+    fc = FeatureConfig()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    bus = InProcessBus(DEFAULT_TOPICS)
+    engine = StreamEngine(bus, wh, fc)
+    for topic, msg in synthetic_session_messages(
+            fc, SyntheticMarketConfig(seed=3, n_days=n_days)):
+        bus.publish(topic, msg)
+    engine.step()
+    return fc, wh
+
+
+@pytest.mark.parametrize("chunk", [3, 37, 10_000])
+def test_joined_row_transform_matches_fetch_bit_for_bit(chunk):
+    """Streamed landed chunks through the transform == the warehouse's
+    joined fetch, at any chunk size: the rolling-indicator context
+    carried across chunk boundaries reproduces the full-table derived
+    columns exactly (head NaNs -> 0 included)."""
+    fc, wh = _ingested_warehouse()
+    n = len(wh)
+    assert n > 60
+    want = wh.fetch(range(1, n + 1))
+    assert want.shape[1] == len(wh.x_fields)
+    transform = wh.joined_row_transform()
+    got = np.concatenate(
+        [transform(m) for _, m in wh.iter_row_chunks(chunk=chunk)], axis=0)
+    assert got.dtype == want.dtype == np.float32
+    assert got.shape == want.shape
+    assert np.array_equal(got, want)
+
+
+def test_joined_row_transform_is_a_fresh_state_factory():
+    """Two transforms from the same warehouse are independent — the
+    factory contract ShadowEvaluator.gate() relies on (it replays twice,
+    and a shared rolling buffer would corrupt the second replay)."""
+    fc, wh = _ingested_warehouse()
+    n = len(wh)
+    want = wh.fetch(range(1, n + 1))
+    for _ in range(2):
+        transform = wh.joined_row_transform()
+        got = np.concatenate(
+            [transform(m) for _, m in wh.iter_row_chunks(chunk=50)], axis=0)
+        assert np.array_equal(got, want)
+
+
+def test_shadow_evaluator_replays_landed_warehouse():
+    """The regression the transform exists for: a ShadowEvaluator over a
+    real (landed-width) warehouse must replay the joined view instead of
+    dying on the landed/joined width mismatch."""
+    fc, wh = _ingested_warehouse()
+    from fmda_tpu.eval.shadow import ShadowEvaluator
+
+    model_cfg = ModelConfig(
+        hidden_size=2, n_features=len(wh.x_fields), output_size=CLASSES,
+        dropout=0.0, bidirectional=False, use_pallas=False)
+    params = build_model(model_cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, 8, model_cfg.n_features)))["params"]
+    bare = ShadowEvaluator(
+        params, model_config=model_cfg, warehouse=wh,
+        window=8, n_tickers=2)
+    with pytest.raises(ValueError, match="row_transform"):
+        bare.score(params)
+    guarded = ShadowEvaluator(
+        params, model_config=model_cfg, warehouse=wh,
+        window=8, n_tickers=2,
+        row_transform=wh.joined_row_transform)
+    ok, detail = guarded.gate(params)
+    assert ok  # candidate == incumbent can never regress
+    assert {"margin", "joined", "scored"} <= set(detail)
+
+
+# ---------------------------------------------------------------------------
+# the loop: tail -> fine-tune -> checkpoint -> guardrailed swap
+# ---------------------------------------------------------------------------
+
+
+def _serving_stack(wh, *, window=16):
+    model_cfg = ModelConfig(
+        hidden_size=4, n_features=len(wh.x_fields), output_size=CLASSES,
+        dropout=0.0, bidirectional=False, use_pallas=False)
+    params = build_model(model_cfg).init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((1, window, model_cfg.n_features)))["params"]
+    pool = SessionPool(model_cfg, params, capacity=4, window=window)
+    gateway = FleetGateway(
+        pool, batcher_config=BatcherConfig(
+            bucket_sizes=(4,), max_linger_s=0.0))
+    pool.step(np.full(4, pool.padding_slot, np.int32),
+              np.zeros((4, model_cfg.n_features), np.float32))
+    assert pool.compile_count == 1
+    pool.mark_warm()
+    return model_cfg, pool, gateway
+
+
+def _continuous_env(tmp_path, *, publish_factory, n_days=8):
+    fc = FeatureConfig()
+    wh = Warehouse(fc, WarehouseConfig(path=":memory:"))
+    bus = InProcessBus(DEFAULT_TOPICS)
+    engine = StreamEngine(bus, wh, fc)
+    msgs = synthetic_session_messages(
+        fc, SyntheticMarketConfig(seed=1, n_days=n_days))
+    per_day = 5 * 78  # five feed messages per 5-minute bar
+
+    def feed_day():
+        n = 0
+        for topic, msg in msgs:
+            bus.publish(topic, msg)
+            n += 1
+            if n >= per_day:
+                break
+        if n:
+            engine.step()
+
+    feed_day()
+    feed_day()  # 2-day backlog for round 1
+    model_cfg, pool, gateway = _serving_stack(wh)
+    train_cfg = TrainConfig(
+        batch_size=32, window=16, chunk_size=96,
+        learning_rate=1e-3, epochs=1, clip=50.0,
+        val_size=0.0, test_size=0.0, seed=0,
+        prefetch_depth=2, cache_chunks=8,
+        continuous_min_rows=64, continuous_window_rows=448,
+        continuous_epochs=1, continuous_follow_polls=3,
+        continuous_poll_s=0.01)
+    continuous = ContinuousTrainer(
+        wh, model_cfg, train_cfg,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        publish=publish_factory(gateway),
+        target_lead=fc.max_lead,
+        wait_fn=feed_day, chunk=512)
+    return continuous, pool, gateway
+
+
+def test_continuous_loop_rounds_checkpoints_and_swaps(tmp_path):
+    continuous, pool, gateway = _continuous_env(
+        tmp_path, publish_factory=gateway_publisher)
+    summary = continuous.run(max_rounds=2)
+    assert summary["rounds"] == 2
+    assert summary["swaps_accepted"] == 2
+    assert summary["swaps_refused"] == 0
+    assert summary["rows_seen"] >= 64
+    assert summary["trainer_unexpected_recompiles"] == 0
+    # every round left a restorable checkpoint with the drift baseline
+    # beside it
+    assert len(summary["checkpoints"]) == 2
+    for ckpt in summary["checkpoints"]:
+        assert os.path.isdir(ckpt)
+        assert os.path.isfile(profile_path_for(ckpt))
+    # serving took both swaps live, recompile-free, and keeps stepping
+    assert gateway.weights_version == 2
+    assert pool.recompiles_after_warmup == 0
+    n_features = continuous.trainer.model_cfg.n_features
+    pool.step(np.full(4, pool.padding_slot, np.int32),
+              np.zeros((4, n_features), np.float32))
+    assert pool.recompiles_after_warmup == 0
+    # the fine-tuned params are what the pool now serves
+    state = continuous._state
+    trained = jax.device_get(state.params)
+    served = jax.device_get(pool._params)
+    assert all(jax.tree.leaves(jax.tree.map(
+        np.array_equal, trained, served)))
+
+
+def test_continuous_refusal_keeps_incumbent(tmp_path):
+    """A refusing guardrail counts the refusal and leaves the incumbent
+    serving — the loop never force-publishes."""
+    def refusing(gateway):
+        return gateway_publisher(
+            gateway,
+            require_eval=lambda params: (False, {"reason": "shadow says no"}))
+
+    continuous, pool, gateway = _continuous_env(
+        tmp_path, publish_factory=refusing)
+    before = jax.device_get(pool._params)
+    summary = continuous.run(max_rounds=2)
+    assert summary["rounds"] == 2
+    assert summary["swaps_accepted"] == 0
+    assert summary["swaps_refused"] == 2
+    assert gateway.weights_version is None  # no swap ever landed
+    after = jax.device_get(pool._params)
+    assert all(jax.tree.leaves(jax.tree.map(np.array_equal, before, after)))
+    # checkpoints still written: a refused round is kept for forensics
+    assert len(summary["checkpoints"]) == 2
+
+
+def test_continuous_skips_rounds_until_window_long_enough(tmp_path):
+    """Too few rows to cut one chunk of windows: the loop polls, skips,
+    and reports zero rounds instead of dying or spinning."""
+    fc = Warehouse(FeatureConfig(), WarehouseConfig(path=":memory:"))
+    train_cfg = TrainConfig(
+        batch_size=8, window=16, chunk_size=96,
+        val_size=0.0, test_size=0.0, seed=0,
+        continuous_min_rows=8, continuous_window_rows=448,
+        continuous_follow_polls=2, continuous_poll_s=0.01)
+    model_cfg = ModelConfig(
+        hidden_size=2, n_features=len(fc.x_fields), output_size=CLASSES,
+        dropout=0.0, bidirectional=False, use_pallas=False)
+    feature_cfg = FeatureConfig()
+    rows = iter([_landed_rows(feature_cfg, 20, seed=9)])
+
+    def feed_once():
+        batch = next(rows, None)
+        if batch:
+            fc.insert_rows(batch)
+
+    continuous = ContinuousTrainer(
+        fc, model_cfg, train_cfg,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        wait_fn=feed_once, chunk=64)
+    summary = continuous.run(max_rounds=2)
+    assert summary["rounds"] == 0
+    assert summary["checkpoints"] == []
+    assert summary["rows_seen"] == 20

@@ -137,17 +137,41 @@ def test_unexpected_recompile_counted_and_evented_after_mark_warm():
 def test_ledger_families_aggregate_same_named_programs():
     """Several pools in one process can track same-named programs (a
     multi-worker soak) — the exposition must stay one sample per label
-    set, summed."""
+    set, summed.  The workers stay live across the scrape (registration
+    is weak: a dropped owner's programs leave the ledger with it)."""
     led = CompileLedger(enabled=True)
+    fns = []
     for _ in range(2):
         f = tracked_jit(lambda x: x - 1.0, name="shared", ledger=led,
                         signature_of=lambda x: int(x.shape[0]))
         f(jnp.ones((3,)))
+        fns.append(f)
     fams = led.families()
     compiles = [s for s in fams["counters"] if s["name"] == "compile_total"
                 and s["labels"].get("program") == "shared"]
     assert len(compiles) == 1
     assert compiles[0]["value"] == 2
+
+
+def test_ledger_registration_is_weak():
+    """Registration must never be what keeps a dead owner alive: a
+    trainer/pool that is dropped takes its tracked programs — and
+    everything their jit closures captured (parameter trees, placed
+    device batches) — off the ledger with it.  Before this pin, every
+    Trainer ever constructed in a process leaked through the ledger."""
+    import gc
+    import weakref
+
+    led = CompileLedger(enabled=True)
+    f = tracked_jit(lambda x: x * 2.0, name="ephemeral", ledger=led,
+                    signature_of=lambda x: int(x.shape[0]))
+    f(jnp.ones((2,)))
+    assert len(led.functions()) == 1
+    ref = weakref.ref(f)
+    del f
+    gc.collect()
+    assert ref() is None
+    assert led.functions() == []
 
 
 def test_ledger_thread_safety_sum_of_deltas_equals_cache_size():
